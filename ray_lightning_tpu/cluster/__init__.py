@@ -1,0 +1,27 @@
+from .actor import ProcessActor, RemoteError, ActorDiedError
+from .queue import DriverQueue, QueueHandle
+from .backend import (
+    ObjectRef,
+    ClusterBackend,
+    LocalBackend,
+    RayBackend,
+    get_backend,
+    ray_is_available,
+)
+from .rpc import find_free_port, get_node_ip
+
+__all__ = [
+    "ProcessActor",
+    "RemoteError",
+    "ActorDiedError",
+    "DriverQueue",
+    "QueueHandle",
+    "ObjectRef",
+    "ClusterBackend",
+    "LocalBackend",
+    "RayBackend",
+    "get_backend",
+    "ray_is_available",
+    "find_free_port",
+    "get_node_ip",
+]

@@ -1,0 +1,367 @@
+"""Process actors: the worker-launch layer of the built-in control plane.
+
+TPU-native analogue of the reference's ``RayExecutor`` actor
+(``/root/reference/ray_lightning/ray_ddp.py:38-63``): a generic remote
+process shell with ``set_env_var(s)``, ``get_node_ip``, device introspection
+and an arbitrary-function runner (``execute``).  The reference creates one
+Ray actor per GPU; here one actor ≙ one **TPU host** (a v4 host owns 4
+chips; JAX is multi-controller SPMD).
+
+Launch mechanics — deliberately Ray-like, NOT ``multiprocessing``-like:
+the child is a fresh ``subprocess`` running a dedicated module entry
+(``python -m ray_lightning_tpu.cluster.actor``), so the user's ``__main__``
+is **never re-imported** (no ``if __name__ == "__main__"`` guard required
+in user scripts, matching Ray-actor ergonomics) and the child does not
+inherit the driver's libtpu/XLA runtime (TPU chips are single-owner per
+process).  Code travels exclusively via cloudpickle, which serializes
+``__main__``-defined functions by value.
+
+RPC protocol: length-prefixed cloudpickle frames over a loopback TCP
+socket; a random authkey passed through the child's stdin authenticates the
+connection.  A dedicated receiver thread resolves
+``concurrent.futures.Future`` objects, so the driver can poll futures while
+pumping the distributed queue (reference ``util.py:55-68``).
+
+Env-var plumbing matters: JAX reads ``XLA_FLAGS`` / ``JAX_PLATFORMS`` /
+``TPU_VISIBLE_CHIPS`` / ``LIBTPU_INIT_ARGS`` at import time, so the actor's
+env dict is applied in the child *before* any user function (and hence any
+jax import) runs — the analogue of the reference broadcasting
+``MASTER_ADDR``/seed env vars to actors before training
+(``ray_ddp.py:215-228``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import traceback
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, Optional
+
+from . import rpc
+
+__all__ = ["ProcessActor", "RemoteError", "ActorDiedError"]
+
+
+class RemoteError(RuntimeError):
+    """An exception raised inside an actor, re-raised on the driver."""
+
+    def __init__(self, actor_name: str, formatted_traceback: str):
+        super().__init__(
+            f"Remote call on actor {actor_name!r} failed:\n{formatted_traceback}"
+        )
+        self.actor_name = actor_name
+        self.remote_traceback = formatted_traceback
+
+
+class ActorDiedError(RuntimeError):
+    """The actor process exited before answering (≙ Ray's RayActorError).
+
+    The reference surfaces worker death as a raised Ray error from
+    ``ray.get`` inside ``process_results`` (``util.py:55-68``); we do the
+    same — failures propagate fast and crash the fit.
+    """
+
+
+def _apply_env(env: Dict[str, str]) -> None:
+    for k, v in env.items():
+        os.environ[k] = str(v)
+
+
+# ---------------------------------------------------------------------------
+# Functions commonly shipped to actors (top-level so plain pickle also works)
+# ---------------------------------------------------------------------------
+
+def _remote_set_env_vars(env: Dict[str, str]) -> None:
+    """≙ RayExecutor.set_env_vars (reference ``ray_ddp.py:44-49``)."""
+    _apply_env(env)
+
+
+def _remote_get_node_ip() -> str:
+    """≙ RayExecutor.get_node_ip (reference ``ray_ddp.py:51-53``)."""
+    return rpc.get_node_ip()
+
+
+def _remote_get_device_info() -> Dict[str, Any]:
+    """TPU analogue of get_node_and_gpu_ids (reference ``ray_ddp.py:55-58``).
+
+    Imports jax *inside the actor* (first touch of the accelerator) and
+    reports the local device topology for the driver's rank/mesh mapping.
+    """
+    import jax
+
+    devices = jax.local_devices()
+    return {
+        "ip": rpc.get_node_ip(),
+        "process_index": jax.process_index(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+        "platform": devices[0].platform if devices else "none",
+        "device_kinds": [d.device_kind for d in devices],
+    }
+
+
+# ---------------------------------------------------------------------------
+# Child-side main loop
+# ---------------------------------------------------------------------------
+
+def _child_main() -> None:
+    """Entry point of the actor subprocess (``python -m ...cluster.actor``)."""
+    host = sys.argv[1]
+    port = int(sys.argv[2])
+    authkey = bytes.fromhex(sys.stdin.readline().strip())
+    sock = socket.create_connection((host, port), timeout=60)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    rpc.send_frame(sock, authkey)
+
+    send_lock = threading.Lock()
+
+    def reply(obj: Any) -> None:
+        with send_lock:
+            rpc.send_frame(sock, rpc.dumps(obj))
+
+    while True:
+        try:
+            msg = rpc.loads(rpc.recv_frame(sock))
+        except (ConnectionError, OSError):
+            break
+        kind = msg[0]
+        if kind == "exit":
+            reply(("bye", None, None))
+            break
+        if kind == "call":
+            _, call_id, payload = msg
+            try:
+                fn, args, kwargs = payload
+                result = fn(*args, **kwargs)
+                out = ("ok", call_id, result)
+            except BaseException:  # noqa: BLE001 - ship everything back
+                out = ("err", call_id, traceback.format_exc())
+            try:
+                reply(out)
+            except (ConnectionError, OSError):
+                break
+            except BaseException:
+                # Result not serializable — report that instead of dying.
+                reply(
+                    ("err", call_id,
+                     "actor result failed to serialize:\n"
+                     + traceback.format_exc())
+                )
+    sock.close()
+    sys.exit(0)
+
+
+class ProcessActor:
+    """A worker subprocess with a generic ``execute`` RPC (≙ ``RayExecutor``)."""
+
+    _ids = itertools.count()
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        env: Optional[Dict[str, str]] = None,
+        startup_timeout_s: float = 120.0,
+    ):
+        self.name = name or f"rlt-actor-{next(self._ids)}"
+        self._env = dict(env or {})
+        authkey = os.urandom(16)
+
+        server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        server.bind(("127.0.0.1", 0))
+        server.listen(1)
+        host, port = server.getsockname()
+
+        child_env = dict(os.environ)
+        child_env.update({k: str(v) for k, v in self._env.items()})
+        # Mirror the driver's import environment: cloudpickle serializes
+        # functions from importable modules *by reference*, so anything the
+        # driver can import (the user's project, this package from a source
+        # checkout, pytest-rootdir test modules) must be importable in the
+        # child too.  '' means cwd on sys.path; make that explicit.
+        driver_path = [p if p else os.getcwd() for p in sys.path]
+        pp = child_env.get("PYTHONPATH", "")
+        extra = [p for p in pp.split(os.pathsep) if p and p not in driver_path]
+        child_env["PYTHONPATH"] = os.pathsep.join(driver_path + extra)
+
+        self._proc = subprocess.Popen(
+            [sys.executable, "-c",
+             "from ray_lightning_tpu.cluster.actor import _child_main; "
+             "_child_main()",
+             host, str(port)],
+            stdin=subprocess.PIPE,
+            env=child_env,
+        )
+        assert self._proc.stdin is not None
+        self._proc.stdin.write(authkey.hex().encode() + b"\n")
+        self._proc.stdin.flush()
+
+        # Accept with timeout + child liveness polling — a child that dies
+        # during startup must surface as ActorDiedError, never a hang.
+        server.settimeout(1.0)
+        conn: Optional[socket.socket] = None
+        deadline = time.monotonic() + startup_timeout_s
+        while conn is None:
+            if self._proc.poll() is not None:
+                server.close()
+                raise ActorDiedError(
+                    f"Actor {self.name!r} exited during startup "
+                    f"(exit code {self._proc.returncode})."
+                )
+            if time.monotonic() > deadline:
+                server.close()
+                self._proc.terminate()
+                raise ActorDiedError(
+                    f"Actor {self.name!r} did not connect within "
+                    f"{startup_timeout_s}s."
+                )
+            try:
+                conn, _ = server.accept()
+            except socket.timeout:
+                continue
+        server.close()
+        conn.settimeout(None)
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if rpc.recv_frame(conn) != authkey:
+            conn.close()
+            self._proc.terminate()
+            raise ActorDiedError(f"Actor {self.name!r} failed authentication.")
+        self._conn = conn
+
+        self._send_lock = threading.Lock()
+        self._call_ids = itertools.count()
+        self._pending: Dict[int, Future] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+        self._conn_dead = False
+        self._recv_thread = threading.Thread(
+            target=self._receive_loop, name=f"{self.name}-recv", daemon=True
+        )
+        self._recv_thread.start()
+
+    # -- receive path -------------------------------------------------------
+    def _receive_loop(self) -> None:
+        while True:
+            try:
+                msg = rpc.loads(rpc.recv_frame(self._conn))
+            except (ConnectionError, OSError):
+                self._fail_all_pending()
+                return
+            status, call_id, payload = msg
+            if status == "bye":
+                self._fail_all_pending()
+                return
+            with self._lock:
+                fut = self._pending.pop(call_id, None)
+            if fut is None:
+                continue
+            if status == "ok":
+                fut.set_result(payload)
+            else:
+                fut.set_exception(RemoteError(self.name, payload))
+
+    def _fail_all_pending(self) -> None:
+        with self._lock:
+            self._conn_dead = True
+            pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(
+                    ActorDiedError(
+                        f"Actor {self.name!r} died before answering "
+                        f"(exit code {self._proc.poll()})."
+                    )
+                )
+
+    # -- submit path --------------------------------------------------------
+    def submit(self, fn: Callable, *args: Any, **kwargs: Any) -> Future:
+        """Asynchronously run ``fn(*args, **kwargs)`` in the actor.
+
+        ≙ ``RayExecutor.execute.remote`` (reference ``ray_ddp.py:60-62``,
+        submission at ``ray_ddp.py:349-353``).  Returns a standard
+        ``concurrent.futures.Future``.
+        """
+        if self._closed or self._conn_dead or self._proc.poll() is not None:
+            raise ActorDiedError(f"Actor {self.name!r} is not alive.")
+        fut: Future = Future()
+        call_id = next(self._call_ids)
+        with self._lock:
+            self._pending[call_id] = fut
+        try:
+            with self._send_lock:
+                rpc.send_frame(
+                    self._conn, rpc.dumps(("call", call_id, (fn, args, kwargs)))
+                )
+        except (OSError, ValueError) as e:
+            with self._lock:
+                self._pending.pop(call_id, None)
+            raise ActorDiedError(f"Failed to submit to actor {self.name!r}: {e}")
+        # Close the race with _fail_all_pending(): if the connection died
+        # between our aliveness check and the insert above, the swap may
+        # have missed this future — TCP happily buffers bytes into a dying
+        # socket, so the send alone proves nothing.
+        with self._lock:
+            if self._conn_dead and not fut.done():
+                self._pending.pop(call_id, None)
+                fut.set_exception(
+                    ActorDiedError(f"Actor {self.name!r} died during submit.")
+                )
+        return fut
+
+    def execute(self, fn: Callable, *args: Any, **kwargs: Any) -> Any:
+        """Synchronous convenience wrapper around :meth:`submit`."""
+        return self.submit(fn, *args, **kwargs).result()
+
+    # -- RayExecutor-parity conveniences ------------------------------------
+    def set_env_vars(self, env: Dict[str, str]) -> None:
+        self._env.update(env)
+        self.execute(_remote_set_env_vars, env)
+
+    def get_node_ip(self) -> str:
+        return self.execute(_remote_get_node_ip)
+
+    def get_device_info(self) -> Dict[str, Any]:
+        return self.execute(_remote_get_device_info)
+
+    # -- lifecycle ----------------------------------------------------------
+    def is_alive(self) -> bool:
+        return (
+            not self._closed
+            and not self._conn_dead
+            and self._proc.poll() is None
+        )
+
+    def kill(self, timeout: float = 5.0) -> None:
+        """Tear down the actor (≙ ``ray.kill(w, no_restart=True)``,
+        reference ``ray_ddp.py:398-400``)."""
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            with self._send_lock:
+                rpc.send_frame(self._conn, rpc.dumps(("exit",)))
+        except (OSError, ValueError):
+            pass
+        deadline = time.monotonic() + timeout
+        while self._proc.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        if self._proc.poll() is None:
+            self._proc.terminate()
+            try:
+                self._proc.wait(timeout)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+        try:
+            self._conn.close()
+        except OSError:
+            pass
+
+
+if __name__ == "__main__":
+    _child_main()

@@ -1,0 +1,275 @@
+"""Cluster backend abstraction + in-memory object store.
+
+The reference leans on Ray core for four services (SURVEY §2.2): actor
+scheduling, object transfer (``ray.put`` at ``ray_ddp.py:340``), the
+distributed queue, and teardown.  This module provides those behind a small
+interface so the framework runs:
+
+* **LocalBackend** (default, zero deps): process actors on this machine —
+  the analogue of ``ray.init()`` auto-bootstrapping a local cluster
+  (reference ``ray_ddp.py:125-126``).  This is also the mode used on a TPU
+  pod slice where an external launcher (GKE, xpk, mpirun) starts one driver
+  per slice.
+* **RayBackend**: if real Ray *is* installed, the same interface maps onto
+  ``@ray.remote`` actors with resource reservations
+  (``RayExecutor.options(num_cpus=..., resources=...)``, reference
+  ``ray_ddp.py:183-189``) — keeping Ray as control plane while the data
+  plane stays XLA/ICI.  Gated with the ``Unavailable`` pattern.
+
+Object store: ``put()`` eagerly serializes with cloudpickle into an
+:class:`ObjectRef` whose payload travels inside actor RPC messages — the
+driver serializes the model **once** and every worker deserializes its own
+copy, exactly the ``ray.put(model)`` / implicit-get dance of reference
+``ray_ddp.py:339-353``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+from . import rpc
+from .actor import ProcessActor
+from .queue import DriverQueue
+
+__all__ = [
+    "ObjectRef",
+    "ClusterBackend",
+    "LocalBackend",
+    "RayBackend",
+    "get_backend",
+    "ray_is_available",
+]
+
+
+def ray_is_available() -> bool:
+    try:
+        import ray  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+class ObjectRef:
+    """A by-value object reference (≙ ``ray.ObjectRef``).
+
+    Serialization happens exactly once at ``put`` time; each ``get`` call
+    deserializes a fresh copy (so workers never alias driver state — the
+    property the reference gets from Ray's object store).
+    """
+
+    __slots__ = ("_payload",)
+
+    def __init__(self, payload: bytes):
+        self._payload = payload
+
+    @classmethod
+    def from_object(cls, obj: Any) -> "ObjectRef":
+        return cls(rpc.dumps(obj))
+
+    def get(self) -> Any:
+        return rpc.loads(self._payload)
+
+    @property
+    def nbytes(self) -> int:
+        return len(self._payload)
+
+
+class ClusterBackend:
+    """Interface every control-plane backend implements."""
+
+    def create_actor(
+        self,
+        name: str,
+        env: Optional[Dict[str, str]] = None,
+        num_cpus: float = 1,
+        resources: Optional[Dict[str, float]] = None,
+    ):
+        raise NotImplementedError
+
+    def put(self, obj: Any) -> ObjectRef:
+        raise NotImplementedError
+
+    def create_queue(self) -> DriverQueue:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        pass
+
+
+class LocalBackend(ClusterBackend):
+    """Process actors on the local host (spawn)."""
+
+    def __init__(self):
+        self._actors: List[ProcessActor] = []
+
+    def create_actor(
+        self,
+        name: str,
+        env: Optional[Dict[str, str]] = None,
+        num_cpus: float = 1,
+        resources: Optional[Dict[str, float]] = None,
+    ) -> ProcessActor:
+        actor = ProcessActor(name=name, env=env)
+        self._actors.append(actor)
+        return actor
+
+    def put(self, obj: Any) -> ObjectRef:
+        return ObjectRef.from_object(obj)
+
+    def create_queue(self) -> DriverQueue:
+        return DriverQueue()
+
+    def shutdown(self) -> None:
+        for a in self._actors:
+            try:
+                a.kill()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass
+        self._actors.clear()
+
+
+class _RayActorAdapter:
+    """Wraps a Ray actor handle behind the :class:`ProcessActor` surface."""
+
+    def __init__(self, handle, name: str):
+        self._handle = handle
+        self.name = name
+
+    def submit(self, fn: Callable, *args: Any, **kwargs: Any):
+        ref = self._handle.execute.remote(fn, *args, **kwargs)
+        return _RayFutureAdapter(ref)
+
+    def execute(self, fn: Callable, *args: Any, **kwargs: Any) -> Any:
+        import ray
+
+        return ray.get(self._handle.execute.remote(fn, *args, **kwargs))
+
+    def set_env_vars(self, env: Dict[str, str]) -> None:
+        from .actor import _remote_set_env_vars
+
+        self.execute(_remote_set_env_vars, env)
+
+    def get_node_ip(self) -> str:
+        from .actor import _remote_get_node_ip
+
+        return self.execute(_remote_get_node_ip)
+
+    def get_device_info(self) -> Dict[str, Any]:
+        from .actor import _remote_get_device_info
+
+        return self.execute(_remote_get_device_info)
+
+    def is_alive(self) -> bool:
+        return True
+
+    def kill(self, timeout: float = 5.0) -> None:
+        import ray
+
+        ray.kill(self._handle, no_restart=True)
+
+
+class _RayFutureAdapter:
+    """Duck-typed ``concurrent.futures.Future`` over a Ray object ref."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        import ray
+
+        return ray.get(self._ref, timeout=timeout)
+
+    def done(self) -> bool:
+        import ray
+
+        ready, _ = ray.wait([self._ref], timeout=0)
+        return bool(ready)
+
+    def exception(self, timeout: Optional[float] = None):
+        try:
+            self.result(timeout=timeout)
+            return None
+        except Exception as e:  # noqa: BLE001
+            return e
+
+
+class RayBackend(ClusterBackend):
+    """Real-Ray control plane, used only when Ray is installed.
+
+    Actors are reserved with custom resources so the scheduler pins one
+    actor per TPU host (e.g. ``resources={"TPU": 4}``) — the analogue of
+    GPU reservations at reference ``ray_ddp.py:183-189``.
+    """
+
+    def __init__(self):
+        import ray
+
+        if not ray.is_initialized():
+            ray.init()  # ≙ reference ray_ddp.py:125-126
+        self._ray = ray
+        self._actors: List[_RayActorAdapter] = []
+
+    def create_actor(
+        self,
+        name: str,
+        env: Optional[Dict[str, str]] = None,
+        num_cpus: float = 1,
+        resources: Optional[Dict[str, float]] = None,
+    ) -> _RayActorAdapter:
+        ray = self._ray
+
+        @ray.remote
+        class _Shell:
+            def execute(self, fn, *args, **kwargs):
+                return fn(*args, **kwargs)
+
+        # runtime_env starts the worker process WITH the env in place —
+        # import-time vars (JAX_PLATFORMS/XLA_FLAGS/TPU_VISIBLE_CHIPS) must
+        # be set before the worker's first jax import, matching
+        # ProcessActor's pre-exec semantics.
+        handle = _Shell.options(
+            num_cpus=num_cpus,
+            resources=resources or None,
+            name=name,
+            runtime_env={"env_vars": {k: str(v) for k, v in (env or {}).items()}},
+        ).remote()
+        adapter = _RayActorAdapter(handle, name)
+        self._actors.append(adapter)
+        return adapter
+
+    def put(self, obj: Any) -> ObjectRef:
+        # Keep by-value semantics for interface uniformity; Ray's own object
+        # store is still used for the RPC arguments themselves.
+        return ObjectRef.from_object(obj)
+
+    def create_queue(self) -> DriverQueue:
+        return DriverQueue(host="0.0.0.0", advertise_host=rpc.get_node_ip())
+
+    def shutdown(self) -> None:
+        for a in self._actors:
+            try:
+                a.kill()
+            except Exception:  # noqa: BLE001
+                pass
+        self._actors.clear()
+
+
+def get_backend(name: Optional[str] = None) -> ClusterBackend:
+    """Select the control plane.
+
+    Priority: explicit ``name`` > ``RLT_BACKEND`` env var > ``local``.
+    ``name="ray"`` requires Ray to be installed.
+    """
+    name = name or os.environ.get("RLT_BACKEND", "local")
+    if name == "ray":
+        if not ray_is_available():
+            raise ImportError(
+                "RLT_BACKEND=ray requested but Ray is not installed; "
+                "falling back is disabled to avoid silent behavior changes."
+            )
+        return RayBackend()
+    if name == "local":
+        return LocalBackend()
+    raise ValueError(f"Unknown cluster backend {name!r} (expected local|ray)")

@@ -1,0 +1,136 @@
+"""Distributed queue: worker → driver streaming channel.
+
+TPU-native analogue of ``ray.util.queue.Queue`` as used by the reference
+(``/root/reference/ray_lightning/ray_ddp.py:344-347`` creates it driver-side
+and ships the handle to every worker; workers ``put`` thunks/metrics from
+inside the fit loop, the driver drains them while polling futures,
+``util.py:47-68``).
+
+Implementation: the *server* lives in the driver process — an accept loop on
+a TCP socket feeding a thread-safe in-memory queue.  The *handle*
+(:class:`QueueHandle`) is a picklable ``(host, port)`` pair; any worker on
+any host can connect and push cloudpickled items.  TCP (not a pipe) so the
+same mechanism works across hosts of a TPU pod, exactly like Ray's
+actor-backed queue works across a cluster.
+"""
+
+from __future__ import annotations
+
+import queue as _pyqueue
+import socket
+import threading
+from typing import Any, Optional
+
+from . import rpc
+
+__all__ = ["DriverQueue", "QueueHandle"]
+
+
+class QueueHandle:
+    """Picklable client handle to a :class:`DriverQueue`.
+
+    One persistent connection per process, lazily opened on first ``put``.
+    """
+
+    def __init__(self, host: str, port: int):
+        self.host = host
+        self.port = port
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    # -- pickling: drop the live socket -------------------------------------
+    def __getstate__(self):
+        return {"host": self.host, "port": self.port}
+
+    def __setstate__(self, state):
+        self.host = state["host"]
+        self.port = state["port"]
+        self._sock = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        if self._sock is None:
+            s = socket.create_connection((self.host, self.port), timeout=60)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._sock = s
+        return self._sock
+
+    def put(self, item: Any) -> None:
+        """Ship ``item`` to the driver (reference ``session.py:61-63``)."""
+        payload = rpc.dumps(item)
+        with self._lock:
+            try:
+                rpc.send_frame(self._connect(), payload)
+            except (OSError, ConnectionError):
+                # One reconnect attempt — the driver may have restarted the
+                # accept loop between epochs.
+                self.close()
+                rpc.send_frame(self._connect(), payload)
+
+    def close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
+
+class DriverQueue:
+    """Driver-side queue server (≙ ``ray.util.queue.Queue`` actor)."""
+
+    def __init__(self, host: str = "127.0.0.1", advertise_host: Optional[str] = None):
+        self._items: _pyqueue.Queue = _pyqueue.Queue()
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host, 0))
+        self._server.listen(128)
+        self._port = self._server.getsockname()[1]
+        self._advertise_host = advertise_host or host
+        self._closed = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="rlt-queue-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    @property
+    def handle(self) -> QueueHandle:
+        return QueueHandle(self._advertise_host, self._port)
+
+    # -- server side --------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return  # listener closed
+            t = threading.Thread(
+                target=self._reader_loop, args=(conn,), daemon=True
+            )
+            t.start()
+
+    def _reader_loop(self, conn: socket.socket) -> None:
+        try:
+            while not self._closed.is_set():
+                frame = rpc.recv_frame(conn)
+                self._items.put(rpc.loads(frame))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    # -- driver consumption (reference util.py:47-52) -----------------------
+    def empty(self) -> bool:
+        return self._items.empty()
+
+    def get_nowait(self) -> Any:
+        return self._items.get_nowait()
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        return self._items.get(timeout=timeout)
+
+    def shutdown(self) -> None:
+        self._closed.set()
+        try:
+            self._server.close()
+        except OSError:
+            pass

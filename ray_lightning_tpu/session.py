@@ -1,0 +1,112 @@
+"""Per-worker session context.
+
+TPU-native analogue of the reference's worker session singleton
+(``/root/reference/ray_lightning/session.py:1-63``).  Each worker process
+(one per TPU host) holds a process-global session exposing:
+
+* ``rank`` — the worker/host rank assigned by the driver;
+* ``queue`` — a handle to the driver-side distributed queue, used by
+  callbacks running deep inside the fit loop (e.g. Tune report callbacks) to
+  ship thunks/metrics back to the driver mid-training;
+* TPU extras the reference had no need for: the ``mesh`` the host
+  participates in and its local device list.
+
+The session is deliberately a module-level singleton (reference
+``session.py:27-36``): callbacks fire many frames below the strategy and
+cannot thread a context object through Lightning-shaped hook signatures.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+__all__ = [
+    "TpuTrainingSession",
+    "init_session",
+    "get_session",
+    "shutdown_session",
+    "is_session_enabled",
+    "get_actor_rank",
+    "put_queue",
+]
+
+
+class TpuTrainingSession:
+    """Worker-side context for one training run on one host actor."""
+
+    def __init__(
+        self,
+        rank: int,
+        queue: Optional[Any] = None,
+        num_workers: int = 1,
+        local_devices: Optional[list] = None,
+        mesh: Optional[Any] = None,
+    ):
+        self.rank = rank
+        self.queue = queue
+        self.num_workers = num_workers
+        self.local_devices = local_devices or []
+        self.mesh = mesh
+
+    def put_queue(self, item: Any) -> None:
+        """Ship ``item`` (often a cloudpickled thunk) to the driver.
+
+        Reference parity: ``session.py:20-24`` — items are drained by the
+        driver's result pump (:func:`ray_lightning_tpu.util.process_results`)
+        and, if callable, executed in driver context.
+        """
+        if self.queue is None:
+            raise ValueError(
+                "No queue is attached to this session. A queue is created "
+                "only when the driver enables streaming (Tune session or "
+                "metrics streaming)."
+            )
+        self.queue.put(item)
+
+
+_session_lock = threading.Lock()
+_session: Optional[TpuTrainingSession] = None
+
+
+def init_session(*args, **kwargs) -> TpuTrainingSession:
+    """Install the process-global session (reference ``session.py:30-36``)."""
+    global _session
+    with _session_lock:
+        if _session is not None:
+            raise ValueError(
+                "A TpuTrainingSession is already active in this process. "
+                "Call shutdown_session() first."
+            )
+        _session = TpuTrainingSession(*args, **kwargs)
+        return _session
+
+
+def get_session() -> TpuTrainingSession:
+    """Reference ``session.py:39-53``."""
+    if _session is None:
+        raise ValueError(
+            "No TpuTrainingSession is active. init_session() is called by "
+            "the strategy on each worker before the fit loop starts."
+        )
+    return _session
+
+
+def is_session_enabled() -> bool:
+    return _session is not None
+
+
+def shutdown_session() -> None:
+    global _session
+    with _session_lock:
+        _session = None
+
+
+def get_actor_rank() -> int:
+    """Rank of the calling worker (reference ``session.py:56-58``)."""
+    return get_session().rank
+
+
+def put_queue(item: Any) -> None:
+    """Module-level convenience (reference ``session.py:61-63``)."""
+    get_session().put_queue(item)

@@ -1,0 +1,72 @@
+"""Driver-side utilities: the result pump.
+
+TPU-native analogue of ``/root/reference/ray_lightning/util.py:47-68``.
+While worker actors run the fit loop, the driver sits in
+:func:`process_results`, interleaving two duties:
+
+1. drain the distributed queue — items are either plain metric payloads or
+   **thunks** (cloudpickled callables) that must execute *in driver
+   context* (the Tune-report indirection, reference ``tune.py:130-134``:
+   ``tune.report`` only works inside the Tune session process);
+2. poll worker futures so a worker crash surfaces immediately as an
+   exception instead of a hang (reference ``util.py:55-68``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, List, Optional, Sequence
+
+from .cluster.queue import DriverQueue
+
+__all__ = ["process_results", "handle_queue_item"]
+
+
+def handle_queue_item(item: Any) -> Any:
+    """Execute a queue item in driver context (reference ``util.py:47-52``)."""
+    if callable(item):
+        return item()
+    return item
+
+
+def _drain_queue(queue: Optional[DriverQueue], on_item: Optional[Callable]) -> None:
+    if queue is None:
+        return
+    while not queue.empty():
+        item = queue.get_nowait()
+        result = handle_queue_item(item)
+        if on_item is not None and not callable(item):
+            on_item(result)
+
+
+def process_results(
+    futures: Sequence[Any],
+    queue: Optional[DriverQueue] = None,
+    poll_interval_s: float = 0.1,
+    on_item: Optional[Callable[[Any], None]] = None,
+) -> List[Any]:
+    """Block until all worker futures resolve, pumping the queue meanwhile.
+
+    Raises the first worker exception encountered (fail-fast, matching the
+    reference where ``ray.get`` re-raises worker errors and crashes fit —
+    SURVEY §5 "failure detection").  Before raising, the queue is drained a
+    final time so late metrics/thunks are not lost.
+    """
+    futures = list(futures)
+    while True:
+        _drain_queue(queue, on_item)
+        done = [f for f in futures if f.done()]
+        # Fail fast: one dead worker must raise immediately — its peers may
+        # be blocked inside a collective waiting for it and will never
+        # finish (reference raises from ray.get inside the poll loop,
+        # util.py:55-63).
+        for f in done:
+            exc = f.exception()
+            if exc is not None:
+                _drain_queue(queue, on_item)
+                raise exc
+        if len(done) == len(futures):
+            break
+        time.sleep(poll_interval_s)
+    _drain_queue(queue, on_item)
+    return [f.result() for f in futures]

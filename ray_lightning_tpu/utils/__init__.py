@@ -1,0 +1,16 @@
+from .unavailable import Unavailable, make_unavailable
+from .state_stream import (
+    to_state_stream,
+    load_state_stream,
+    tree_to_bytes,
+    tree_from_bytes,
+)
+
+__all__ = [
+    "Unavailable",
+    "make_unavailable",
+    "to_state_stream",
+    "load_state_stream",
+    "tree_to_bytes",
+    "tree_from_bytes",
+]

@@ -1,0 +1,43 @@
+"""Sentinel for optional dependencies.
+
+TPU-native analogue of the reference's ``Unavailable`` placeholder
+(``/root/reference/ray_lightning/util.py:40-44``): a class that can be
+referenced at import time but raises with a helpful message the moment a user
+tries to instantiate (or otherwise use) it.  Used to gate optional
+integrations — real Ray, Ray Tune, torch — so the framework degrades
+gracefully when they are absent (the reference's CI exercises exactly this,
+``.github/workflows/test.yaml:196-225``).
+"""
+
+from __future__ import annotations
+
+
+class Unavailable:
+    """Stand-in for an optional dependency that is not installed."""
+
+    #: Subclasses/instances may override with the missing requirement name.
+    _missing_requirement: str = "an optional dependency"
+
+    def __init__(self, *args, **kwargs):
+        raise ImportError(
+            f"This feature requires {self._missing_requirement}, which is not "
+            "installed in this environment."
+        )
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+
+    def __getattr__(self, item):  # pragma: no cover - defensive
+        raise ImportError(
+            f"This feature requires {self._missing_requirement}, which is not "
+            "installed in this environment."
+        )
+
+
+def make_unavailable(requirement: str) -> type:
+    """Create an ``Unavailable`` subclass naming the missing requirement."""
+    return type(
+        f"Unavailable[{requirement}]",
+        (Unavailable,),
+        {"_missing_requirement": requirement},
+    )

@@ -1,0 +1,53 @@
+"""Session singleton tests (≙ reference session.py semantics)."""
+
+import pytest
+
+from ray_lightning_tpu import session as S
+
+
+@pytest.fixture(autouse=True)
+def _clean_session():
+    S.shutdown_session()
+    yield
+    S.shutdown_session()
+
+
+def test_init_get_shutdown():
+    assert not S.is_session_enabled()
+    sess = S.init_session(rank=3, queue=None, num_workers=4)
+    assert S.is_session_enabled()
+    assert S.get_session() is sess
+    assert S.get_actor_rank() == 3
+    S.shutdown_session()
+    assert not S.is_session_enabled()
+
+
+def test_double_init_raises():
+    S.init_session(rank=0)
+    with pytest.raises(ValueError, match="already active"):
+        S.init_session(rank=1)
+
+
+def test_get_without_init_raises():
+    with pytest.raises(ValueError, match="No TpuTrainingSession"):
+        S.get_session()
+
+
+def test_put_queue_without_queue_raises():
+    S.init_session(rank=0, queue=None)
+    with pytest.raises(ValueError, match="No queue"):
+        S.put_queue({"x": 1})
+
+
+def test_put_queue_forwards():
+    class FakeQueue:
+        def __init__(self):
+            self.items = []
+
+        def put(self, item):
+            self.items.append(item)
+
+    q = FakeQueue()
+    S.init_session(rank=0, queue=q)
+    S.put_queue({"loss": 0.5})
+    assert q.items == [{"loss": 0.5}]
