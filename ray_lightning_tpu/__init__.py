@@ -38,9 +38,16 @@ from ray_lightning_tpu.utils import (
 
 __version__ = "0.1.0"
 
-# NOTE: strategy/trainer names are appended to __all__ lazily below once
-# their modules exist; keeping them out until then makes star-imports safe.
 __all__ = [
+    "RayStrategy",
+    "HorovodRayStrategy",
+    "RayShardedStrategy",
+    "RayPlugin",
+    "HorovodRayPlugin",
+    "RayShardedPlugin",
+    "LocalStrategy",
+    "Trainer",
+    "TpuModule",
     "get_actor_rank",
     "get_session",
     "init_session",
@@ -54,10 +61,21 @@ __all__ = [
 ]
 
 
+_STRATEGY_NAMES = (
+    "RayStrategy",
+    "HorovodRayStrategy",
+    "RayShardedStrategy",
+    "RayPlugin",
+    "HorovodRayPlugin",
+    "RayShardedPlugin",
+    "LocalStrategy",
+)
+
+
 def __getattr__(name):
     # Lazy imports keep `import ray_lightning_tpu` light (no jax tracing
     # machinery touched until a strategy/trainer is actually used).
-    if name in ("RayStrategy", "HorovodRayStrategy", "RayShardedStrategy"):
+    if name in _STRATEGY_NAMES:
         from ray_lightning_tpu.parallel import strategies
 
         return getattr(strategies, name)

@@ -1,0 +1,25 @@
+from .module import TpuModule, TrainState
+from .data import TpuDataModule, ArrayDataset, NumpyLoader, RandomDataset
+from .callbacks import (
+    Callback,
+    ModelCheckpoint,
+    EarlyStopping,
+    DeviceStatsCallback,
+)
+from .loop import FitConfig
+from .trainer import Trainer
+
+__all__ = [
+    "TpuModule",
+    "TrainState",
+    "TpuDataModule",
+    "ArrayDataset",
+    "NumpyLoader",
+    "RandomDataset",
+    "Callback",
+    "ModelCheckpoint",
+    "EarlyStopping",
+    "DeviceStatsCallback",
+    "FitConfig",
+    "Trainer",
+]

@@ -1,0 +1,263 @@
+"""Callback system — hooks firing inside the worker-side fit loop.
+
+≙ Lightning callbacks as the reference uses them: callbacks travel pickled
+with the trainer to workers and fire deep inside the remote fit loop
+(reference ships ``TuneReportCallback`` this way, ``tune.py:59-134``; tests
+assert sampler/device placement via callbacks, ``test_ddp.py:179-211``).
+The ``trainer`` argument every hook receives is the **worker-side loop
+context** (:class:`ray_lightning_tpu.core.loop.LoopContext`) — a duck-typed
+subset of the driver Trainer (rank, metrics, state, should_stop).
+
+Rank-zero file I/O discipline: on a multi-host mesh all hosts run the same
+loop; only ``trainer.is_global_zero`` writes checkpoints (the reference
+gets this from Lightning's rank_zero machinery, ``ray_ddp.py:420``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+__all__ = [
+    "Callback",
+    "ModelCheckpoint",
+    "EarlyStopping",
+    "DeviceStatsCallback",
+]
+
+
+class Callback:
+    """Base callback: override any subset of hooks."""
+
+    def setup(self, trainer, module, stage: str) -> None: ...
+
+    def on_fit_start(self, trainer, module) -> None: ...
+
+    def on_train_epoch_start(self, trainer, module) -> None: ...
+
+    def on_train_batch_end(
+        self, trainer, module, logs: Dict[str, float], batch_idx: int
+    ) -> None: ...
+
+    def on_train_epoch_end(self, trainer, module) -> None: ...
+
+    def on_validation_epoch_end(self, trainer, module) -> None: ...
+
+    def on_fit_end(self, trainer, module) -> None: ...
+
+    def teardown(self, trainer, module, stage: str) -> None: ...
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None: ...
+
+
+class ModelCheckpoint(Callback):
+    """Save state streams to disk, tracking the best by a monitored metric.
+
+    ≙ Lightning's ``ModelCheckpoint`` as the reference relies on it:
+    writes happen on workers, and worker-0's ``best_model_path`` is adopted
+    by the driver post-fit (reference ``ray_ddp.py:393-395``).  Checkpoints
+    are topology-independent state streams (host-gathered pytrees), so a
+    run may resume with a different worker count
+    (≙ ``test_ddp_sharded.py:119-138``).
+    """
+
+    def __init__(
+        self,
+        dirpath: Optional[str] = None,
+        filename: str = "epoch={epoch}-step={step}",
+        monitor: Optional[str] = None,
+        mode: str = "min",
+        save_top_k: int = 1,
+        every_n_epochs: int = 1,
+    ):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be min|max, got {mode!r}")
+        self.dirpath = dirpath
+        self.filename = filename
+        self.monitor = monitor
+        self.mode = mode
+        self.save_top_k = save_top_k
+        self.every_n_epochs = every_n_epochs
+        self.best_model_path: str = ""
+        self.best_model_score: Optional[float] = None
+        self._saved: list = []  # [(score, path)]
+
+    def setup(self, trainer, module, stage: str) -> None:
+        if self.dirpath is None:
+            self.dirpath = os.path.join(trainer.default_root_dir, "checkpoints")
+
+    def _score(self, metrics: Dict[str, float]) -> Optional[float]:
+        if self.monitor is None:
+            return None
+        value = metrics.get(self.monitor)
+        return None if value is None else float(value)
+
+    def _is_better(self, score: float) -> bool:
+        if self.best_model_score is None:
+            return True
+        return (
+            score < self.best_model_score
+            if self.mode == "min"
+            else score > self.best_model_score
+        )
+
+    def on_train_epoch_end(self, trainer, module) -> None:
+        epoch = trainer.current_epoch
+        if (epoch + 1) % self.every_n_epochs != 0:
+            return
+        if not trainer.is_global_zero:
+            return
+        metrics = trainer.callback_metrics
+        score = self._score(metrics)
+        if self.monitor is not None and score is None:
+            return  # monitored metric not produced this epoch
+        os.makedirs(self.dirpath, exist_ok=True)
+        name = self.filename.format(epoch=epoch, step=trainer.global_step)
+        path = os.path.join(self.dirpath, name + ".ckpt")
+        trainer.save_checkpoint(path)
+        if score is None:
+            # monitor=None ⇒ Lightning semantics: "best" is simply the most
+            # recent; rank saves by recency (global_step, mode=max) so
+            # _prune keeps the latest k, not a stale early file.
+            self.best_model_path = path
+            self._saved.append((float(trainer.global_step), path))
+            self._prune(force_mode="max")
+            return
+        if self._is_better(score):
+            self.best_model_score = score
+            self.best_model_path = path
+        self._saved.append((score, path))
+        self._prune()
+
+    def _prune(self, force_mode: Optional[str] = None) -> None:
+        if self.save_top_k < 0 or len(self._saved) <= self.save_top_k:
+            return
+        reverse = (force_mode or self.mode) == "max"
+        ranked = sorted(self._saved, key=lambda t: t[0], reverse=reverse)
+        keep = set(p for _, p in ranked[: self.save_top_k])
+        keep.add(self.best_model_path)
+        for score, path in list(self._saved):
+            if path not in keep and os.path.exists(path):
+                os.remove(path)
+        self._saved = [(s, p) for s, p in self._saved if p in keep]
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "best_model_path": self.best_model_path,
+            "best_model_score": self.best_model_score,
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.best_model_path = state.get("best_model_path", "")
+        self.best_model_score = state.get("best_model_score")
+
+
+class EarlyStopping(Callback):
+    """Stop training when a monitored metric stops improving.
+
+    ≙ Lightning ``EarlyStopping`` as exercised by reference
+    ``test_ddp.py:289-308``.  Decision consistency across hosts: metrics
+    are mesh-global (all-reduced inside the step functions), so every host
+    reaches the same verdict on the same epoch — no extra broadcast needed.
+    """
+
+    def __init__(
+        self,
+        monitor: str = "val_loss",
+        mode: str = "min",
+        patience: int = 3,
+        min_delta: float = 0.0,
+    ):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be min|max, got {mode!r}")
+        self.monitor = monitor
+        self.mode = mode
+        self.patience = patience
+        self.min_delta = min_delta
+        self.best: Optional[float] = None
+        self.wait = 0
+        self.stopped_epoch: Optional[int] = None
+
+    def _improved(self, value: float) -> bool:
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return value < self.best - self.min_delta
+        return value > self.best + self.min_delta
+
+    def on_validation_epoch_end(self, trainer, module) -> None:
+        value = trainer.callback_metrics.get(self.monitor)
+        if value is None:
+            return
+        value = float(value)
+        if self._improved(value):
+            self.best = value
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                trainer.should_stop = True
+                self.stopped_epoch = trainer.current_epoch
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"best": self.best, "wait": self.wait}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.best = state.get("best")
+        self.wait = state.get("wait", 0)
+
+
+class DeviceStatsCallback(Callback):
+    """Per-epoch wall time + device memory stats, mesh-averaged.
+
+    TPU-native analogue of the reference's ``CUDACallback`` benchmark
+    harness (``examples/ray_ddp_sharded_example.py:16-45``): epoch time and
+    peak device memory, averaged across workers.  Uses
+    ``jax.local_devices()[0].memory_stats()`` (populated on TPU; absent on
+    the CPU test backend, where it degrades to wall-time only).
+    """
+
+    def __init__(self, log: bool = True):
+        self.log = log
+        self.epoch_times: list = []
+        self.peak_memories: list = []
+        self._t0 = 0.0
+
+    def on_train_epoch_start(self, trainer, module) -> None:
+        self._t0 = time.perf_counter()
+
+    def on_train_epoch_end(self, trainer, module) -> None:
+        dt = time.perf_counter() - self._t0
+        self.epoch_times.append(dt)
+        peak = None
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats()
+            if stats:
+                peak = stats.get("peak_bytes_in_use")
+        except Exception:  # noqa: BLE001 - stats are best-effort
+            peak = None
+        if peak is not None:
+            self.peak_memories.append(peak)
+        trainer.log_metrics({"epoch_time_s": dt})
+        if self.log and trainer.is_global_zero:
+            mem = f", peak_mem={peak / 2**20:.0f}MiB" if peak else ""
+            print(
+                f"[rlt] epoch {trainer.current_epoch}: {dt:.2f}s{mem}",
+                flush=True,
+            )
+
+    def summary(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        if self.epoch_times:
+            out["avg_epoch_time_s"] = float(np.mean(self.epoch_times))
+        if self.peak_memories:
+            out["avg_peak_memory_bytes"] = float(np.mean(self.peak_memories))
+        return out

@@ -1,0 +1,170 @@
+"""Data pipeline: datamodule protocol + numpy loaders with host sharding.
+
+≙ the reference's reliance on torch ``DataLoader`` + ``DistributedSampler``
+(sampler kwargs injected at reference ``ray_ddp.py:556-561``, asserted by
+``test_ddp.py:179-211``).  TPU-idiomatic replacement: data never flows
+through the control plane — each host loads/synthesizes its own **shard of
+every global batch** (`shard_index = host_rank`, `num_shards = num_hosts`),
+and the strategy turns per-host arrays into globally-sharded
+``jax.Array``s via ``make_array_from_process_local_data``.
+
+Loaders yield numpy (host) batches; device transfer is the strategy's job
+so it can attach the right ``NamedSharding``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "TpuDataModule",
+    "ArrayDataset",
+    "NumpyLoader",
+    "RandomDataset",
+]
+
+
+class TpuDataModule:
+    """≙ ``pl.LightningDataModule`` (used by reference examples/tests).
+
+    Subclasses override the ``*_dataloader`` methods to return a
+    :class:`NumpyLoader` (or any iterable of numpy-batch pytrees).  The
+    strategy calls :meth:`set_shard` before ``setup`` so loaders can shard
+    per host (the ``DistributedSampler`` analogue).
+    """
+
+    def __init__(self):
+        self.shard_index: int = 0
+        self.num_shards: int = 1
+
+    def set_shard(self, shard_index: int, num_shards: int) -> None:
+        self.shard_index = shard_index
+        self.num_shards = num_shards
+
+    def prepare_data(self) -> None:
+        """Download/once-per-node work (≙ the init_hook FileLock pattern,
+        reference ``examples/ray_ddp_tune.py:22-25``)."""
+
+    def setup(self, stage: str) -> None:
+        ...
+
+    def train_dataloader(self):
+        raise NotImplementedError
+
+    def val_dataloader(self):
+        return None
+
+    def test_dataloader(self):
+        return None
+
+    def predict_dataloader(self):
+        return None
+
+    def teardown(self, stage: str) -> None:
+        ...
+
+
+class ArrayDataset:
+    """A dataset over aligned numpy arrays (features, labels, ...)."""
+
+    def __init__(self, **arrays: np.ndarray):
+        sizes = {k: len(v) for k, v in arrays.items()}
+        if len(set(sizes.values())) > 1:
+            raise ValueError(f"Array length mismatch: {sizes}")
+        self.arrays = {k: np.asarray(v) for k, v in arrays.items()}
+        self.size = next(iter(sizes.values())) if sizes else 0
+
+    def __len__(self) -> int:
+        return self.size
+
+    def take(self, idx: np.ndarray) -> Dict[str, np.ndarray]:
+        return {k: v[idx] for k, v in self.arrays.items()}
+
+
+class RandomDataset(ArrayDataset):
+    """Synthetic regression data (≙ reference ``tests/utils.py:16-25``)."""
+
+    def __init__(self, size: int = 32, length: int = 64, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        super().__init__(x=rng.standard_normal((length, size), dtype=np.float32))
+
+
+class NumpyLoader:
+    """Batched iterator over an :class:`ArrayDataset` with host sharding.
+
+    The global batch of size ``batch_size`` is split into ``num_shards``
+    host shards; this loader yields THIS host's ``batch_size //
+    num_shards`` examples per step, with a shuffle order derived from
+    ``seed + epoch`` that is identical on every host (so shards never
+    overlap — the ``DistributedSampler`` contract).
+
+    ``drop_last=True`` semantics by default: a ragged final global batch is
+    dropped, keeping shapes static for XLA (dynamic shapes would recompile
+    every tail batch — SURVEY "XLA semantics").
+    """
+
+    def __init__(
+        self,
+        dataset: ArrayDataset,
+        batch_size: int = 32,
+        shuffle: bool = False,
+        seed: int = 0,
+        shard_index: int = 0,
+        num_shards: int = 1,
+        drop_last: bool = True,
+    ):
+        if batch_size % num_shards != 0:
+            raise ValueError(
+                f"Global batch_size {batch_size} must divide evenly over "
+                f"{num_shards} host shards."
+            )
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.shard_index = shard_index
+        self.num_shards = num_shards
+        self.drop_last = drop_last
+        self.epoch = 0
+
+    def set_shard(self, shard_index: int, num_shards: int) -> None:
+        if self.batch_size % num_shards != 0:
+            raise ValueError(
+                f"Global batch_size {self.batch_size} must divide evenly "
+                f"over {num_shards} host shards."
+            )
+        self.shard_index = shard_index
+        self.num_shards = num_shards
+
+    def set_epoch(self, epoch: int) -> None:
+        """≙ ``DistributedSampler.set_epoch`` — reshuffle per epoch."""
+        self.epoch = epoch
+
+    @property
+    def per_host_batch_size(self) -> int:
+        return self.batch_size // self.num_shards
+
+    def __len__(self) -> int:
+        n = len(self.dataset) // self.batch_size
+        if not self.drop_last and len(self.dataset) % self.batch_size:
+            n += 1
+        return n
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        n = len(self.dataset)
+        if self.shuffle:
+            order = np.random.default_rng(self.seed + self.epoch).permutation(n)
+        else:
+            order = np.arange(n)
+        num_batches = len(self)
+        for b in range(num_batches):
+            start = b * self.batch_size
+            global_idx = order[start : start + self.batch_size]
+            # This host's contiguous slice of the global batch.
+            per = len(global_idx) // self.num_shards
+            lo = self.shard_index * per
+            shard_idx = global_idx[lo : lo + per]
+            yield self.dataset.take(shard_idx)

@@ -1,0 +1,171 @@
+"""TpuModule — the Lightning-shaped, JAX-native module protocol.
+
+The reference keeps the user surface an unmodified ``LightningModule``
+(``/root/reference/README.md:50-62``).  A torch module cannot execute under
+XLA/pjit, so this framework defines a *LightningModule-shaped protocol*
+written in JAX (SURVEY §7 "hard parts" #1, option (a)): same hook names and
+division of responsibility — the module owns model math and optimizer
+choice, the Trainer/strategy owns distribution — but every step method is a
+**pure function of (params, batch, rng)** so the strategy can ``jax.jit``
+/ ``shard_map`` it over a device mesh.
+
+Key contracts:
+
+* ``init_params(rng)`` must be deterministic in ``rng`` — workers
+  initialize locally from a broadcast seed instead of receiving traced
+  objects over the wire (≙ ``PL_GLOBAL_SEED`` broadcast, reference
+  ``ray_ddp.py:223``).
+* ``training_step`` returns ``(loss, logs)``; the strategy differentiates
+  it, so it must be traceable (no Python side effects on the hot path; use
+  ``logs`` for metrics).
+* The module object itself must be cloudpickle-able: it is shipped
+  driver → workers through the object store (≙ ``ray.put(model)``,
+  reference ``ray_ddp.py:339-342``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["TpuModule", "TrainState"]
+
+Logs = Dict[str, jax.Array]
+
+
+@jax.tree_util.register_pytree_node_class
+class TrainState:
+    """Minimal training state pytree: params + optimizer state + step.
+
+    Unlike flax's ``TrainState`` it carries **no static function fields**
+    (``apply_fn``/``tx``) — the optimizer transformation lives in the
+    strategy, so the whole state is a pure array pytree that can be
+    sharded, donated, state-streamed and checkpointed without special
+    casing (the property behind topology-independent checkpoints,
+    SURVEY §7 hard-part #4).
+    """
+
+    def __init__(self, params: Any, opt_state: Any, step: jax.Array):
+        self.params = params
+        self.opt_state = opt_state
+        self.step = step
+
+    def tree_flatten(self):
+        return (self.params, self.opt_state, self.step), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @classmethod
+    def create(cls, params: Any, tx) -> "TrainState":
+        return cls(
+            params=params,
+            opt_state=tx.init(params),
+            step=jnp.zeros((), dtype=jnp.int32),
+        )
+
+    def apply_gradients(self, grads: Any, tx) -> "TrainState":
+        updates, new_opt_state = tx.update(grads, self.opt_state, self.params)
+        import optax
+
+        new_params = optax.apply_updates(self.params, updates)
+        return TrainState(new_params, new_opt_state, self.step + 1)
+
+    def __repr__(self):
+        n = sum(
+            x.size for x in jax.tree_util.tree_leaves(self.params)
+            if hasattr(x, "size")
+        )
+        return f"TrainState(step={self.step}, params={n} elems)"
+
+
+class TpuModule:
+    """Base class for user models (≙ ``pl.LightningModule``).
+
+    Subclasses implement::
+
+        class MyModel(TpuModule):
+            def __init__(self, hidden=128):
+                super().__init__()
+                self.save_hyperparameters(hidden=hidden)
+
+            def init_params(self, rng):
+                ...  # build the initial param pytree (e.g. flax init)
+
+            def training_step(self, params, batch, rng):
+                loss = ...
+                return loss, {"train_loss": loss}
+
+            def validation_step(self, params, batch):
+                return {"val_loss": ...}
+
+            def configure_optimizers(self):
+                return optax.adam(1e-3)
+    """
+
+    def __init__(self):
+        self.hparams: Dict[str, Any] = {}
+        self.trainer = None  # set by the loop (worker-side context)
+        self.precision: str = "f32"
+
+    # -- configuration ------------------------------------------------------
+    def save_hyperparameters(self, **kwargs: Any) -> None:
+        self.hparams.update(kwargs)
+
+    def configure_optimizers(self):
+        """Return an ``optax.GradientTransformation``.
+
+        ≙ ``LightningModule.configure_optimizers``; may also return a tuple
+        ``(tx, lr_schedule_fn)`` where the schedule is used for logging.
+        """
+        raise NotImplementedError
+
+    # -- model math (pure) --------------------------------------------------
+    def init_params(self, rng: jax.Array) -> Any:
+        """Deterministically build the initial parameter pytree."""
+        raise NotImplementedError
+
+    def training_step(
+        self, params: Any, batch: Any, rng: jax.Array
+    ) -> Tuple[jax.Array, Logs]:
+        """One forward+loss on one (per-device or global) batch shard.
+
+        Must be jax-traceable; the strategy wraps it in ``value_and_grad``
+        and inserts/relies-on the data-parallel mean (the analogue of DDP's
+        bucketed all-reduce, reference ``ray_ddp.py:483``).
+        """
+        raise NotImplementedError
+
+    def validation_step(self, params: Any, batch: Any) -> Logs:
+        raise NotImplementedError
+
+    def test_step(self, params: Any, batch: Any) -> Logs:
+        return self.validation_step(params, batch)
+
+    def predict_step(self, params: Any, batch: Any) -> Any:
+        raise NotImplementedError
+
+    # -- lifecycle hooks (run on workers, inside the fit loop) --------------
+    def setup(self, stage: str) -> None:
+        """Called on each worker before the loop ('fit'|'validate'|'test'|'predict')."""
+
+    def on_fit_start(self) -> None:
+        ...
+
+    def on_fit_end(self) -> None:
+        ...
+
+    def on_train_epoch_start(self, epoch: int) -> None:
+        ...
+
+    def on_train_epoch_end(self, epoch: int, metrics: Dict[str, float]) -> None:
+        ...
+
+    def on_validation_epoch_end(self, metrics: Dict[str, float]) -> None:
+        ...
+
+    def teardown(self, stage: str) -> None:
+        ...

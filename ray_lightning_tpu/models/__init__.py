@@ -1,0 +1,8 @@
+from .boring import BoringModel, BoringDataModule, XORModel, XORDataModule
+
+__all__ = [
+    "BoringModel",
+    "BoringDataModule",
+    "XORModel",
+    "XORDataModule",
+]

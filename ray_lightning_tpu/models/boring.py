@@ -1,0 +1,176 @@
+"""Fixture models (≙ reference ``tests/utils.py:16-210``), shipped in the
+package because they double as minimal usage examples.
+
+* :class:`BoringModel` ≙ reference ``BoringModel`` (``tests/utils.py:28-96``):
+  one linear layer over :class:`~ray_lightning_tpu.core.data.RandomDataset`,
+  loss drives outputs to zero — enough structure to verify that training
+  moves weights (``train_test``, ``tests/utils.py:236-245``).
+* :class:`XORModel` ≙ reference ``XORModel`` (``tests/utils.py:151-188``):
+  tiny MLP on the 4-point XOR table with an accuracy metric — enough to
+  verify convergence (``predict_test`` accuracy ≥ 0.5,
+  ``tests/utils.py:256-272``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_lightning_tpu.core.data import (
+    ArrayDataset,
+    NumpyLoader,
+    RandomDataset,
+    TpuDataModule,
+)
+from ray_lightning_tpu.core.module import TpuModule
+
+__all__ = ["BoringModel", "BoringDataModule", "XORModel", "XORDataModule"]
+
+
+class BoringModel(TpuModule):
+    def __init__(self, in_dim: int = 32, out_dim: int = 2, lr: float = 1e-1):
+        super().__init__()
+        self.save_hyperparameters(in_dim=in_dim, out_dim=out_dim, lr=lr)
+
+    def init_params(self, rng: jax.Array) -> Dict[str, Any]:
+        k_w, _ = jax.random.split(rng)
+        h = self.hparams
+        return {
+            "w": jax.random.normal(k_w, (h["in_dim"], h["out_dim"]))
+            * (1.0 / np.sqrt(h["in_dim"])),
+            "b": jnp.zeros((h["out_dim"],)),
+        }
+
+    def _forward(self, params, x):
+        return x @ params["w"] + params["b"]
+
+    def training_step(self, params, batch, rng):
+        out = self._forward(params, batch["x"])
+        loss = jnp.mean(out**2)
+        return loss, {"train_loss": loss}
+
+    def validation_step(self, params, batch):
+        out = self._forward(params, batch["x"])
+        return {"val_loss": jnp.mean(out**2)}
+
+    def predict_step(self, params, batch):
+        return self._forward(params, batch["x"])
+
+    def configure_optimizers(self):
+        return optax.sgd(self.hparams["lr"])
+
+
+class BoringDataModule(TpuDataModule):
+    def __init__(self, length: int = 64, batch_size: int = 16, in_dim: int = 32):
+        super().__init__()
+        self.length = length
+        self.batch_size = batch_size
+        self.in_dim = in_dim
+
+    def _loader(self, seed: int) -> NumpyLoader:
+        return NumpyLoader(
+            RandomDataset(size=self.in_dim, length=self.length, seed=seed),
+            batch_size=self.batch_size,
+            shard_index=self.shard_index,
+            num_shards=self.num_shards,
+        )
+
+    def train_dataloader(self):
+        return self._loader(seed=0)
+
+    def val_dataloader(self):
+        return self._loader(seed=1)
+
+    def test_dataloader(self):
+        return self._loader(seed=2)
+
+    def predict_dataloader(self):
+        return self._loader(seed=3)
+
+
+def _xor_table(batch_size: int) -> Dict[str, np.ndarray]:
+    """XOR truth table tiled to ``batch_size`` rows."""
+    x = np.array([[0, 0], [0, 1], [1, 0], [1, 1]], dtype=np.float32)
+    y = np.array([0, 1, 1, 0], dtype=np.int32)
+    reps = max(1, batch_size // 4)
+    return {
+        "x": np.tile(x, (reps, 1)),
+        "y": np.tile(y, reps),
+    }
+
+
+class XORModel(TpuModule):
+    def __init__(self, hidden: int = 8, lr: float = 0.1):
+        super().__init__()
+        self.save_hyperparameters(hidden=hidden, lr=lr)
+
+    def init_params(self, rng: jax.Array) -> Dict[str, Any]:
+        k1, k2 = jax.random.split(rng)
+        h = self.hparams["hidden"]
+        return {
+            "w1": jax.random.normal(k1, (2, h)) * 0.7,
+            "b1": jnp.zeros((h,)),
+            "w2": jax.random.normal(k2, (h, 2)) * 0.7,
+            "b2": jnp.zeros((2,)),
+        }
+
+    def _forward(self, params, x):
+        hidden = jnp.tanh(x @ params["w1"] + params["b1"])
+        return hidden @ params["w2"] + params["b2"]
+
+    def _loss_acc(self, params, batch):
+        logits = self._forward(params, batch["x"])
+        labels = batch["y"]
+        loss = jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        )
+        acc = jnp.mean((jnp.argmax(logits, -1) == labels).astype(jnp.float32))
+        return loss, acc
+
+    def training_step(self, params, batch, rng):
+        loss, acc = self._loss_acc(params, batch)
+        return loss, {"train_loss": loss, "train_acc": acc}
+
+    def validation_step(self, params, batch):
+        loss, acc = self._loss_acc(params, batch)
+        return {"val_loss": loss, "val_acc": acc}
+
+    def predict_step(self, params, batch):
+        return jnp.argmax(self._forward(params, batch["x"]), axis=-1)
+
+    def configure_optimizers(self):
+        return optax.adam(self.hparams["lr"])
+
+
+class XORDataModule(TpuDataModule):
+    """≙ reference ``XORDataModule`` (``tests/utils.py:191-210``)."""
+
+    def __init__(self, batch_size: int = 16, batches_per_epoch: int = 8):
+        super().__init__()
+        self.batch_size = batch_size
+        self.batches_per_epoch = batches_per_epoch
+
+    def _loader(self) -> NumpyLoader:
+        table = _xor_table(self.batch_size * self.batches_per_epoch)
+        return NumpyLoader(
+            ArrayDataset(**table),
+            batch_size=self.batch_size,
+            shard_index=self.shard_index,
+            num_shards=self.num_shards,
+        )
+
+    def train_dataloader(self):
+        return self._loader()
+
+    def val_dataloader(self):
+        return self._loader()
+
+    def test_dataloader(self):
+        return self._loader()
+
+    def predict_dataloader(self):
+        return self._loader()

@@ -1,0 +1,49 @@
+from .mesh import MeshSpec, build_mesh, bootstrap_distributed, compute_host_ranks
+from .sharding import (
+    batch_sharding,
+    make_global_batch,
+    replicated,
+    shard_leaf_spec,
+    zero_state_shardings,
+)
+
+__all__ = [
+    "MeshSpec",
+    "build_mesh",
+    "bootstrap_distributed",
+    "compute_host_ranks",
+    "batch_sharding",
+    "make_global_batch",
+    "replicated",
+    "shard_leaf_spec",
+    "zero_state_shardings",
+    "TpuStrategy",
+    "LocalStrategy",
+    "RayStrategy",
+    "HorovodRayStrategy",
+    "RayShardedStrategy",
+    "RayPlugin",
+    "HorovodRayPlugin",
+    "RayShardedPlugin",
+]
+
+_STRATEGY_NAMES = (
+    "TpuStrategy",
+    "LocalStrategy",
+    "RayStrategy",
+    "HorovodRayStrategy",
+    "RayShardedStrategy",
+    "RayPlugin",
+    "HorovodRayPlugin",
+    "RayShardedPlugin",
+)
+
+
+def __getattr__(name):
+    # Lazy: strategies imports the core loop, which imports this package's
+    # sharding module — an eager import here would be a cycle.
+    if name in _STRATEGY_NAMES:
+        from . import strategies
+
+        return getattr(strategies, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,201 @@
+"""Jitted step-function builders — the gradient-sync hot path.
+
+≙ the reference's entire L2 collective layer: where torch DDP wraps the
+model so backward triggers NCCL bucketed all-reduce (wrap at reference
+``ray_ddp.py:483``, backend init ``ray_ddp.py:430-433``), here the
+data-parallel mean **is part of the compiled program**:
+
+* **GSPMD flavor** (``mode="gspmd"``, ≙ ``RayPlugin``/DDP): the batch is
+  sharded over the ``data`` mesh axis, the loss is a mean over the global
+  batch, and ``jax.grad`` of that mean *is* the all-reduced gradient — XLA
+  inserts and schedules the collectives (overlapped with compute on ICI).
+  ZeRO sharding arrives purely via in/out shardings on the train state.
+
+* **shard_map flavor** (``mode="shard_map"``, ≙ ``HorovodRayPlugin``): the
+  per-device program is explicit SPMD — each device computes grads on its
+  shard and calls ``jax.lax.pmean`` (the ring-all-reduce analogue of
+  ``hvd.allreduce``, reference ``ray_horovod.py:196``).  Numerically
+  equivalent; exists as the second execution flavor and as the
+  explicitly-scheduled escape hatch.
+
+Both flavors donate the input state (buffers are reused in-place on HBM)
+and return (new_state, metrics) with metrics mesh-global, so every host
+logs identical values and callbacks (early stopping) agree without extra
+broadcasts.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_lightning_tpu.core.module import TpuModule, TrainState
+from . import sharding as shardlib
+
+__all__ = ["build_train_step", "build_eval_step", "build_predict_step"]
+
+
+def _loss_and_grads(module: TpuModule, params, batch, rng):
+    def loss_fn(p):
+        loss, logs = module.training_step(p, batch, rng)
+        return loss, logs
+
+    (loss, logs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+    logs = dict(logs)
+    logs.setdefault("loss", loss)
+    return grads, logs
+
+
+def build_train_step(
+    module: TpuModule,
+    tx,
+    mesh: Optional[Mesh],
+    mode: str = "gspmd",
+    zero_stage: int = 0,
+    state_shardings: Optional[Any] = None,
+    data_axis: str = "data",
+) -> Callable[[TrainState, Any, jax.Array], Tuple[TrainState, dict]]:
+    """Compile one optimizer step over the mesh.
+
+    Returns ``step(state, batch, rng) -> (new_state, metrics)``.
+    ``batch`` must already be device-placed (global jax.Arrays sharded on
+    the data axis for gspmd; see :func:`..sharding.make_global_batch`).
+    """
+    if mesh is None:
+        # Single-device path (driver-local smoke tests, ≙ non-distributed
+        # Lightning fit).
+        @functools.partial(jax.jit, donate_argnums=0)
+        def step(state: TrainState, batch, rng):
+            grads, logs = _loss_and_grads(module, state.params, batch, rng)
+            return state.apply_gradients(grads, tx), logs
+
+        return step
+
+    if mode == "gspmd":
+        repl = shardlib.replicated(mesh)
+        if state_shardings is None:
+            # A single sharding acts as a pytree prefix: replicate the
+            # whole train state (plain DDP, zero_stage=0).
+            state_shardings = repl
+        batch_sh = shardlib.batch_sharding(mesh, data_axis)
+
+        def raw_step(state: TrainState, batch, rng):
+            grads, logs = _loss_and_grads(module, state.params, batch, rng)
+            new_state = state.apply_gradients(grads, tx)
+            return new_state, logs
+
+        # in/out shardings: state keeps its (possibly ZeRO-sharded) layout,
+        # batch arrives data-sharded, rng + metrics replicated.
+        step = jax.jit(
+            raw_step,
+            in_shardings=(state_shardings, batch_sh, repl),
+            out_shardings=(state_shardings, repl),
+            donate_argnums=0,
+        )
+        return step
+
+    if mode == "shard_map":
+        from jax import shard_map
+
+        repl_spec = P()
+        batch_spec = P(data_axis)
+
+        def per_device_step(state: TrainState, batch, rng):
+            # The explicit all-reduce of the Horovod duality
+            # (hvd.allreduce ≙ collective over ICI) — but note the modern
+            # shard_map (VMA) semantics: the cotangent of the *replicated*
+            # params is automatically psum'd across the data axis, so the
+            # correct place for the mean is the LOSS, before grad; an
+            # explicit pmean on the grads would double-count by axis size.
+            def loss_fn(p):
+                loss, logs = module.training_step(p, batch, rng)
+                return jax.lax.pmean(loss, axis_name=data_axis), logs
+
+            (loss, logs), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(state.params)
+            logs = dict(logs)
+            logs.setdefault("loss", loss)
+            logs = jax.lax.pmean(logs, axis_name=data_axis)
+            new_state = state.apply_gradients(grads, tx)
+            return new_state, logs
+
+        sharded = shard_map(
+            per_device_step,
+            mesh=mesh,
+            in_specs=(repl_spec, batch_spec, repl_spec),
+            out_specs=(repl_spec, repl_spec),
+        )
+        return jax.jit(sharded, donate_argnums=0)
+
+    raise ValueError(f"Unknown step mode {mode!r} (expected gspmd|shard_map)")
+
+
+def build_eval_step(
+    module: TpuModule,
+    mesh: Optional[Mesh],
+    kind: str = "validation",
+    mode: str = "gspmd",
+    params_shardings: Optional[Any] = None,
+    data_axis: str = "data",
+) -> Callable[[Any, Any], dict]:
+    """Compile one metric-producing eval step: ``(params, batch) -> logs``."""
+    step_method = (
+        module.validation_step if kind == "validation" else module.test_step
+    )
+
+    if mesh is None:
+        return jax.jit(lambda params, batch: dict(step_method(params, batch)))
+
+    if mode == "shard_map":
+        from jax import shard_map
+
+        def per_device(params, batch):
+            logs = dict(step_method(params, batch))
+            return jax.lax.pmean(logs, axis_name=data_axis)
+
+        return jax.jit(
+            shard_map(
+                per_device,
+                mesh=mesh,
+                in_specs=(P(), P(data_axis)),
+                out_specs=P(),
+            )
+        )
+
+    repl = shardlib.replicated(mesh)
+    batch_sh = shardlib.batch_sharding(mesh, data_axis)
+    in_sh = (params_shardings if params_shardings is not None else repl,
+             batch_sh)
+    return jax.jit(
+        lambda params, batch: dict(step_method(params, batch)),
+        in_shardings=in_sh,
+        out_shardings=repl,
+    )
+
+
+def build_predict_step(
+    module: TpuModule,
+    mesh: Optional[Mesh],
+    params_shardings: Optional[Any] = None,
+    data_axis: str = "data",
+):
+    """Compile ``(params, batch) -> outputs`` with outputs batch-sharded.
+
+    Outputs keep the data-axis sharding so each host can ``device_get``
+    its own slice (addressable shards) for driver-side concatenation.
+    """
+    if mesh is None:
+        return jax.jit(module.predict_step)
+    repl = shardlib.replicated(mesh)
+    batch_sh = shardlib.batch_sharding(mesh, data_axis)
+    return jax.jit(
+        module.predict_step,
+        in_shardings=(params_shardings if params_shardings is not None
+                      else repl, batch_sh),
+        out_shardings=batch_sh,
+    )

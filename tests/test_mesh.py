@@ -1,0 +1,58 @@
+"""Mesh/rank-mapping unit tests (no cluster — ≙ reference fake-IP actor
+trick, ``test_ddp.py:80-114``)."""
+
+import pytest
+
+from ray_lightning_tpu.parallel.mesh import (
+    MeshSpec,
+    build_mesh,
+    compute_host_ranks,
+)
+
+
+class TestComputeHostRanks:
+    def test_two_nodes_two_workers_each(self):
+        # ≙ reference Node1Actor/Node2Actor hardcoded-IP scenario.
+        ips = ["10.0.0.1", "10.0.0.1", "10.0.0.2", "10.0.0.2"]
+        ranks = compute_host_ranks(ips)
+        assert ranks == {0: (0, 0), 1: (0, 1), 2: (1, 0), 3: (1, 1)}
+
+    def test_interleaved_nodes(self):
+        ips = ["a", "b", "a", "b"]
+        ranks = compute_host_ranks(ips)
+        assert ranks == {0: (0, 0), 1: (1, 0), 2: (0, 1), 3: (1, 1)}
+
+    def test_single_node(self):
+        assert compute_host_ranks(["x"]) == {0: (0, 0)}
+
+    def test_empty(self):
+        assert compute_host_ranks([]) == {}
+
+
+class TestMeshSpec:
+    def test_default_is_1d_data(self):
+        spec = MeshSpec()
+        assert spec.axis_names == ("data",)
+        assert spec.resolve(8) == {"data": 8}
+
+    def test_infer_axis(self):
+        spec = MeshSpec({"data": -1, "model": 2})
+        assert spec.resolve(8) == {"data": 4, "model": 2}
+
+    def test_exact_match_required(self):
+        with pytest.raises(ValueError, match="wants"):
+            MeshSpec({"data": 3}).resolve(8)
+
+    def test_indivisible(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            MeshSpec({"data": -1, "model": 3}).resolve(8)
+
+    def test_two_inferred_axes_rejected(self):
+        with pytest.raises(ValueError, match="Only one"):
+            MeshSpec({"a": -1, "b": -1})
+
+
+def test_build_mesh_cpu(cpu_mesh_devices):
+    mesh = build_mesh(MeshSpec({"data": 2, "model": 4}))
+    assert mesh.shape == {"data": 2, "model": 4}
+    assert mesh.axis_names == ("data", "model")

@@ -1,0 +1,213 @@
+"""Local (in-process) Trainer integration tests on the 8-device CPU mesh.
+
+≙ the reference's CPU integration tier (``test_ddp.py`` run with
+``ray.init(num_cpus=N)``): weights-change, ckpt roundtrip, accuracy,
+early stopping, metrics fidelity — all against LocalStrategy first, which
+exercises the full loop/step/sharding machinery without actors.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from ray_lightning_tpu.core.callbacks import (
+    Callback,
+    EarlyStopping,
+    ModelCheckpoint,
+)
+from ray_lightning_tpu.core.trainer import Trainer
+from ray_lightning_tpu.models import (
+    BoringDataModule,
+    BoringModel,
+    XORDataModule,
+    XORModel,
+)
+from ray_lightning_tpu.parallel.strategies import LocalStrategy
+
+from utils import get_trainer, load_test, predict_test, train_test
+
+
+def test_train_weights_change(tmp_path):
+    trainer = get_trainer(LocalStrategy(), max_epochs=2, tmp_path=tmp_path)
+    train_test(trainer, BoringModel(), BoringDataModule())
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    trainer = get_trainer(LocalStrategy(), max_epochs=1, tmp_path=tmp_path)
+    load_test(trainer, BoringModel(), BoringDataModule(), tmp_path)
+
+
+def test_xor_learns(tmp_path):
+    trainer = get_trainer(LocalStrategy(), max_epochs=12, tmp_path=tmp_path)
+    predict_test(trainer, XORModel(), XORDataModule())
+
+
+def test_predict_returns_rows(tmp_path):
+    trainer = get_trainer(LocalStrategy(), max_epochs=4, tmp_path=tmp_path)
+    trainer.fit(XORModel(), XORDataModule())
+    preds = trainer.predict(XORModel(), XORDataModule())
+    assert preds.ndim == 1 and len(preds) > 0
+    assert set(np.unique(preds)).issubset({0, 1})
+
+
+def test_metrics_populated(tmp_path):
+    trainer = get_trainer(LocalStrategy(), max_epochs=1, tmp_path=tmp_path)
+    trainer.fit(BoringModel(), BoringDataModule())
+    # ≙ reference metrics-fidelity test (test_ddp.py:326-350)
+    assert "train_loss" in trainer.callback_metrics
+    assert "val_loss" in trainer.callback_metrics
+    assert np.isfinite(trainer.callback_metrics["train_loss"])
+
+
+def test_early_stopping(tmp_path):
+    # ≙ reference test_ddp.py:289-308 — must stop before max_epochs.
+    es = EarlyStopping(monitor="val_loss", patience=1, min_delta=10.0)
+    trainer = get_trainer(
+        LocalStrategy(), max_epochs=50, tmp_path=tmp_path, callbacks=[es]
+    )
+    trainer.fit(BoringModel(), BoringDataModule())
+    assert trainer.epochs_run < 50
+
+
+def test_model_checkpoint_best_path(tmp_path):
+    ckpt = ModelCheckpoint(
+        dirpath=str(tmp_path / "ckpts"), monitor="val_loss", mode="min"
+    )
+    trainer = get_trainer(
+        LocalStrategy(),
+        max_epochs=3,
+        tmp_path=tmp_path,
+        callbacks=[ckpt],
+        enable_checkpointing=False,
+    )
+    trainer.fit(BoringModel(), BoringDataModule())
+    assert ckpt.best_model_path
+    assert trainer.best_model_path == ckpt.best_model_path
+    import os
+
+    assert os.path.exists(ckpt.best_model_path)
+
+
+def test_resume_from_checkpoint(tmp_path):
+    # ≙ reference resume test (test_ddp_sharded.py:84-105)
+    ckpt_dir = str(tmp_path / "ckpts")
+    trainer = get_trainer(
+        LocalStrategy(),
+        max_epochs=2,
+        tmp_path=tmp_path,
+        callbacks=[ModelCheckpoint(dirpath=ckpt_dir)],
+        enable_checkpointing=False,
+    )
+    trainer.fit(BoringModel(), BoringDataModule())
+    first_steps = trainer.global_step
+    path = trainer.best_model_path
+
+    resumed = get_trainer(
+        LocalStrategy(),
+        max_epochs=4,
+        tmp_path=tmp_path,
+        resume_from_checkpoint=path,
+    )
+    resumed.fit(BoringModel(), BoringDataModule())
+    assert resumed.global_step > first_steps
+    assert resumed.epochs_run == 4
+
+
+def test_validate_without_fit(tmp_path):
+    # ≙ reference test-without-fit (test_ddp_sharded.py:108-116)
+    trainer = get_trainer(LocalStrategy(), tmp_path=tmp_path)
+    metrics = trainer.validate(BoringModel(), BoringDataModule())
+    assert "val_loss" in metrics
+
+
+def test_fast_dev_run(tmp_path):
+    trainer = get_trainer(
+        LocalStrategy(), tmp_path=tmp_path, fast_dev_run=True, max_epochs=10
+    )
+    trainer.fit(BoringModel(), BoringDataModule())
+    assert trainer.global_step == 1
+
+
+def test_max_steps(tmp_path):
+    trainer = get_trainer(
+        LocalStrategy(), max_epochs=10, tmp_path=tmp_path, max_steps=3
+    )
+    trainer.fit(BoringModel(), BoringDataModule())
+    assert trainer.global_step == 3
+
+
+def test_callback_hook_order(tmp_path):
+    calls = []
+
+    class Recorder(Callback):
+        def setup(self, trainer, module, stage):
+            calls.append("setup")
+
+        def on_fit_start(self, trainer, module):
+            calls.append("fit_start")
+
+        def on_train_epoch_start(self, trainer, module):
+            calls.append("epoch_start")
+
+        def on_train_epoch_end(self, trainer, module):
+            calls.append("epoch_end")
+
+        def on_fit_end(self, trainer, module):
+            calls.append("fit_end")
+
+    trainer = get_trainer(
+        LocalStrategy(),
+        max_epochs=2,
+        tmp_path=tmp_path,
+        callbacks=[Recorder()],
+        enable_checkpointing=False,
+    )
+    trainer.fit(BoringModel(), BoringDataModule())
+    assert calls == [
+        "setup",
+        "fit_start",
+        "epoch_start",
+        "epoch_end",
+        "epoch_start",
+        "epoch_end",
+        "fit_end",
+    ]
+
+
+def test_module_dataloaders_without_datamodule(tmp_path):
+    class SelfFeeding(BoringModel):
+        def train_dataloader(self):
+            return BoringDataModule().train_dataloader()
+
+        def val_dataloader(self):
+            return None
+
+    trainer = get_trainer(LocalStrategy(), tmp_path=tmp_path)
+    trainer.fit(SelfFeeding())
+    assert trainer.params is not None
+
+
+def test_max_steps_zero_trains_nothing(tmp_path):
+    trainer = get_trainer(
+        LocalStrategy(), max_epochs=2, tmp_path=tmp_path, max_steps=0,
+        enable_checkpointing=False,
+    )
+    trainer.fit(BoringModel(), BoringDataModule())
+    assert trainer.global_step == 0
+
+
+def test_checkpoint_monitor_none_keeps_latest(tmp_path):
+    import os
+
+    ckpt = ModelCheckpoint(dirpath=str(tmp_path / "c"), monitor=None,
+                           save_top_k=1)
+    trainer = get_trainer(
+        LocalStrategy(), max_epochs=3, tmp_path=tmp_path, callbacks=[ckpt],
+        enable_checkpointing=False,
+    )
+    trainer.fit(BoringModel(), BoringDataModule())
+    files = os.listdir(tmp_path / "c")
+    assert len(files) == 1
+    assert "epoch=2" in files[0]  # the LATEST, not epoch 0
+    assert ckpt.best_model_path.endswith(files[0])

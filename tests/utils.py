@@ -1,0 +1,68 @@
+"""Assertion helpers (≙ reference ``tests/utils.py:213-272``)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from ray_lightning_tpu.core.trainer import Trainer
+from ray_lightning_tpu.models import (
+    BoringDataModule,
+    BoringModel,
+    XORDataModule,
+    XORModel,
+)
+
+
+def get_trainer(strategy=None, max_epochs: int = 1, tmp_path=".", **kwargs):
+    """≙ reference ``get_trainer`` (``tests/utils.py:213-233``)."""
+    return Trainer(
+        strategy=strategy,
+        max_epochs=max_epochs,
+        default_root_dir=str(tmp_path),
+        log_every_n_steps=1,
+        **kwargs,
+    )
+
+
+def _flat_norm_delta(a, b) -> float:
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return float(
+        sum(
+            np.linalg.norm(np.asarray(x) - np.asarray(y))
+            for x, y in zip(la, lb)
+        )
+    )
+
+
+def train_test(trainer: Trainer, module, datamodule) -> None:
+    """Weights must move under training (≙ ``tests/utils.py:236-245``)."""
+    initial = jax.device_get(
+        jax.jit(module.init_params)(jax.random.PRNGKey(trainer.config.seed))
+    )
+    trainer.fit(module, datamodule)
+    assert trainer.params is not None
+    delta = _flat_norm_delta(initial, trainer.params)
+    assert delta > 0.1, f"params barely moved: ‖Δ‖={delta}"
+
+
+def load_test(trainer: Trainer, module, datamodule, tmp_path) -> None:
+    """Checkpoint roundtrip (≙ ``tests/utils.py:248-253``)."""
+    trainer.fit(module, datamodule)
+    path = str(tmp_path / "model.ckpt")
+    trainer.save_checkpoint(path)
+    from ray_lightning_tpu.utils.state_stream import load_state_stream
+
+    payload = load_state_stream(open(path, "rb").read())
+    restored = payload["state"].params
+    assert _flat_norm_delta(restored, trainer.params) == 0.0
+
+
+def predict_test(trainer: Trainer, module, datamodule) -> None:
+    """Post-train accuracy ≥ 0.5 (≙ ``tests/utils.py:256-272``)."""
+    trainer.fit(module, datamodule)
+    metrics = trainer.validate(module, datamodule)
+    acc = metrics.get("val_acc")
+    assert acc is not None and acc >= 0.5, f"val_acc={acc}"
