@@ -108,10 +108,12 @@ class ModelCheckpoint(Callback):
         )
 
     def on_train_epoch_end(self, trainer, module) -> None:
+        # Runs on ALL ranks: metrics are mesh-global so every rank reaches
+        # the same decision, and trainer.save_checkpoint is a collective
+        # (gather on all ranks, write on rank 0) — rank-guarding here
+        # would deadlock a multi-host mesh.
         epoch = trainer.current_epoch
         if (epoch + 1) % self.every_n_epochs != 0:
-            return
-        if not trainer.is_global_zero:
             return
         metrics = trainer.callback_metrics
         score = self._score(metrics)
@@ -127,15 +129,15 @@ class ModelCheckpoint(Callback):
             # _prune keeps the latest k, not a stale early file.
             self.best_model_path = path
             self._saved.append((float(trainer.global_step), path))
-            self._prune(force_mode="max")
+            self._prune(trainer, force_mode="max")
             return
         if self._is_better(score):
             self.best_model_score = score
             self.best_model_path = path
         self._saved.append((score, path))
-        self._prune()
+        self._prune(trainer)
 
-    def _prune(self, force_mode: Optional[str] = None) -> None:
+    def _prune(self, trainer, force_mode: Optional[str] = None) -> None:
         if self.save_top_k < 0 or len(self._saved) <= self.save_top_k:
             return
         reverse = (force_mode or self.mode) == "max"
@@ -143,8 +145,15 @@ class ModelCheckpoint(Callback):
         keep = set(p for _, p in ranked[: self.save_top_k])
         keep.add(self.best_model_path)
         for score, path in list(self._saved):
-            if path not in keep and os.path.exists(path):
-                os.remove(path)
+            if path not in keep:
+                # Bookkeeping runs on every rank (kept consistent for the
+                # callback_states return), but file deletion is rank-0's —
+                # co-located ranks share a filesystem and would race.
+                if trainer.is_global_zero:
+                    try:
+                        os.remove(path)
+                    except FileNotFoundError:
+                        pass
         self._saved = [(s, p) for s, p in self._saved if p in keep]
 
     def state_dict(self) -> Dict[str, Any]:

@@ -102,19 +102,25 @@ class LoopContext:
         """Host-local numpy copy of the full train state.
 
         Single host: every shard is addressable, ``device_get`` suffices.
-        Multi-host: gather non-addressable shards via process_allgather so
-        checkpoints stay topology-independent (SURVEY §7 hard-part #4).
+        Multi-host: replicate via an identity jit with replicated
+        out_shardings (an XLA all-gather over ICI/DCN), then device_get the
+        local replica — checkpoints stay topology-independent (SURVEY §7
+        hard-part #4).
+
+        **COLLECTIVE**: on a multi-host mesh every rank MUST call this at
+        the same point (rank-guarding the caller deadlocks the mesh — only
+        the file WRITE may be rank-guarded).
         """
         state = self.state
-        if self.world_size > 1:
-            from jax.experimental import multihost_utils
+        leaves = jax.tree_util.tree_leaves(state)
+        fully_addressable = all(
+            getattr(x, "is_fully_addressable", True) for x in leaves
+        )
+        if self.world_size > 1 and not fully_addressable:
+            from jax.sharding import NamedSharding, PartitionSpec as P
 
-            fully_addressable = all(
-                getattr(x, "is_fully_addressable", True)
-                for x in jax.tree_util.tree_leaves(state)
-            )
-            if not fully_addressable:
-                state = multihost_utils.process_allgather(state)
+            repl = NamedSharding(self.mesh, P())
+            state = jax.jit(lambda s: s, out_shardings=repl)(state)
         return jax.device_get(state)
 
     def checkpoint_payload(self, extra: Optional[Dict[str, Any]] = None) -> dict:
@@ -127,8 +133,11 @@ class LoopContext:
         }
 
     def save_checkpoint(self, path: str) -> None:
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        state_stream_to_file(to_state_stream(self.checkpoint_payload()), path)
+        """Gather (all ranks — collective) and write (rank 0 only)."""
+        payload = self.checkpoint_payload()
+        if self.is_global_zero:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            state_stream_to_file(to_state_stream(payload), path)
 
 
 def _call_hooks(callbacks: List[Callback], hook: str, *args) -> None:
@@ -350,6 +359,9 @@ def run_fit(
     datamodule.teardown("fit")
 
     # -- rank-0 result package (≙ ray_ddp.py:490-519) -----------------------
+    # The gather is collective: every rank participates, then only rank 0
+    # serializes and ships the bytes.
+    gathered = ctx._gathered_state()
     if not ctx.is_global_zero:
         return {"rank": global_rank}
     best_path = ""
@@ -359,7 +371,7 @@ def run_fit(
             break
     return {
         "rank": 0,
-        "state_stream": to_state_stream(ctx._gathered_state()),
+        "state_stream": to_state_stream(gathered),
         "callback_metrics": {
             k: float(v) for k, v in ctx.callback_metrics.items()
         },
@@ -391,6 +403,10 @@ def _resolve_params(
         host_params = None
     if host_params is None:
         params = jax.jit(module.init_params)(jax.random.PRNGKey(config.seed))
+    elif mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        params = jax.device_put(host_params, NamedSharding(mesh, P()))
     else:
         params = jax.device_put(host_params)
     return params

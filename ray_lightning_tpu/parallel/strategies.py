@@ -137,6 +137,16 @@ def _execute_remote(task_ref, global_rank: int, queue_handle) -> Dict[str, Any]:
         raise ValueError(f"Unknown stage kind {task['kind']!r}")
     finally:
         session_mod.shutdown_session()
+        if world_size > 1:
+            # Orderly disconnect from the coordination service — without
+            # this, the first worker to exit is seen as "died" and the
+            # service fatally terminates its peers mid-teardown.
+            try:
+                import jax
+
+                jax.distributed.shutdown()
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                pass
 
 
 # ---------------------------------------------------------------------------
@@ -229,7 +239,12 @@ class TpuStrategy:
         reference ``ray_ddp.py:215-228``)."""
         if self.num_workers <= 1:
             return None
-        ip = self._workers[0].get_node_ip()
+        if isinstance(self._backend, backend_mod.LocalBackend):
+            # All actors share this host; loopback is always routable
+            # (the NIC address may be NAT'd/unroutable in sandboxes).
+            ip = "127.0.0.1"
+        else:
+            ip = self._workers[0].get_node_ip()
         port = self._workers[0].execute(_remote_find_free_port)
         return f"{ip}:{port}"
 

@@ -19,6 +19,10 @@ import os
 # gate, test_ddp_gpu.py:125-136).
 if not os.environ.get("RLT_REAL_TPU"):
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # Neutralize any real-TPU sitecustomize hook in spawned worker actors:
+    # a PJRT plugin registered at interpreter startup would lock jax state
+    # before jax.distributed.initialize runs in the worker.
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
