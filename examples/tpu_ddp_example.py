@@ -1,0 +1,106 @@
+"""MNIST data-parallel example (≙ reference ``examples/ray_ddp_example.py``).
+
+Train the MNIST classifier under :class:`RayStrategy` (data-parallel over a
+TPU host's devices, or the CPU-simulated mesh), optionally as a Tune sweep
+(``--tune``), with the same CLI contract as the reference
+(``ray_ddp_example.py:119-150``): ``--num-workers``, ``--smoke-test``,
+``--tune``, ``--num-samples``.
+
+Run (CPU mesh):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/tpu_ddp_example.py --smoke-test
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from ray_lightning_tpu import RayStrategy, Trainer
+from ray_lightning_tpu.models.mnist import MNISTClassifier, MNISTDataModule
+from ray_lightning_tpu.tune import TuneReportCallback, get_tune_resources
+from ray_lightning_tpu.tuning import grid_search, loguniform, tune_run
+
+
+def train_mnist(
+    config: dict,
+    num_workers: int = 1,
+    num_epochs: int = 4,
+    batch_size: int = 32,
+    use_tune: bool = False,
+):
+    """≙ reference ``train_mnist`` (``ray_ddp_example.py:18-52``)."""
+    callbacks = (
+        [TuneReportCallback(
+            {"loss": "ptl/val_loss", "mean_accuracy": "ptl/val_accuracy"},
+            on="validation_end",
+        )]
+        if use_tune
+        else []
+    )
+    trainer = Trainer(
+        strategy=RayStrategy(num_workers=num_workers),
+        max_epochs=num_epochs,
+        callbacks=callbacks,
+        log_every_n_steps=10,
+        default_root_dir="rlt_logs/mnist_ddp",
+    )
+    trainer.fit(
+        MNISTClassifier(lr=config.get("lr", 1e-3),
+                        hidden_1=config.get("layer_1", 128),
+                        hidden_2=config.get("layer_2", 256)),
+        MNISTDataModule(batch_size=batch_size),
+    )
+    return trainer
+
+
+def tune_mnist(
+    num_workers: int = 1,
+    num_samples: int = 2,
+    num_epochs: int = 4,
+    batch_size: int = 32,
+):
+    """≙ reference ``tune_mnist`` (``ray_ddp_example.py:105-117``)."""
+    config = {
+        "layer_1": grid_search([64, 128]),
+        "layer_2": 256,
+        "lr": loguniform(1e-4, 1e-2),
+    }
+    analysis = tune_run(
+        lambda cfg: train_mnist(
+            cfg, num_workers=num_workers, num_epochs=num_epochs,
+            batch_size=batch_size, use_tune=True,
+        ),
+        config=config,
+        num_samples=num_samples,
+        metric="loss",
+        mode="min",
+        local_dir="rlt_logs/mnist_tune",
+    )
+    print("Best hyperparameters:", analysis.best_config)
+    print("Resource request per trial:",
+          get_tune_resources(num_workers=num_workers))
+    return analysis
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-workers", type=int, default=1)
+    parser.add_argument("--num-epochs", type=int, default=4)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--tune", action="store_true")
+    parser.add_argument("--num-samples", type=int, default=2)
+    parser.add_argument("--smoke-test", action="store_true")
+    args = parser.parse_args()
+
+    epochs = 1 if args.smoke_test else args.num_epochs
+    samples = 1 if args.smoke_test else args.num_samples
+    if args.tune:
+        tune_mnist(args.num_workers, samples, epochs, args.batch_size)
+    else:
+        trainer = train_mnist(
+            {}, num_workers=args.num_workers, num_epochs=epochs,
+            batch_size=args.batch_size,
+        )
+        print("final metrics:", {
+            k: round(v, 4) for k, v in trainer.callback_metrics.items()
+        })

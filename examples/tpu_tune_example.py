@@ -1,0 +1,78 @@
+"""MNIST + ASHA hyperparameter sweep (≙ reference ``examples/ray_ddp_tune.py``).
+
+Demonstrates the init_hook pattern for per-host dataset preparation
+(≙ the FileLock download hook, reference ``ray_ddp_tune.py:22-25,39``) and
+an ASHA-scheduled sweep over lr/hidden sizes.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def prepare_data_hook():
+    """Runs once on every worker before training (≙ ``download_data``
+    with FileLock, reference ``ray_ddp_tune.py:22-25``)."""
+    from ray_lightning_tpu.models.mnist import _digits_as_mnist
+
+    _digits_as_mnist()  # warms any on-disk cache; idempotent
+
+
+def tune_mnist_asha(num_workers=1, num_samples=4, num_epochs=6,
+                    batch_size=32):
+    from ray_lightning_tpu import RayStrategy, Trainer
+    from ray_lightning_tpu.models.mnist import (
+        MNISTClassifier,
+        MNISTDataModule,
+    )
+    from ray_lightning_tpu.tune import TuneReportCallback
+    from ray_lightning_tpu.tuning import ASHAScheduler, choice, loguniform, tune_run
+
+    def trainable(config):
+        trainer = Trainer(
+            strategy=RayStrategy(
+                num_workers=num_workers, init_hook=prepare_data_hook
+            ),
+            max_epochs=num_epochs,
+            callbacks=[TuneReportCallback(
+                {"loss": "ptl/val_loss",
+                 "mean_accuracy": "ptl/val_accuracy"},
+                on="validation_end",
+            )],
+            default_root_dir="rlt_logs/mnist_asha",
+        )
+        trainer.fit(
+            MNISTClassifier(lr=config["lr"], hidden_1=config["layer_1"]),
+            MNISTDataModule(batch_size=batch_size),
+        )
+
+    analysis = tune_run(
+        trainable,
+        config={
+            "layer_1": choice([64, 128]),
+            "lr": loguniform(1e-4, 1e-2),
+        },
+        num_samples=num_samples,
+        scheduler=ASHAScheduler(
+            metric="loss", mode="min", max_t=num_epochs, grace_period=1,
+        ),
+        metric="loss",
+        mode="min",
+        local_dir="rlt_logs/mnist_asha_tune",
+    )
+    print("Best hyperparameters:", analysis.best_config)
+    return analysis
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-workers", type=int, default=1)
+    parser.add_argument("--num-samples", type=int, default=4)
+    parser.add_argument("--num-epochs", type=int, default=6)
+    parser.add_argument("--smoke-test", action="store_true")
+    args = parser.parse_args()
+    tune_mnist_asha(
+        args.num_workers,
+        1 if args.smoke_test else args.num_samples,
+        2 if args.smoke_test else args.num_epochs,
+    )

@@ -1,8 +1,11 @@
 from .boring import BoringModel, BoringDataModule, XORModel, XORDataModule
+from .mnist import MNISTClassifier, MNISTDataModule
 
 __all__ = [
     "BoringModel",
     "BoringDataModule",
     "XORModel",
     "XORDataModule",
+    "MNISTClassifier",
+    "MNISTDataModule",
 ]

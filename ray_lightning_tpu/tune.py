@@ -1,0 +1,265 @@
+"""Tune integration: report/checkpoint callbacks + resource factory.
+
+≙ ``/root/reference/ray_lightning/tune.py`` (L6 of the layer map).  The
+callbacks travel pickled to worker rank 0 and fire inside the fit loop;
+metric/checkpoint payloads cross back to the driver as **thunks** on the
+distributed queue, because reporting only works inside the trial session
+process (reference ``tune.py:130-134`` and SURVEY §3.3).
+
+Backend resolution mirrors the reference's ``TUNE_INSTALLED`` guard
+(``tune.py:13-27``): if real Ray Tune is importable, thunks call
+``ray.tune.report``; otherwise they report into this package's native
+trial session (:mod:`ray_lightning_tpu.tuning`).  Either way the worker
+side is identical — only the driver-side thunk body differs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Union
+
+from ray_lightning_tpu.core.callbacks import Callback
+from ray_lightning_tpu.session import get_session, is_session_enabled
+from ray_lightning_tpu.utils.state_stream import to_state_stream
+
+try:  # real Ray Tune, if present (reference tune.py:13-27)
+    from ray import tune as _ray_tune  # type: ignore
+
+    RAY_TUNE_INSTALLED = True
+except ImportError:
+    _ray_tune = None
+    RAY_TUNE_INSTALLED = False
+
+__all__ = [
+    "TuneReportCallback",
+    "TuneReportCheckpointCallback",
+    "get_tune_resources",
+    "RAY_TUNE_INSTALLED",
+]
+
+
+# ---------------------------------------------------------------------------
+# Driver-side report/checkpoint executors (module-level: queue thunks
+# capture these by reference and run them in the trial-session process)
+# ---------------------------------------------------------------------------
+
+def _driver_report(metrics: Dict[str, float]) -> None:
+    if RAY_TUNE_INSTALLED and _ray_tune is not None:
+        _ray_tune.report(metrics)
+        return
+    from ray_lightning_tpu.tuning.session import report
+
+    report(**metrics)
+
+
+def _driver_write_checkpoint(
+    payload: bytes, step: int, filename: str,
+    metrics: Optional[Dict[str, float]] = None,
+) -> None:
+    """≙ _TuneCheckpointCallback._handle driver half (reference
+    ``tune.py:169-178``): write bytes into the trial's checkpoint dir.
+
+    Under real Ray Tune, metrics+checkpoint MUST travel in ONE
+    ``tune.report`` call — separate calls would break the
+    checkpoint↔metric association and double-count training_iteration.
+    """
+    if RAY_TUNE_INSTALLED and _ray_tune is not None:
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmp:
+            path = os.path.join(tmp, filename)
+            with open(path, "wb") as f:
+                f.write(payload)
+            _ray_tune.report(
+                metrics or {},
+                checkpoint=_ray_tune.Checkpoint.from_directory(tmp),
+            )
+        return
+    from ray_lightning_tpu.tuning.session import checkpoint_dir
+
+    path = os.path.join(checkpoint_dir(step), filename)
+    with open(path, "wb") as f:
+        f.write(payload)
+    if metrics:
+        _driver_report(metrics)
+
+
+class TuneReportCallback(Callback):
+    """Report trainer metrics to the tuner on a Lightning-style hook.
+
+    ≙ reference ``TuneReportCallback`` (``tune.py:59-134``): ``metrics``
+    maps reported-name → trainer metric name (list/str = identity map);
+    ``on`` picks the firing hook (default ``validation_end``).  Worker
+    rank 0 ships ``lambda: report(**got)`` through the queue; running
+    outside any remote session (LocalStrategy), it reports directly.
+    """
+
+    _VALID_ON = ("validation_end", "train_epoch_end", "batch_end")
+
+    def __init__(
+        self,
+        metrics: Union[None, str, List[str], Dict[str, str]] = None,
+        on: str = "validation_end",
+    ):
+        if on not in self._VALID_ON:
+            # ≙ the reference's TuneCallback hook validation — a typo'd
+            # hook must fail loudly, not silently never report.
+            raise ValueError(
+                f"on={on!r} is not supported; choose from {self._VALID_ON}"
+            )
+        if isinstance(metrics, str):
+            metrics = [metrics]
+        self._metrics = metrics
+        self._on = on
+
+    # -- metric extraction (≙ reference _get_report_dict, tune.py:110-128) --
+    def _get_report_dict(self, trainer) -> Optional[Dict[str, float]]:
+        source = trainer.callback_metrics
+        if not source:
+            return None
+        if self._metrics is None:
+            return {k: float(v) for k, v in source.items()}
+        if isinstance(self._metrics, list):
+            pairs = {m: m for m in self._metrics}
+        else:
+            pairs = dict(self._metrics)
+        out: Dict[str, float] = {}
+        for report_as, metric_name in pairs.items():
+            if metric_name in source:
+                out[report_as] = float(source[metric_name])
+        return out or None
+
+    def _handle(self, trainer, module) -> None:
+        if not trainer.is_global_zero:
+            return
+        got = self._get_report_dict(trainer)
+        if got is None:
+            return
+        if is_session_enabled() and get_session().queue is not None:
+            # ═══ queue boundary: executes in the trial driver ═══
+            get_session().put_queue(lambda: _driver_report(got))
+        else:
+            _driver_report(got)
+
+    # -- hook dispatch -------------------------------------------------------
+    def on_validation_epoch_end(self, trainer, module) -> None:
+        if self._on == "validation_end":
+            self._handle(trainer, module)
+
+    def on_train_epoch_end(self, trainer, module) -> None:
+        if self._on == "train_epoch_end":
+            self._handle(trainer, module)
+
+    def on_train_batch_end(self, trainer, module, logs, batch_idx) -> None:
+        if self._on == "batch_end":
+            self._handle(trainer, module)
+
+
+class _TuneCheckpointCallback(Callback):
+    """Ship a full trainer checkpoint through the queue to the trial dir.
+
+    ≙ reference ``_TuneCheckpointCallback`` (``tune.py:136-178``): worker
+    dumps the checkpoint payload to bytes, driver writes them under
+    ``checkpoint_dir(step)``.
+    """
+
+    _VALID_ON = ("validation_end", "train_epoch_end")
+
+    def __init__(self, filename: str = "checkpoint", on: str = "validation_end"):
+        if on not in self._VALID_ON:
+            raise ValueError(
+                f"on={on!r} is not supported; choose from {self._VALID_ON}"
+            )
+        self._filename = filename
+        self._on = on
+
+    def _payload(self, trainer) -> Optional[bytes]:
+        """Collective gather on every rank; serialization on rank 0 only."""
+        payload_dict = trainer.checkpoint_payload()
+        if not trainer.is_global_zero:
+            return None
+        return to_state_stream(payload_dict)
+
+    def _handle(self, trainer, module) -> None:
+        payload = self._payload(trainer)
+        if payload is None:
+            return
+        step = trainer.global_step
+        filename = self._filename
+        if is_session_enabled() and get_session().queue is not None:
+            get_session().put_queue(
+                lambda: _driver_write_checkpoint(payload, step, filename)
+            )
+        else:
+            _driver_write_checkpoint(payload, step, filename)
+
+    def on_validation_epoch_end(self, trainer, module) -> None:
+        if self._on == "validation_end":
+            self._handle(trainer, module)
+
+    def on_train_epoch_end(self, trainer, module) -> None:
+        if self._on == "train_epoch_end":
+            self._handle(trainer, module)
+
+
+class TuneReportCheckpointCallback(Callback):
+    """Checkpoint + report in ONE tuner transaction (≙ reference
+    ``TuneReportCheckpointCallback``, ``tune.py:180-236``): the metric and
+    the checkpoint it scores travel in a single thunk/report so the tuner
+    associates them (and training_iteration counts once per epoch)."""
+
+    def __init__(
+        self,
+        metrics: Union[None, str, List[str], Dict[str, str]] = None,
+        filename: str = "checkpoint",
+        on: str = "validation_end",
+    ):
+        self._checkpoint = _TuneCheckpointCallback(filename, on)
+        self._report = TuneReportCallback(metrics, on)
+        self._on = on
+
+    def _handle(self, trainer, module) -> None:
+        payload = self._checkpoint._payload(trainer)  # collective
+        if payload is None:
+            return  # non-zero rank
+        got = self._report._get_report_dict(trainer)
+        step = trainer.global_step
+        filename = self._checkpoint._filename
+
+        def thunk(payload=payload, step=step, filename=filename, got=got):
+            _driver_write_checkpoint(payload, step, filename, metrics=got)
+
+        if is_session_enabled() and get_session().queue is not None:
+            get_session().put_queue(thunk)
+        else:
+            thunk()
+
+    def on_validation_epoch_end(self, trainer, module) -> None:
+        if self._on == "validation_end":
+            self._handle(trainer, module)
+
+    def on_train_epoch_end(self, trainer, module) -> None:
+        if self._on == "train_epoch_end":
+            self._handle(trainer, module)
+
+
+def get_tune_resources(
+    num_workers: int = 1,
+    num_cpus_per_worker: int = 1,
+    use_tpu: bool = True,
+    tpu_chips_per_worker: int = 4,
+) -> Any:
+    """Per-trial resource request (≙ reference ``get_tune_resources``,
+    ``tune.py:32-56``): one head bundle (the trial driver) + N worker
+    bundles.  Returns a ``PlacementGroupFactory`` under real Ray Tune,
+    else a plain dict the native tuner records."""
+    head = {"CPU": 1}
+    worker = {"CPU": num_cpus_per_worker}
+    if use_tpu:
+        worker["TPU"] = tpu_chips_per_worker
+    bundles = [head] + [dict(worker) for _ in range(num_workers)]
+    if RAY_TUNE_INSTALLED and _ray_tune is not None:
+        from ray.tune import PlacementGroupFactory  # type: ignore
+
+        return PlacementGroupFactory(bundles, strategy="PACK")
+    return {"bundles": bundles, "strategy": "PACK"}

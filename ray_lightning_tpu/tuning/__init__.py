@@ -1,0 +1,39 @@
+from .search import (
+    choice,
+    generate_trials,
+    grid_search,
+    loguniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from .schedulers import ASHAScheduler, FIFOScheduler, PopulationBasedTraining
+from .session import (
+    TrialStopRequested,
+    checkpoint_dir,
+    get_trial_session,
+    is_trial_session_enabled,
+    report,
+)
+from .tuner import ExperimentAnalysis, Trial, tune_run
+
+__all__ = [
+    "choice",
+    "generate_trials",
+    "grid_search",
+    "loguniform",
+    "randint",
+    "sample_from",
+    "uniform",
+    "ASHAScheduler",
+    "FIFOScheduler",
+    "PopulationBasedTraining",
+    "TrialStopRequested",
+    "checkpoint_dir",
+    "get_trial_session",
+    "is_trial_session_enabled",
+    "report",
+    "ExperimentAnalysis",
+    "Trial",
+    "tune_run",
+]

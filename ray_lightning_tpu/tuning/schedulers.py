@@ -1,0 +1,158 @@
+"""Trial schedulers: FIFO, ASHA (successive halving), simplified PBT.
+
+≙ the schedulers the reference's Tune integration is driven by (PBT/ASHA
+named at SURVEY §3.3; the reference example uses ASHA-style early stopping
+through ``tune.run(scheduler=...)``).  Decisions are made on every metric
+report flowing through the trial session.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Dict, List, Optional
+
+__all__ = ["FIFOScheduler", "ASHAScheduler", "PopulationBasedTraining"]
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    """Run every trial to completion."""
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, trial_id: str, last: Dict[str, Any]) -> None:
+        ...
+
+
+class ASHAScheduler(FIFOScheduler):
+    """Asynchronous Successive Halving: stop trials that fall out of the
+    top 1/reduction_factor of their rung."""
+
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: int = 3,
+    ):
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be min|max")
+        if grace_period < 1:
+            raise ValueError("grace_period must be >= 1")
+        if reduction_factor < 2:
+            raise ValueError("reduction_factor must be >= 2")
+        self.metric = metric
+        self.mode = mode
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        # rung index -> list of metric values recorded at that rung
+        self._rungs: Dict[int, List[float]] = {}
+        self._sign = 1.0 if mode == "min" else -1.0
+
+    def _rung_of(self, iteration: int) -> Optional[int]:
+        """Rung milestones at grace_period * rf^k."""
+        t = self.grace_period
+        k = 0
+        while t <= self.max_t:
+            if iteration == t:
+                return k
+            t *= self.rf
+            k += 1
+        return None
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        value = result.get(self.metric)
+        iteration = result.get("training_iteration", 0)
+        if value is None:
+            return CONTINUE
+        # Strictly beyond max_t: a trial whose own budget ends exactly AT
+        # max_t finishes naturally (TERMINATED, not STOPPED).
+        if iteration > self.max_t:
+            return STOP
+        rung = self._rung_of(iteration)
+        if rung is None:
+            return CONTINUE
+        scores = self._rungs.setdefault(rung, [])
+        score = self._sign * float(value)
+        scores.append(score)
+        # Continue iff within the top 1/rf of scores seen at this rung
+        # (asynchronous: compares against everything seen so far).
+        cutoff_index = max(0, math.ceil(len(scores) / self.rf) - 1)
+        cutoff = sorted(scores)[cutoff_index]
+        return CONTINUE if score <= cutoff else STOP
+
+
+class PopulationBasedTraining(FIFOScheduler):
+    """Simplified synchronous PBT over sequential trials.
+
+    Real PBT exploits/explores a concurrently-running population.  With
+    sequential trial execution the same search dynamic is approximated:
+    when a trial underperforms the population's best at a perturbation
+    interval, it is stopped, and :meth:`next_config` seeds the following
+    trial from the best trial's config with mutated hyperparameters.
+    """
+
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        perturbation_interval: int = 2,
+        hyperparam_mutations: Optional[Dict[str, Any]] = None,
+        quantile_fraction: float = 0.25,
+        seed: int = 0,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self._sign = 1.0 if mode == "min" else -1.0
+        self._best: Optional[tuple] = None  # (score, trial_id, config)
+        self._scores: List[float] = []
+        self._rng = random.Random(seed)
+        self._configs: Dict[str, Dict[str, Any]] = {}
+
+    def register_trial(self, trial_id: str, config: Dict[str, Any]) -> None:
+        self._configs[trial_id] = dict(config)
+
+    def on_result(self, trial_id: str, result: Dict[str, Any]) -> str:
+        value = result.get(self.metric)
+        iteration = result.get("training_iteration", 0)
+        if value is None:
+            return CONTINUE
+        score = self._sign * float(value)
+        if self._best is None or score < self._best[0]:
+            self._best = (score, trial_id, self._configs.get(trial_id, {}))
+        if iteration % self.interval != 0:
+            return CONTINUE
+        self._scores.append(score)
+        if len(self._scores) < 4:
+            return CONTINUE
+        if self.quantile <= 0:
+            return CONTINUE  # quantile 0 ⇒ never stop (Ray PBT parity)
+        idx = min(
+            len(self._scores) - 1,
+            int(len(self._scores) * (1 - self.quantile)),
+        )
+        cutoff = sorted(self._scores)[idx]
+        return STOP if score > cutoff else CONTINUE
+
+    def next_config(self, base_config: Dict[str, Any]) -> Dict[str, Any]:
+        """Exploit-and-explore: start from the best config, mutate."""
+        if self._best is None:
+            return base_config
+        cfg = dict(self._best[2]) or dict(base_config)
+        for key, domain in self.mutations.items():
+            if isinstance(domain, list):
+                cfg[key] = self._rng.choice(domain)
+            elif callable(getattr(domain, "sample", None)):
+                cfg[key] = domain.sample(self._rng)
+            elif key in cfg and isinstance(cfg[key], (int, float)):
+                cfg[key] = cfg[key] * self._rng.choice([0.8, 1.25])
+        return cfg

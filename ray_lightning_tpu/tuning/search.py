@@ -1,0 +1,101 @@
+"""Search-space primitives: grid/choice/uniform/loguniform sampling.
+
+The subset of Ray Tune's search-space API the reference's examples exercise
+(``examples/ray_ddp_example.py:105-113`` uses ``tune.choice``-style grids).
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import random
+from typing import Any, Callable, Dict, Iterator, List, Sequence
+
+__all__ = [
+    "grid_search",
+    "choice",
+    "uniform",
+    "loguniform",
+    "randint",
+    "sample_from",
+    "generate_trials",
+]
+
+
+class _Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class grid_search(_Domain):  # noqa: N801 - Tune-parity naming
+    """Exhaustive grid over the given values (cross-product with other
+    grids; multiplies num_samples like Ray Tune)."""
+
+    def __init__(self, values: Sequence[Any]):
+        self.values = list(values)
+
+
+class choice(_Domain):  # noqa: N801
+    def __init__(self, values: Sequence[Any]):
+        self.values = list(values)
+
+    def sample(self, rng: random.Random) -> Any:
+        return rng.choice(self.values)
+
+
+class uniform(_Domain):  # noqa: N801
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng: random.Random) -> float:
+        return rng.uniform(self.low, self.high)
+
+
+class loguniform(_Domain):  # noqa: N801
+    def __init__(self, low: float, high: float):
+        self.low, self.high = low, high
+
+    def sample(self, rng: random.Random) -> float:
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+class randint(_Domain):  # noqa: N801
+    def __init__(self, low: int, high: int):
+        self.low, self.high = low, high
+
+    def sample(self, rng: random.Random) -> int:
+        return rng.randrange(self.low, self.high)
+
+
+class sample_from(_Domain):  # noqa: N801
+    def __init__(self, fn: Callable[[Dict[str, Any]], Any]):
+        self.fn = fn
+
+
+def generate_trials(
+    space: Dict[str, Any], num_samples: int = 1, seed: int = 0
+) -> List[Dict[str, Any]]:
+    """Materialize trial configs: grid cross-product × num_samples random
+    draws of the stochastic domains."""
+    rng = random.Random(seed)
+    grid_keys = [k for k, v in space.items() if isinstance(v, grid_search)]
+    grids = (
+        itertools.product(*(space[k].values for k in grid_keys))
+        if grid_keys
+        else [()]
+    )
+    configs: List[Dict[str, Any]] = []
+    for grid_values in grids:
+        for _ in range(num_samples):
+            cfg: Dict[str, Any] = dict(zip(grid_keys, grid_values))
+            for k, v in space.items():
+                if k in cfg:
+                    continue
+                if isinstance(v, sample_from):
+                    continue  # resolved after other keys
+                cfg[k] = v.sample(rng) if isinstance(v, _Domain) else v
+            for k, v in space.items():
+                if isinstance(v, sample_from):
+                    cfg[k] = v.fn(cfg)
+            configs.append(cfg)
+    return configs

@@ -1,0 +1,110 @@
+"""Trial session: the driver-side context a running trial reports into.
+
+≙ the Ray Tune *session* the reference's queue-shipped lambdas execute in
+(reference ``tune.py:130-134``: ``tune.report`` only works in the Tune
+session process — "a key design point", SURVEY §3.3).  Our native tuner
+keeps the same indirection: worker rank-0 callbacks ship
+``lambda: report(**metrics)`` through the distributed queue; the driver's
+result pump executes the thunk *here*, inside the active trial session,
+where the scheduler can see the metric and decide to stop the trial.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+__all__ = [
+    "TrialSession",
+    "TrialStopRequested",
+    "init_trial_session",
+    "get_trial_session",
+    "shutdown_trial_session",
+    "is_trial_session_enabled",
+    "report",
+    "checkpoint_dir",
+]
+
+
+class TrialStopRequested(Exception):
+    """Raised by ``report`` when the scheduler stops the trial.
+
+    Propagates out of the driver's queue pump (``process_results``) and
+    through ``Trainer.fit``; the strategy's ``finally: teardown()`` kills
+    the workers — the native analogue of Ray Tune terminating a trial
+    actor mid-training.
+    """
+
+
+class TrialSession:
+    def __init__(
+        self,
+        trial_id: str,
+        local_dir: str,
+        on_report: Optional[Callable[[Dict[str, Any]], str]] = None,
+    ):
+        self.trial_id = trial_id
+        self.local_dir = local_dir
+        self._on_report = on_report
+        self.reports: list = []
+        self.training_iteration = 0
+
+    def report(self, **metrics: Any) -> None:
+        self.training_iteration += 1
+        record = dict(metrics)
+        record["training_iteration"] = self.training_iteration
+        self.reports.append(record)
+        if self._on_report is not None:
+            decision = self._on_report(record)
+            if decision == "STOP":
+                raise TrialStopRequested(self.trial_id)
+
+    def checkpoint_dir(self, step: int) -> str:
+        """≙ ``tune.checkpoint_dir`` (reference ``tune.py:169-178``)."""
+        path = os.path.join(
+            self.local_dir, self.trial_id, f"checkpoint_{step:06d}"
+        )
+        os.makedirs(path, exist_ok=True)
+        return path
+
+
+_lock = threading.Lock()
+_session: Optional[TrialSession] = None
+
+
+def init_trial_session(*args, **kwargs) -> TrialSession:
+    global _session
+    with _lock:
+        if _session is not None:
+            raise ValueError("A trial session is already active.")
+        _session = TrialSession(*args, **kwargs)
+        return _session
+
+
+def get_trial_session() -> TrialSession:
+    if _session is None:
+        raise ValueError(
+            "No trial session is active; report() must run inside a "
+            "tune_run trial (driver process)."
+        )
+    return _session
+
+
+def shutdown_trial_session() -> None:
+    global _session
+    with _lock:
+        _session = None
+
+
+def is_trial_session_enabled() -> bool:
+    return _session is not None
+
+
+def report(**metrics: Any) -> None:
+    """≙ ``tune.report`` — module-level so queue thunks pickle by ref."""
+    get_trial_session().report(**metrics)
+
+
+def checkpoint_dir(step: int) -> str:
+    return get_trial_session().checkpoint_dir(step)

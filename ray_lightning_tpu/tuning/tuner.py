@@ -1,0 +1,161 @@
+"""The native experiment runner: ``tune_run`` + ``ExperimentAnalysis``.
+
+≙ the ``tune.run(train_fn, config=..., scheduler=..., num_samples=...)``
+surface the reference's examples drive (``examples/ray_ddp_example.py:
+105-113``, ``examples/ray_ddp_tune.py``).  Nested distribution works the
+same way (SURVEY §3.3): each trial's trainable constructs a Trainer with a
+(possibly multi-worker) strategy; metric reports flow worker → queue →
+driver thunk → trial session → scheduler.
+
+Trials execute sequentially in the driver process — on a TPU pod the
+accelerator is a single shared resource, so trial-parallelism is
+cross-slice (multiple drivers), not in-process.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+from .schedulers import FIFOScheduler, PopulationBasedTraining
+from .search import generate_trials
+from .session import (
+    TrialStopRequested,
+    init_trial_session,
+    shutdown_trial_session,
+)
+
+__all__ = ["Trial", "ExperimentAnalysis", "tune_run"]
+
+
+class Trial:
+    def __init__(self, trial_id: str, config: Dict[str, Any]):
+        self.trial_id = trial_id
+        self.config = config
+        self.reports: List[Dict[str, Any]] = []
+        self.status = "PENDING"  # RUNNING | TERMINATED | STOPPED | ERROR
+        self.error: Optional[str] = None
+        self.duration_s: float = 0.0
+
+    @property
+    def last_result(self) -> Dict[str, Any]:
+        return self.reports[-1] if self.reports else {}
+
+    @property
+    def training_iteration(self) -> int:
+        return self.last_result.get("training_iteration", 0)
+
+
+class ExperimentAnalysis:
+    """≙ the ``tune.run`` return object the examples read best configs from."""
+
+    def __init__(self, trials: List[Trial], metric: str, mode: str):
+        self.trials = trials
+        self.metric = metric
+        self.mode = mode
+
+    def _scored(self) -> List[Trial]:
+        return [
+            t for t in self.trials
+            if t.status in ("TERMINATED", "STOPPED")
+            and self.metric in t.last_result
+        ]
+
+    @property
+    def best_trial(self) -> Trial:
+        scored = self._scored()
+        if not scored:
+            raise ValueError(f"No completed trial reported {self.metric!r}")
+        key = lambda t: t.last_result[self.metric]  # noqa: E731
+        return (
+            min(scored, key=key) if self.mode == "min" else max(scored, key=key)
+        )
+
+    @property
+    def best_config(self) -> Dict[str, Any]:
+        return self.best_trial.config
+
+    @property
+    def best_result(self) -> Dict[str, Any]:
+        return self.best_trial.last_result
+
+    def dataframe(self):
+        import pandas as pd
+
+        rows = []
+        for t in self.trials:
+            row = {"trial_id": t.trial_id, "status": t.status,
+                   "training_iteration": t.training_iteration,
+                   "duration_s": t.duration_s}
+            row.update({f"config/{k}": v for k, v in t.config.items()})
+            row.update(t.last_result)
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+def tune_run(
+    trainable: Callable[[Dict[str, Any]], Any],
+    config: Dict[str, Any],
+    num_samples: int = 1,
+    scheduler: Optional[FIFOScheduler] = None,
+    metric: str = "loss",
+    mode: str = "min",
+    local_dir: str = "rlt_tune",
+    seed: int = 0,
+    raise_on_trial_error: bool = False,
+    verbose: bool = True,
+) -> ExperimentAnalysis:
+    """Run an experiment: sample configs, execute trials, schedule stops.
+
+    ``trainable(config)`` runs in the driver; inside it, the trial session
+    is active, so TuneReportCallback thunks arriving through the
+    distributed queue report into this trial (≙ SURVEY §3.3's
+    "report runs on the driver" indirection).
+    """
+    scheduler = scheduler or FIFOScheduler()
+    configs = generate_trials(config, num_samples=num_samples, seed=seed)
+    os.makedirs(local_dir, exist_ok=True)
+    trials: List[Trial] = []
+    for i, cfg in enumerate(configs):
+        if isinstance(scheduler, PopulationBasedTraining) and i > 0:
+            cfg = scheduler.next_config(cfg)
+        trial = Trial(f"trial_{i:04d}", cfg)
+        trials.append(trial)
+        if isinstance(scheduler, PopulationBasedTraining):
+            scheduler.register_trial(trial.trial_id, cfg)
+
+        def on_report(record: Dict[str, Any], _trial=trial) -> str:
+            _trial.reports.append(record)
+            return scheduler.on_result(_trial.trial_id, record)
+
+        session = init_trial_session(
+            trial.trial_id, local_dir, on_report=on_report
+        )
+        trial.status = "RUNNING"
+        t0 = time.perf_counter()
+        try:
+            trainable(dict(cfg))
+            trial.status = "TERMINATED"
+        except TrialStopRequested:
+            trial.status = "STOPPED"
+        except Exception:  # noqa: BLE001 - record, optionally re-raise
+            trial.status = "ERROR"
+            trial.error = traceback.format_exc()
+            if raise_on_trial_error:
+                shutdown_trial_session()
+                raise
+        finally:
+            trial.duration_s = time.perf_counter() - t0
+            shutdown_trial_session()
+        scheduler.on_trial_complete(trial.trial_id, trial.last_result)
+        if verbose:
+            last = trial.last_result.get(metric)
+            print(
+                f"[tune] {trial.trial_id} {trial.status:10s} "
+                f"iters={trial.training_iteration:3d} {metric}="
+                f"{last if last is not None else 'n/a'} config={cfg}",
+                flush=True,
+            )
+    return ExperimentAnalysis(trials, metric, mode)

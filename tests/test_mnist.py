@@ -1,0 +1,17 @@
+"""MNIST classifier convergence (BASELINE.md config #1 analogue;
+≙ reference predict_test accuracy>=0.5, tests/utils.py:256-272)."""
+
+from ray_lightning_tpu.core.trainer import Trainer
+from ray_lightning_tpu.models.mnist import MNISTClassifier, MNISTDataModule
+from ray_lightning_tpu.parallel.strategies import LocalStrategy
+
+
+def test_mnist_converges(tmp_path):
+    trainer = Trainer(
+        strategy=LocalStrategy(),
+        max_epochs=2,
+        default_root_dir=str(tmp_path),
+        enable_checkpointing=False,
+    )
+    trainer.fit(MNISTClassifier(), MNISTDataModule())
+    assert trainer.callback_metrics["ptl/val_accuracy"] >= 0.5

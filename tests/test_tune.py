@@ -1,0 +1,225 @@
+"""Tune integration tests (≙ reference ``tests/test_tune.py``).
+
+Covers: trial-count/iteration invariants (≙ ``test_tune.py:42-51``),
+checkpoint existence (≙ ``test_tune.py:66-78``), queue-thunk reporting from
+remote workers, ASHA early stopping, PBT exploit/explore, search-space
+generation.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.core.trainer import Trainer
+from ray_lightning_tpu.models import (
+    BoringDataModule,
+    BoringModel,
+    XORDataModule,
+    XORModel,
+)
+from ray_lightning_tpu.parallel.strategies import LocalStrategy, RayStrategy
+from ray_lightning_tpu.tune import (
+    TuneReportCallback,
+    TuneReportCheckpointCallback,
+    get_tune_resources,
+)
+from ray_lightning_tpu.tuning import (
+    ASHAScheduler,
+    PopulationBasedTraining,
+    choice,
+    generate_trials,
+    grid_search,
+    loguniform,
+    tune_run,
+    uniform,
+)
+from ray_lightning_tpu.tuning.search import generate_trials  # noqa: F811
+
+
+def _train_boring(config, tmp_path, strategy=None, max_epochs=2):
+    trainer = Trainer(
+        strategy=strategy or LocalStrategy(),
+        max_epochs=max_epochs,
+        callbacks=[TuneReportCallback(on="validation_end")],
+        default_root_dir=str(tmp_path),
+        enable_checkpointing=False,
+        log_every_n_steps=1,
+    )
+    trainer.fit(BoringModel(lr=config["lr"]), BoringDataModule())
+
+
+class TestSearchSpace:
+    def test_grid_cross_product_times_samples(self):
+        space = {"a": grid_search([1, 2, 3]), "b": choice([10, 20]), "c": 5}
+        trials = generate_trials(space, num_samples=2, seed=0)
+        assert len(trials) == 6  # 3 grid × 2 samples
+        assert all(t["c"] == 5 for t in trials)
+        assert {t["a"] for t in trials} == {1, 2, 3}
+
+    def test_loguniform_range(self):
+        space = {"lr": loguniform(1e-5, 1e-1)}
+        trials = generate_trials(space, num_samples=50, seed=1)
+        vals = [t["lr"] for t in trials]
+        assert all(1e-5 <= v <= 1e-1 for v in vals)
+        assert min(vals) < 1e-3 < max(vals)  # spans decades
+
+    def test_uniform(self):
+        vals = [t["x"] for t in generate_trials({"x": uniform(0, 1)}, 20)]
+        assert all(0 <= v <= 1 for v in vals)
+
+
+class TestTuneRun:
+    def test_iteration_invariant(self, tmp_path):
+        # ≙ reference: training_iteration == max_epochs (test_tune.py:50-51)
+        max_epochs = 3
+        analysis = tune_run(
+            lambda cfg: _train_boring(cfg, tmp_path, max_epochs=max_epochs),
+            config={"lr": grid_search([0.05, 0.1])},
+            metric="val_loss",
+            mode="min",
+            local_dir=str(tmp_path / "tune"),
+            verbose=False,
+        )
+        assert len(analysis.trials) == 2
+        for t in analysis.trials:
+            assert t.status == "TERMINATED", t.error
+            assert t.training_iteration == max_epochs
+        assert analysis.best_config["lr"] in (0.05, 0.1)
+        assert np.isfinite(analysis.best_result["val_loss"])
+
+    def test_report_thunks_cross_queue_from_remote_worker(self, tmp_path):
+        # The full nested-distribution path of SURVEY §3.3: trial driver →
+        # worker actor → queue thunk → trial session.
+        analysis = tune_run(
+            lambda cfg: _train_boring(
+                cfg, tmp_path, strategy=RayStrategy(num_workers=1)
+            ),
+            config={"lr": grid_search([0.1])},
+            metric="val_loss",
+            mode="min",
+            local_dir=str(tmp_path / "tune"),
+            verbose=False,
+        )
+        t = analysis.trials[0]
+        assert t.status == "TERMINATED", t.error
+        assert t.training_iteration == 2
+
+    def test_checkpoint_callback_writes_trial_dir(self, tmp_path):
+        def trainable(config):
+            trainer = Trainer(
+                strategy=LocalStrategy(),
+                max_epochs=2,
+                callbacks=[
+                    TuneReportCheckpointCallback(
+                        metrics={"loss": "val_loss"}, filename="ckpt"
+                    )
+                ],
+                default_root_dir=str(tmp_path),
+                enable_checkpointing=False,
+            )
+            trainer.fit(BoringModel(lr=config["lr"]), BoringDataModule())
+
+        local_dir = str(tmp_path / "tune")
+        analysis = tune_run(
+            trainable,
+            config={"lr": grid_search([0.1])},
+            metric="loss",
+            mode="min",
+            local_dir=local_dir,
+            verbose=False,
+        )
+        t = analysis.trials[0]
+        assert t.status == "TERMINATED", t.error
+        # ≙ reference checkpoint-existence assertion (test_tune.py:66-78)
+        ckpts = []
+        for root, _, files in os.walk(os.path.join(local_dir, t.trial_id)):
+            ckpts += [os.path.join(root, f) for f in files if f == "ckpt"]
+        assert ckpts, "no checkpoint written into the trial dir"
+        # The checkpoint is a loadable state stream.
+        from ray_lightning_tpu.utils.state_stream import load_state_stream
+
+        payload = load_state_stream(open(ckpts[0], "rb").read())
+        assert "state" in payload and payload["global_step"] > 0
+
+    def test_asha_stops_bad_trials(self, tmp_path):
+        # lr=0 never improves; ASHA must stop it before max_epochs while
+        # a good lr runs to completion.
+        analysis = tune_run(
+            lambda cfg: _train_boring(cfg, tmp_path, max_epochs=9),
+            config={"lr": grid_search([0.2, 0.0, 0.0, 0.0])},
+            scheduler=ASHAScheduler(
+                metric="val_loss", mode="min", max_t=9, grace_period=1,
+                reduction_factor=3,
+            ),
+            metric="val_loss",
+            mode="min",
+            local_dir=str(tmp_path / "tune"),
+            verbose=False,
+        )
+        statuses = {t.config["lr"]: t.status for t in analysis.trials}
+        iters = [t.training_iteration for t in analysis.trials
+                 if t.config["lr"] == 0.0]
+        assert statuses[0.2] == "TERMINATED"
+        assert any(i < 9 for i in iters), f"ASHA never stopped a trial: {iters}"
+        assert analysis.best_config["lr"] == 0.2
+
+    def test_trial_error_recorded(self, tmp_path):
+        def bad(config):
+            raise RuntimeError("trainable exploded")
+
+        analysis = tune_run(
+            bad, config={"lr": grid_search([0.1])}, verbose=False,
+            local_dir=str(tmp_path / "tune"),
+        )
+        t = analysis.trials[0]
+        assert t.status == "ERROR"
+        assert "trainable exploded" in t.error
+
+    def test_pbt_mutates_from_best(self, tmp_path):
+        pbt = PopulationBasedTraining(
+            metric="val_loss", mode="min", perturbation_interval=1,
+            hyperparam_mutations={"lr": [0.05, 0.1, 0.2]},
+        )
+        analysis = tune_run(
+            lambda cfg: _train_boring(cfg, tmp_path, max_epochs=2),
+            config={"lr": uniform(0.05, 0.2)},
+            num_samples=5,
+            scheduler=pbt,
+            metric="val_loss",
+            mode="min",
+            local_dir=str(tmp_path / "tune"),
+            verbose=False,
+        )
+        assert len(analysis.trials) == 5
+        assert all(t.status in ("TERMINATED", "STOPPED")
+                   for t in analysis.trials)
+
+
+def test_get_tune_resources_shape():
+    # ≙ reference "+1 CPU head bundle" contract (tune.py:50-56, README:184)
+    res = get_tune_resources(num_workers=2, num_cpus_per_worker=3,
+                             use_tpu=True)
+    assert res["strategy"] == "PACK"
+    assert res["bundles"][0] == {"CPU": 1}
+    assert res["bundles"][1] == {"CPU": 3, "TPU": 4}
+    assert len(res["bundles"]) == 3
+
+
+class TestSchedulerValidation:
+    def test_asha_rejects_bad_params(self):
+        with pytest.raises(ValueError):
+            ASHAScheduler(grace_period=0)
+        with pytest.raises(ValueError):
+            ASHAScheduler(reduction_factor=1)
+
+    def test_pbt_quantile_zero_never_stops(self):
+        pbt = PopulationBasedTraining(metric="m", quantile_fraction=0.0,
+                                      perturbation_interval=1)
+        for i in range(10):
+            assert pbt.on_result(f"t{i}", {"m": float(i),
+                                           "training_iteration": 1}) == "CONTINUE"
+
+    def test_report_callback_rejects_bad_hook(self):
+        with pytest.raises(ValueError, match="not supported"):
+            TuneReportCallback(on="validation_epoch_end")
