@@ -180,7 +180,9 @@ def init_train_state(
     if mesh is None:
         return make(rng), None
     abstract = jax.eval_shape(make, rng)
-    shardings = shardlib.zero_state_shardings(abstract, mesh, zero_stage)
+    shardings = shardlib.state_shardings_for_module(
+        module, abstract, mesh, zero_stage
+    )
     state = jax.jit(make, out_shardings=shardings)(rng)
     return state, shardings
 

@@ -1,4 +1,5 @@
 from .boring import BoringModel, BoringDataModule, XORModel, XORDataModule
+from .gpt import GPT, GPTConfig, SyntheticLMDataModule
 from .mnist import MNISTClassifier, MNISTDataModule
 
 __all__ = [
@@ -8,4 +9,7 @@ __all__ = [
     "XORDataModule",
     "MNISTClassifier",
     "MNISTDataModule",
+    "GPT",
+    "GPTConfig",
+    "SyntheticLMDataModule",
 ]

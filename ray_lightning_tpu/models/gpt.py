@@ -1,0 +1,275 @@
+"""GPT — the flagship transformer family (decoder-only LM), TPU-first.
+
+≙ the reference's "large model" example slot (pl_bolts ImageGPT under
+``RayShardedPlugin``, ``/root/reference/examples/ray_ddp_sharded_example.py:48-71``
+— its GPT is an external torch module).  Here the model is owned by the
+framework and written for the hardware:
+
+* **scan-over-layers**: block parameters are stacked with a leading
+  ``n_layer`` axis and the forward is one ``lax.scan`` — XLA compiles one
+  block body instead of ``n_layer`` inlined copies (compile time stays
+  flat as depth grows).
+* **mixed precision**: activations in bfloat16 (MXU-native), parameters,
+  layer-norm statistics, softmax and the loss in float32.
+* **attention dispatch**: :func:`ray_lightning_tpu.ops.causal_attention`
+  — Pallas flash kernel on TPU, XLA einsum elsewhere, or ring attention
+  over a sequence-parallel mesh axis for long context.
+* **parallelism as annotations**: :meth:`GPT.param_partition_specs`
+  publishes Megatron-style tensor-parallel PartitionSpecs (column-split
+  QKV/MLP-in, row-split proj/MLP-out, vocab-split embedding); the
+  strategy layers ZeRO/FSDP sharding on top (see
+  ``parallel/sharding.py``) and XLA inserts the collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import PartitionSpec as P
+
+from ray_lightning_tpu.core.data import TpuDataModule, NumpyLoader
+from ray_lightning_tpu.core.module import TpuModule
+from ray_lightning_tpu.ops import causal_attention
+
+__all__ = ["GPTConfig", "GPT", "SyntheticLMDataModule"]
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304  # GPT-2 vocab padded to a multiple of 128 (MXU)
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    seq_len: int = 1024
+    mlp_ratio: int = 4
+    lr: float = 3e-4
+    weight_decay: float = 0.1
+    warmup_steps: int = 100
+
+    @classmethod
+    def tiny(cls) -> "GPTConfig":
+        """Test-sized config (CPU-mesh friendly)."""
+        return cls(vocab_size=512, n_layer=2, n_head=4, d_model=128,
+                   seq_len=128, warmup_steps=2)
+
+    @classmethod
+    def gpt2_small(cls) -> "GPTConfig":
+        return cls()  # 124M params
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_head
+
+
+def _layer_norm(x: jax.Array, g: jax.Array, b: jax.Array) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + 1e-5)
+    return (y * g + b).astype(x.dtype)
+
+
+class GPT(TpuModule):
+    """Decoder-only LM.  Batch contract: ``{"tokens": int32 (B, T+1)}``
+    — inputs are ``tokens[:, :-1]``, targets ``tokens[:, 1:]``."""
+
+    def __init__(
+        self,
+        config: Optional[GPTConfig] = None,
+        attn_impl: str = "auto",
+        seq_axis: str = "sp",
+    ):
+        super().__init__()
+        self.config = config or GPTConfig.tiny()
+        self.attn_impl = attn_impl
+        self.seq_axis = seq_axis
+        self.save_hyperparameters(
+            **dataclasses.asdict(self.config), attn_impl=attn_impl
+        )
+
+    # -- params -------------------------------------------------------------
+    def init_params(self, rng: jax.Array) -> Dict[str, Any]:
+        cfg = self.config
+        d, h, L = cfg.d_model, cfg.mlp_ratio * cfg.d_model, cfg.n_layer
+        keys = jax.random.split(rng, 8)
+
+        def norm(key, shape, std=0.02):
+            return (jax.random.normal(key, shape) * std).astype(jnp.float32)
+
+        # Residual-path projections scaled by 1/sqrt(2L) (GPT-2 init).
+        resid_std = 0.02 / np.sqrt(2 * L)
+        return {
+            "wte": norm(keys[0], (cfg.vocab_size, d)),
+            "wpe": norm(keys[1], (cfg.seq_len, d), std=0.01),
+            "blocks": {
+                "ln1_g": jnp.ones((L, d)),
+                "ln1_b": jnp.zeros((L, d)),
+                "qkv_w": norm(keys[2], (L, d, 3 * d)),
+                "qkv_b": jnp.zeros((L, 3 * d)),
+                "proj_w": norm(keys[3], (L, d, d), std=resid_std),
+                "proj_b": jnp.zeros((L, d)),
+                "ln2_g": jnp.ones((L, d)),
+                "ln2_b": jnp.zeros((L, d)),
+                "mlp_in_w": norm(keys[4], (L, d, h)),
+                "mlp_in_b": jnp.zeros((L, h)),
+                "mlp_out_w": norm(keys[5], (L, h, d), std=resid_std),
+                "mlp_out_b": jnp.zeros((L, d)),
+            },
+            "ln_f_g": jnp.ones((d,)),
+            "ln_f_b": jnp.zeros((d,)),
+        }
+
+    def param_partition_specs(self) -> Dict[str, Any]:
+        """Tensor-parallel layout over the ``tensor`` mesh axis.
+
+        Megatron recipe: QKV and MLP-in are column-parallel (shard the
+        output features ⇒ heads split across devices, no collective
+        between the two matmuls of a block half), proj and MLP-out are
+        row-parallel (shard the input features ⇒ one psum at the block
+        output, inserted by GSPMD).  Embedding is vocab-sharded.  Axes
+        absent from the active mesh are dropped by the strategy.
+        """
+        t = "tensor"
+        return {
+            "wte": P(t, None),
+            "wpe": P(),
+            "blocks": {
+                "ln1_g": P(), "ln1_b": P(),
+                "qkv_w": P(None, None, t), "qkv_b": P(None, t),
+                "proj_w": P(None, t, None), "proj_b": P(),
+                "ln2_g": P(), "ln2_b": P(),
+                "mlp_in_w": P(None, None, t), "mlp_in_b": P(None, t),
+                "mlp_out_w": P(None, t, None), "mlp_out_b": P(),
+            },
+            "ln_f_g": P(), "ln_f_b": P(),
+        }
+
+    # -- forward ------------------------------------------------------------
+    def _compute_dtype(self):
+        return jnp.bfloat16 if self.precision in ("bf16", "bfloat16") else (
+            jnp.float32
+        )
+
+    def _attention(self, q, k, v):
+        if self.attn_impl == "ring":
+            from ray_lightning_tpu.ops import ring_attention_sharded
+
+            mesh = getattr(self.trainer, "mesh", None)
+            if mesh is None or self.seq_axis not in mesh.axis_names:
+                return causal_attention(q, k, v, impl="auto")
+            return ring_attention_sharded(
+                q, k, v, mesh, seq_axis=self.seq_axis
+            )
+        return causal_attention(q, k, v, impl=self.attn_impl)
+
+    def forward(self, params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
+        """tokens (B, T) int32 -> logits (B, T, vocab) float32."""
+        cfg = self.config
+        c = self._compute_dtype()
+        B, T = tokens.shape
+        x = (params["wte"][tokens] + params["wpe"][:T]).astype(c)
+
+        def block(x, p):
+            h = _layer_norm(x, p["ln1_g"], p["ln1_b"])
+            qkv = h @ p["qkv_w"].astype(c) + p["qkv_b"].astype(c)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+
+            def heads(z):
+                return z.reshape(B, T, cfg.n_head, cfg.head_dim)
+
+            att = self._attention(heads(q), heads(k), heads(v))
+            att = att.reshape(B, T, cfg.d_model)
+            x = x + att @ p["proj_w"].astype(c) + p["proj_b"].astype(c)
+            h = _layer_norm(x, p["ln2_g"], p["ln2_b"])
+            h = jax.nn.gelu(h @ p["mlp_in_w"].astype(c)
+                            + p["mlp_in_b"].astype(c))
+            x = x + h @ p["mlp_out_w"].astype(c) + p["mlp_out_b"].astype(c)
+            return x, None
+
+        x, _ = jax.lax.scan(block, x, params["blocks"])
+        x = _layer_norm(x, params["ln_f_g"], params["ln_f_b"])
+        # Tied LM head; logits in float32 for a stable softmax.
+        return jnp.einsum(
+            "btd,vd->btv", x, params["wte"].astype(c),
+            preferred_element_type=jnp.float32,
+        )
+
+    # -- steps --------------------------------------------------------------
+    def _loss(self, params, tokens):
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = self.forward(params, inputs)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets
+        ).mean()
+        return loss
+
+    def training_step(self, params, batch, rng):
+        loss = self._loss(params, batch["tokens"])
+        return loss, {"train_loss": loss}
+
+    def validation_step(self, params, batch):
+        loss = self._loss(params, batch["tokens"])
+        return {"val_loss": loss, "val_ppl": jnp.exp(loss)}
+
+    def predict_step(self, params, batch):
+        return jnp.argmax(
+            self.forward(params, batch["tokens"][:, :-1]), axis=-1
+        )
+
+    def configure_optimizers(self):
+        cfg = self.config
+        schedule = optax.warmup_cosine_decay_schedule(
+            0.0, cfg.lr, cfg.warmup_steps, max(10 * cfg.warmup_steps, 1000)
+        )
+        tx = optax.chain(
+            optax.clip_by_global_norm(1.0),
+            optax.adamw(schedule, b1=0.9, b2=0.95,
+                        weight_decay=cfg.weight_decay),
+        )
+        return tx
+
+
+class SyntheticLMDataModule(TpuDataModule):
+    """Deterministic synthetic token stream for smoke tests and benches.
+
+    ≙ the reference's ``RandomDataset`` fixture pattern
+    (``tests/utils.py:16-25``), extended to the LM batch contract.
+    """
+
+    def __init__(self, config: GPTConfig, batch_size: int = 8,
+                 num_batches: int = 16, seed: int = 0):
+        super().__init__()
+        self.config = config
+        self.batch_size = batch_size
+        self.num_batches = num_batches
+        self.seed = seed
+        self._tokens: Optional[np.ndarray] = None
+
+    def setup(self, stage: str) -> None:
+        if self._tokens is None:
+            rng = np.random.default_rng(self.seed)
+            n = self.batch_size * self.num_batches
+            self._tokens = rng.integers(
+                0, self.config.vocab_size,
+                size=(n, self.config.seq_len + 1),
+            ).astype(np.int32)
+
+    def _loader(self):
+        from ray_lightning_tpu.core.data import ArrayDataset
+
+        ds = ArrayDataset(tokens=self._tokens)
+        return NumpyLoader(
+            ds, batch_size=self.batch_size,
+            shard_index=self.shard_index, num_shards=self.num_shards,
+        )
+
+    def train_dataloader(self):
+        return self._loader()
+
+    def val_dataloader(self):
+        return self._loader()

@@ -1,0 +1,27 @@
+"""TPU compute ops: XLA reference implementations + Pallas kernels.
+
+The reference framework has no custom compute ops at all — its hot path is
+torch DDP + NCCL (``/root/reference/ray_lightning/ray_ddp.py:483``).  This
+package is where the TPU build keeps the ops that XLA alone doesn't already
+fuse optimally:
+
+* :mod:`.attention` — causal multi-head attention dispatcher
+  (XLA einsum reference / Pallas flash kernel / ring sequence-parallel).
+* :mod:`.flash_attention` — Pallas TPU flash-attention forward kernel
+  (online softmax, blocked over VMEM).
+* :mod:`.ring_attention` — causal ring attention over a sequence-sharded
+  mesh axis (``shard_map`` + ``lax.ppermute``), the long-context/context-
+  parallel primitive (net-new vs the reference, SURVEY §5 "long-context").
+"""
+
+from ray_lightning_tpu.ops.attention import causal_attention
+from ray_lightning_tpu.ops.ring_attention import (
+    ring_attention_sharded,
+    ring_causal_attention,
+)
+
+__all__ = [
+    "causal_attention",
+    "ring_causal_attention",
+    "ring_attention_sharded",
+]

@@ -1,0 +1,92 @@
+"""Causal multi-head attention: XLA reference + implementation dispatcher.
+
+All implementations share one contract::
+
+    causal_attention(q, k, v) -> out      # shapes (batch, seq, heads, dim)
+
+* ``impl="xla"`` — einsum + masked softmax; XLA fuses this well and it runs
+  anywhere (CPU test meshes included).  This is also the numerical
+  reference the Pallas/ring implementations are tested against.
+* ``impl="flash"`` — the Pallas TPU kernel (:mod:`.flash_attention`):
+  blocked online-softmax, O(seq) memory, causal blocks skipped.
+* ``impl="auto"`` — flash on TPU when shapes allow, else XLA.
+
+Ring (sequence-parallel) attention has a different calling convention — it
+runs *inside* ``shard_map`` over a sequence-sharded axis — and lives in
+:mod:`.ring_attention`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["causal_attention", "xla_causal_attention"]
+
+_NEG_INF = -1e30  # large-negative instead of -inf: keeps masked softmax
+# rows finite (causal rows always have >=1 unmasked entry, but -inf
+# produces nan gradients through where()).
+
+
+def xla_causal_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Reference causal attention, (B, S, H, D) -> (B, S, H, D).
+
+    Softmax is computed in float32 regardless of input dtype (bfloat16
+    activations keep full-precision normalizers — the standard TPU mixed-
+    precision recipe), output is cast back to the input dtype.
+    """
+    b, s, h, d = q.shape
+    scale = (d ** -0.5) if scale is None else scale
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    )
+    logits = logits * scale
+    q_pos = jnp.arange(s)[:, None]
+    k_pos = jnp.arange(s)[None, :]
+    logits = jnp.where(q_pos >= k_pos, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
+
+
+def _flash_supported(q: jax.Array) -> bool:
+    try:
+        platform = jax.default_backend()
+    except Exception:  # noqa: BLE001
+        return False
+    if platform != "tpu":
+        return False
+    _, s, _, d = q.shape
+    # Kernel constraints: seq divisible by its q/k block, head_dim lane-able.
+    from ray_lightning_tpu.ops import flash_attention as fa
+
+    return s % fa.DEFAULT_BLOCK_Q == 0 and d in (64, 128, 256)
+
+
+def causal_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    scale: Optional[float] = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """Dispatching causal attention (see module docstring)."""
+    if impl == "auto":
+        impl = "flash" if _flash_supported(q) else "xla"
+    if impl == "xla":
+        return xla_causal_attention(q, k, v, scale)
+    if impl == "flash":
+        from ray_lightning_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, scale)
+    raise ValueError(f"Unknown attention impl {impl!r} (auto|xla|flash)")

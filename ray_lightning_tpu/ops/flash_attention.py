@@ -1,0 +1,154 @@
+"""Pallas TPU flash-attention (causal, forward kernel + recompute VJP).
+
+The hot op of the transformer family, written TPU-first per the Pallas
+playbook (``/opt/skills/guides/pallas_guide.md``):
+
+* grid ``(batch*heads, seq/block_q)`` — one program per query block;
+* K/V live in VMEM per (batch,head) and are walked in ``block_k`` slices
+  with online softmax (running max/denominator in float32 scratch carries)
+  — memory is O(seq · head_dim) instead of the O(seq²) logits tensor;
+* the causal structure bounds the inner loop: query block ``i`` visits only
+  key blocks ``<= i`` (the upper half of the score matrix is never
+  computed, ~2× fewer MXU ops than mask-and-discard);
+* logits/accumulators in float32, inputs/outputs in the caller's dtype
+  (bfloat16 in the mixed-precision recipe).
+
+Backward pass: recompute-based ``custom_vjp`` — residuals are just
+(q, k, v); the VJP re-runs the XLA reference attention under ``jax.vjp``.
+Rematerialization trades FLOPs for HBM exactly like ``jax.checkpoint``;
+a fused Pallas backward kernel is the natural next optimization.
+
+(The reference framework has no analogue — its compute is opaque torch
+modules; this file exists because the TPU build owns its model math.)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["flash_attention", "DEFAULT_BLOCK_Q", "DEFAULT_BLOCK_K"]
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, block_q, block_k,
+                head_dim):
+    q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
+    qi = pl.program_id(1)
+    q_base = qi * block_q
+
+    def body(kb, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (block_q, block_k)
+        q_pos = q_base + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * corr + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc_new, m_new, l_new
+
+    # Causal bound: the last key position this query block can see is
+    # q_base + block_q - 1, so visit cdiv(q_base + block_q, block_k) blocks.
+    num_kb = pl.cdiv(q_base + block_q, block_k)
+    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, _, l = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def _flash_fwd_bhsd(q, k, v, scale, block_q, block_k):
+    """q/k/v: (BH, S, D) merged batch-heads layout."""
+    bh, s, d = q.shape
+    grid = (bh, s // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k,
+        head_dim=d,
+    )
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        # Mosaic compiles only for TPU; CPU test meshes run the kernel
+        # under the Pallas interpreter (same program, host execution).
+        interpret=(jax.default_backend() != "tpu"),
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _flash(scale, block_q, block_k, q, k, v):
+    b, s, h, d = q.shape
+
+    def to_bhsd(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    out = _flash_fwd_bhsd(
+        to_bhsd(q), to_bhsd(k), to_bhsd(v), scale, block_q, block_k
+    )
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _flash_vjp_fwd(scale, block_q, block_k, q, k, v):
+    return _flash(scale, block_q, block_k, q, k, v), (q, k, v)
+
+
+def _flash_vjp_bwd(scale, block_q, block_k, residuals, g):
+    from ray_lightning_tpu.ops.attention import xla_causal_attention
+
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: xla_causal_attention(q_, k_, v_, scale), q, k, v
+    )
+    return vjp(g)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    scale: Optional[float] = None,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
+) -> jax.Array:
+    """Causal flash attention, (B, S, H, D) -> (B, S, H, D)."""
+    _, s, _, d = q.shape
+    scale = (d ** -0.5) if scale is None else scale
+    block_q = min(DEFAULT_BLOCK_Q, s) if block_q is None else block_q
+    block_k = min(DEFAULT_BLOCK_K, s) if block_k is None else block_k
+    if s % block_q or s % block_k:
+        raise ValueError(
+            f"seq_len {s} must be divisible by block_q={block_q} and "
+            f"block_k={block_k}"
+        )
+    return _flash(scale, block_q, block_k, q, k, v)

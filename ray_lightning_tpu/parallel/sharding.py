@@ -27,8 +27,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = [
     "replicated",
     "batch_sharding",
+    "data_axes",
+    "default_zero_axis",
     "shard_leaf_spec",
     "zero_state_shardings",
+    "state_shardings_for_module",
     "make_global_batch",
 ]
 
@@ -37,8 +40,25 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
-    """Shard the leading (batch) dim over the data axis; replicate the rest."""
+def data_axes(mesh: Mesh) -> tuple:
+    """Mesh axes the global batch shards over.
+
+    Both ``data`` and ``fsdp`` are batch-parallel axes (FSDP is data
+    parallelism with parameters sharded over the same replicas); model
+    axes (``tensor``/``sp``/...) see replicated batches.
+    """
+    return tuple(a for a in mesh.axis_names if a in ("data", "fsdp"))
+
+
+def default_zero_axis(mesh: Mesh) -> str:
+    """ZeRO shards state over ``fsdp`` when the mesh has one, else ``data``."""
+    return "fsdp" if "fsdp" in mesh.axis_names else "data"
+
+
+def batch_sharding(mesh: Mesh, axis=None) -> NamedSharding:
+    """Shard the leading (batch) dim over the data axes; replicate the rest."""
+    if axis is None:
+        axis = data_axes(mesh)
     return NamedSharding(mesh, P(axis))
 
 
@@ -49,19 +69,7 @@ def shard_leaf_spec(
     min_leaf_size: int = 2**12,
 ) -> P:
     """PartitionSpec for one leaf: biggest divisible axis or replicate."""
-    if not shape or int(np.prod(shape)) < min_leaf_size:
-        return P()
-    candidates = [
-        (dim_size, i)
-        for i, dim_size in enumerate(shape)
-        if dim_size % axis_size == 0
-    ]
-    if not candidates:
-        return P()
-    _, best_axis = max(candidates)
-    spec = [None] * len(shape)
-    spec[best_axis] = axis_name
-    return P(*spec)
+    return _merge_zero_axis(P(), shape, axis_size, axis_name, min_leaf_size)
 
 
 def zero_state_shardings(
@@ -109,7 +117,135 @@ def zero_state_shardings(
     )
 
 
-def make_global_batch(batch: Any, mesh: Mesh, axis: str = "data") -> Any:
+def _sanitize_spec(spec: P, mesh: Mesh) -> P:
+    """Drop axis names the active mesh doesn't have (so one module can
+    publish a full tp/sp layout and still run on a plain data mesh)."""
+    names = set(mesh.axis_names)
+
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in names)
+            return kept if kept else None
+        return entry if entry in names else None
+
+    return P(*(keep(e) for e in spec))
+
+
+def _merge_zero_axis(
+    spec: P, shape: tuple, axis_size: int, axis_name: str, min_leaf_size: int
+) -> P:
+    """Layer ZeRO sharding onto an existing (possibly TP) spec: shard the
+    largest still-unsharded divisible dim over ``axis_name``."""
+    if not shape or int(np.prod(shape)) < min_leaf_size:
+        return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    candidates = [
+        (dim, i) for i, dim in enumerate(shape)
+        if entries[i] is None and dim % axis_size == 0
+    ]
+    if not candidates:
+        return spec
+    _, best = max(candidates)
+    entries[best] = axis_name
+    return P(*entries)
+
+
+def state_shardings_for_module(
+    module: Any,
+    abstract_state: Any,
+    mesh: Mesh,
+    zero_stage: int = 0,
+    min_leaf_size: int = 2**12,
+) -> Any:
+    """NamedShardings for a TrainState honoring the module's parallelism.
+
+    Layering order (≙ how Megatron-LM + ZeRO compose, here as pure
+    annotations):
+
+    1. **module TP/SP specs** — ``module.param_partition_specs()`` if
+       defined (a P-pytree congruent with params), sanitized against the
+       active mesh;
+    2. **ZeRO** — stage>=1 shards optimizer moments, stage>=3 also
+       parameters, over the ``fsdp`` axis (or ``data`` if no fsdp axis),
+       on the largest dim not already claimed by TP.
+
+    Optimizer-state leaves inherit their parameter's spec by **key-path
+    suffix matching**: an optax state like ``ScaleByAdamState.mu`` is a
+    params-shaped subtree, so each moment leaf's path ends with the full
+    path of its parameter — that spec (shape-checked) is reused.  Leaves
+    with no param twin (step counts, scalars) fall back to the generic
+    largest-axis rule.
+    """
+    from ray_lightning_tpu.core.module import TrainState
+
+    if not isinstance(abstract_state, TrainState):
+        return zero_state_shardings(
+            abstract_state, mesh, zero_stage,
+            default_zero_axis(mesh), min_leaf_size,
+        )
+
+    zero_axis = default_zero_axis(mesh)
+    axis_size = mesh.shape[zero_axis]
+    spec_fn = getattr(module, "param_partition_specs", None)
+    if spec_fn is not None:
+        param_specs = jax.tree_util.tree_map(
+            lambda s: _sanitize_spec(s, mesh),
+            spec_fn(),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+    else:
+        param_specs = jax.tree_util.tree_map(
+            lambda _: P(), abstract_state.params
+        )
+
+    def finalize(spec: P, leaf, shard_it: bool) -> NamedSharding:
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if shard_it:
+            spec = _merge_zero_axis(
+                spec, shape, axis_size, zero_axis, min_leaf_size
+            )
+        return NamedSharding(mesh, spec)
+
+    params_sh = jax.tree_util.tree_map(
+        lambda spec, leaf: finalize(spec, leaf, zero_stage >= 3),
+        param_specs,
+        abstract_state.params,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+    # Path-indexed spec lookup for optimizer moments.
+    flat_params = jax.tree_util.tree_flatten_with_path(abstract_state.params)[0]
+    flat_specs = jax.tree_util.tree_leaves(
+        param_specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    by_path = {
+        tuple(path): (tuple(leaf.shape), spec)
+        for (path, leaf), spec in zip(flat_params, flat_specs)
+    }
+
+    def opt_leaf(path, leaf) -> NamedSharding:
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        path = tuple(path)
+        for i in range(len(path)):
+            hit = by_path.get(path[i:])
+            if hit is not None and hit[0] == shape:
+                return finalize(hit[1], leaf, zero_stage >= 1)
+        return finalize(
+            shard_leaf_spec(shape, axis_size, zero_axis, min_leaf_size)
+            if zero_stage >= 1 else P(),
+            leaf,
+            False,
+        )
+
+    opt_sh = jax.tree_util.tree_map_with_path(
+        opt_leaf, abstract_state.opt_state
+    )
+    return TrainState(params_sh, opt_sh, replicated(mesh))
+
+
+def make_global_batch(batch: Any, mesh: Mesh, axis=None) -> Any:
     """Per-host numpy batch shard → globally batch-sharded jax.Arrays.
 
     Every host holds ``global_batch / num_hosts`` examples (the
@@ -118,8 +254,21 @@ def make_global_batch(batch: Any, mesh: Mesh, axis: str = "data") -> Any:
     host's shard lands on its own devices
     (``make_array_from_process_local_data``).
     """
-    sharding = batch_sharding(mesh, axis)
-    axis_size = mesh.shape[axis]
+    if axis is None:
+        axis = data_axes(mesh)
+    axes = (axis,) if isinstance(axis, str) else tuple(axis)
+    if not axes and jax.process_count() > 1:
+        # Replicated batch + per-host loader shards would silently hand
+        # every host DIFFERENT rows under one "replicated" global array.
+        raise ValueError(
+            "Mesh has no data/fsdp axis to shard the batch over; a "
+            "multi-host run would train on inconsistent data. Add a "
+            "batch-parallel axis to mesh_axes."
+        )
+    sharding = batch_sharding(mesh, axes)
+    axis_size = 1
+    for a in axes:
+        axis_size *= mesh.shape[a]
 
     def to_global(x):
         x = np.asarray(x)
@@ -129,7 +278,7 @@ def make_global_batch(batch: Any, mesh: Mesh, axis: str = "data") -> Any:
         if x.ndim == 0 or global_rows % axis_size != 0:
             raise ValueError(
                 f"Batch leading dim (global {global_rows}) must be divisible "
-                f"by the {axis!r} mesh axis size ({axis_size}). Pick a "
+                f"by the {axis!r} mesh axes size ({axis_size}). Pick a "
                 f"batch_size that is a multiple of the number of devices."
             )
         return jax.make_array_from_process_local_data(sharding, x)

@@ -81,7 +81,7 @@ def build_train_step(
             # A single sharding acts as a pytree prefix: replicate the
             # whole train state (plain DDP, zero_stage=0).
             state_shardings = repl
-        batch_sh = shardlib.batch_sharding(mesh, data_axis)
+        batch_sh = shardlib.batch_sharding(mesh)
 
         def raw_step(state: TrainState, batch, rng):
             grads, logs = _loss_and_grads(module, state.params, batch, rng)
@@ -168,7 +168,7 @@ def build_eval_step(
         )
 
     repl = shardlib.replicated(mesh)
-    batch_sh = shardlib.batch_sharding(mesh, data_axis)
+    batch_sh = shardlib.batch_sharding(mesh)
     in_sh = (params_shardings if params_shardings is not None else repl,
              batch_sh)
     return jax.jit(
@@ -182,7 +182,6 @@ def build_predict_step(
     module: TpuModule,
     mesh: Optional[Mesh],
     params_shardings: Optional[Any] = None,
-    data_axis: str = "data",
 ):
     """Compile ``(params, batch) -> outputs`` with outputs batch-sharded.
 
@@ -192,7 +191,7 @@ def build_predict_step(
     if mesh is None:
         return jax.jit(module.predict_step)
     repl = shardlib.replicated(mesh)
-    batch_sh = shardlib.batch_sharding(mesh, data_axis)
+    batch_sh = shardlib.batch_sharding(mesh)
     return jax.jit(
         module.predict_step,
         in_shardings=(params_shardings if params_shardings is not None

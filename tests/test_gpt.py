@@ -1,0 +1,119 @@
+"""GPT flagship-model tests: training moves weights, parallel flavors agree.
+
+≙ the reference test taxonomy (SURVEY §4): ``train_test`` weights-changed,
+plus the TPU-specific addition — loss parity between the plain data mesh
+and the TP/FSDP/ZeRO-sharded mesh (sharding must be a no-op numerically).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ray_lightning_tpu.core.trainer import Trainer
+from ray_lightning_tpu.models.gpt import GPT, GPTConfig, SyntheticLMDataModule
+from ray_lightning_tpu.parallel.strategies import LocalStrategy
+
+
+def tiny():
+    return GPTConfig.tiny()
+
+
+def make_trainer(**kw):
+    kw.setdefault("max_epochs", 1)
+    kw.setdefault("limit_train_batches", 2)
+    kw.setdefault("limit_val_batches", 1)
+    kw.setdefault("enable_checkpointing", False)
+    return Trainer(**kw)
+
+
+def fit_metrics(strategy, attn_impl="xla"):
+    cfg = tiny()
+    tr = make_trainer(strategy=strategy)
+    tr.fit(GPT(cfg, attn_impl=attn_impl),
+           SyntheticLMDataModule(cfg, batch_size=8, num_batches=2))
+    return tr
+
+
+def test_gpt_trains_and_moves_weights():
+    tr = fit_metrics(LocalStrategy())
+    assert np.isfinite(tr.callback_metrics["train_loss"])
+    # Loss near ln(vocab) for random tokens — the model is wired correctly.
+    assert 4.0 < tr.callback_metrics["train_loss"] < 8.0
+    assert tr.state is not None
+
+
+def test_gpt_tp_fsdp_parity_with_data_mesh():
+    """ZeRO-3 + tensor parallel must be numerically identical to plain DP."""
+    base = fit_metrics(LocalStrategy())
+    sharded = fit_metrics(
+        LocalStrategy(mesh_axes={"data": 2, "fsdp": 2, "tensor": 2},
+                      zero_stage=3)
+    )
+    assert base.callback_metrics["train_loss"] == pytest.approx(
+        sharded.callback_metrics["train_loss"], rel=1e-5
+    )
+    assert base.callback_metrics["val_loss"] == pytest.approx(
+        sharded.callback_metrics["val_loss"], rel=1e-5
+    )
+
+
+def test_gpt_ring_attention_training():
+    """Sequence-parallel (ring attention) flavor trains and agrees."""
+    base = fit_metrics(LocalStrategy())
+    ring = fit_metrics(
+        LocalStrategy(mesh_axes={"data": 2, "sp": 4}),
+        attn_impl="ring",
+    )
+    assert base.callback_metrics["train_loss"] == pytest.approx(
+        ring.callback_metrics["train_loss"], rel=1e-4
+    )
+
+
+def test_param_partition_specs_cover_params():
+    model = GPT(tiny())
+    params = jax.eval_shape(model.init_params, jax.random.PRNGKey(0))
+    specs = model.param_partition_specs()
+    p_leaves = jax.tree_util.tree_leaves(params)
+    s_leaves = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    assert len(p_leaves) == len(s_leaves)
+
+
+def test_state_shardings_follow_tp_specs():
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    from ray_lightning_tpu.core.module import TrainState
+    from ray_lightning_tpu.parallel.sharding import (
+        state_shardings_for_module,
+    )
+
+    model = GPT(tiny())
+    mesh = Mesh(
+        mesh_utils.create_device_mesh((2, 2, 2)),
+        ("data", "fsdp", "tensor"),
+    )
+    tx = model.configure_optimizers()
+
+    def make(rng):
+        return TrainState.create(model.init_params(rng), tx)
+
+    abstract = jax.eval_shape(make, jax.random.PRNGKey(0))
+    sh = state_shardings_for_module(model, abstract, mesh, zero_stage=1)
+    # TP spec honored on params:
+    assert sh.params["blocks"]["qkv_w"].spec == P(None, None, "tensor")
+    # Optimizer moments inherit the param TP spec + the fsdp zero axis:
+    mu_qkv = jax.tree_util.tree_leaves_with_path(sh.opt_state)
+    hits = [
+        s for path, s in mu_qkv
+        if any(getattr(k, "key", None) == "qkv_w" for k in path)
+    ]
+    assert hits, "no optimizer-moment sharding found for qkv_w"
+    for s in hits:
+        assert "tensor" in jax.tree_util.tree_leaves(tuple(s.spec)) or (
+            s.spec and "tensor" in str(s.spec)
+        )
+        assert "fsdp" in str(s.spec)
