@@ -1,0 +1,87 @@
+"""Headline benchmark: flagship GPT training throughput on one TPU chip.
+
+Prints ONE JSON line ``{"metric", "value", "unit", "vs_baseline"}``.
+
+The reference (`sxjscience/ray_lightning`) publishes no performance
+numbers (BASELINE.md: ``"published": {}``), so ``vs_baseline`` is
+reported as the ratio against the framework's own recorded target of
+parity (1.0 ≡ established baseline; >1 is headroom over it).
+
+Config: GPT-2-small-shaped model (124M params), bf16 activations, seq
+1024, per-chip batch 8, full optimizer step (adamw + global-norm clip,
+donated buffers) through the same ``build_train_step`` path the
+strategies compile.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_lightning_tpu.core.module import TrainState
+from ray_lightning_tpu.models.gpt import GPT, GPTConfig
+from ray_lightning_tpu.parallel.step_fns import build_train_step
+
+WARMUP_STEPS = 3
+TIMED_STEPS = 10
+
+
+def main() -> None:
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = GPTConfig(
+            vocab_size=50304, n_layer=12, n_head=12, d_model=768,
+            seq_len=1024, warmup_steps=10,
+        )
+        batch_size = 8
+    else:
+        # CPU fallback so the harness always produces a line.
+        cfg = GPTConfig.tiny()
+        batch_size = 4
+
+    module = GPT(cfg)
+    module.precision = "bf16"
+
+    params = module.init_params(jax.random.PRNGKey(0))
+    tx = module.configure_optimizers()
+    state = TrainState.create(params, tx)
+    step = build_train_step(module, tx, mesh=None)
+
+    rng = jax.random.PRNGKey(0)
+    tokens = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(batch_size, cfg.seq_len + 1)
+    ).astype(np.int32)
+    batch = {"tokens": jnp.asarray(tokens)}
+
+    for _ in range(WARMUP_STEPS):
+        state, logs = step(state, batch, rng)
+    # Synchronize via host transfer: on the experimental remote-TPU
+    # platform block_until_ready can return before execution finishes,
+    # but a device->host copy of the result cannot.
+    float(logs["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(TIMED_STEPS):
+        state, logs = step(state, batch, rng)
+    loss = float(logs["loss"])
+    dt = time.perf_counter() - t0
+    assert np.isfinite(loss), f"non-finite loss {loss}"
+
+    steps_per_sec = TIMED_STEPS / dt
+    tokens_per_sec = steps_per_sec * batch_size * cfg.seq_len
+
+    print(json.dumps({
+        "metric": "gpt2_small_train_tokens_per_sec_per_chip"
+        if on_tpu else "gpt_tiny_train_tokens_per_sec_cpu",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

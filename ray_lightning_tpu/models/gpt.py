@@ -161,7 +161,15 @@ class GPT(TpuModule):
 
             mesh = getattr(self.trainer, "mesh", None)
             if mesh is None or self.seq_axis not in mesh.axis_names:
-                return causal_attention(q, k, v, impl="auto")
+                # Explicitly-requested ring attention with no seq axis is a
+                # misconfiguration — falling back silently would hide an
+                # O(seq^2)-memory surprise on a long-context run.
+                raise ValueError(
+                    f"attn_impl='ring' needs mesh axis {self.seq_axis!r}; "
+                    f"active mesh axes: "
+                    f"{None if mesh is None else mesh.axis_names}. Add "
+                    f"{self.seq_axis!r} to mesh_axes or use attn_impl='auto'."
+                )
             return ring_attention_sharded(
                 q, k, v, mesh, seq_axis=self.seq_axis
             )

@@ -70,7 +70,9 @@ def _flash_supported(q: jax.Array) -> bool:
     # Kernel constraints: seq divisible by its q/k block, head_dim lane-able.
     from ray_lightning_tpu.ops import flash_attention as fa
 
-    return s % fa.DEFAULT_BLOCK_Q == 0 and d in (64, 128, 256)
+    # Mirror the dispatch target's actual constraint: flash_attention uses
+    # block = min(DEFAULT_BLOCK, s), so short sequences still qualify.
+    return s % min(fa.DEFAULT_BLOCK_Q, s) == 0 and d in (64, 128, 256)
 
 
 def causal_attention(

@@ -58,8 +58,7 @@ def ring_causal_attention(
     q_pos = my_idx * s_loc + jnp.arange(s_loc)  # global query positions
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
 
-    def step(carry, i):
-        k_cur, v_cur, acc, m, l = carry
+    def fold(acc, m, l, k_cur, v_cur, i):
         # Which global chunk the ring has delivered to us at step i:
         # data moves j -> j+1 each hop, so after i hops we hold chunk
         # (my_idx - i) mod n.
@@ -82,9 +81,16 @@ def ring_causal_attention(
             "bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32),
             preferred_element_type=jnp.float32,
         )
-        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
-        return (k_next, v_next, acc_new, m_new, l_new), None
+        return acc_new, m_new, l_new
+
+    def step(carry, i):
+        # Permute FIRST: the local (i=0) block is folded before the scan,
+        # so every hop's transfer is consumed — no wasted final ppermute.
+        k_cur, v_cur, acc, m, l = carry
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        acc, m, l = fold(acc, m, l, k_cur, v_cur, i)
+        return (k_cur, v_cur, acc, m, l), None
 
     # Initial carries must carry the same varying-manual-axes type as the
     # loop outputs (shard_map VMA typing) — mark them varying over every
@@ -97,8 +103,9 @@ def ring_causal_attention(
     acc0 = varying(jnp.zeros((b, h, s_loc, d), jnp.float32))
     m0 = varying(jnp.full((b, h, s_loc, 1), _NEG_INF, jnp.float32))
     l0 = varying(jnp.zeros((b, h, s_loc, 1), jnp.float32))
+    acc0, m0, l0 = fold(acc0, m0, l0, k, v, 0)
     (_, _, acc, _, l), _ = jax.lax.scan(
-        step, (k, v, acc0, m0, l0), jnp.arange(axis_size)
+        step, (k, v, acc0, m0, l0), jnp.arange(1, axis_size)
     )
     out = acc / l  # (b, h, s_loc, d)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)

@@ -50,9 +50,12 @@ def data_axes(mesh: Mesh) -> tuple:
     return tuple(a for a in mesh.axis_names if a in ("data", "fsdp"))
 
 
-def default_zero_axis(mesh: Mesh) -> str:
-    """ZeRO shards state over ``fsdp`` when the mesh has one, else ``data``."""
-    return "fsdp" if "fsdp" in mesh.axis_names else "data"
+def default_zero_axis(mesh: Mesh) -> Optional[str]:
+    """ZeRO shards state over ``fsdp`` when the mesh has one, else ``data``;
+    ``None`` on a pure model-parallel mesh (nothing to ZeRO-shard over)."""
+    if "fsdp" in mesh.axis_names:
+        return "fsdp"
+    return "data" if "data" in mesh.axis_names else None
 
 
 def batch_sharding(mesh: Mesh, axis=None) -> NamedSharding:
@@ -91,7 +94,11 @@ def zero_state_shardings(
 
     Works on abstract (ShapeDtypeStruct) or concrete pytrees.
     """
-    axis_size = mesh.shape[shard_axis]
+    if shard_axis not in mesh.axis_names:
+        zero_stage = 0  # no batch-parallel axis to shard state over
+        axis_size = 1
+    else:
+        axis_size = mesh.shape[shard_axis]
 
     def leaf_sharding(leaf, shard_it: bool) -> NamedSharding:
         shape = tuple(getattr(leaf, "shape", ()) or ())
@@ -187,7 +194,11 @@ def state_shardings_for_module(
         )
 
     zero_axis = default_zero_axis(mesh)
-    axis_size = mesh.shape[zero_axis]
+    if zero_axis is None:
+        zero_stage = 0  # pure model-parallel mesh: TP specs only
+        axis_size = 1
+    else:
+        axis_size = mesh.shape[zero_axis]
     spec_fn = getattr(module, "param_partition_specs", None)
     if spec_fn is not None:
         param_specs = jax.tree_util.tree_map(

@@ -101,6 +101,15 @@ def build_train_step(
     if mode == "shard_map":
         from jax import shard_map
 
+        # Shard the batch over every batch-parallel axis the mesh actually
+        # has (matching make_global_batch), not a hard-coded "data".
+        batch_axes = shardlib.data_axes(mesh)
+        if not batch_axes:
+            raise ValueError(
+                "shard_map mode needs a data/fsdp mesh axis to shard the "
+                f"batch over; mesh axes = {mesh.axis_names}"
+            )
+        data_axis = batch_axes if len(batch_axes) > 1 else batch_axes[0]
         repl_spec = P()
         batch_spec = P(data_axis)
 
@@ -153,6 +162,14 @@ def build_eval_step(
 
     if mode == "shard_map":
         from jax import shard_map
+
+        batch_axes = shardlib.data_axes(mesh)
+        if not batch_axes:
+            raise ValueError(
+                "shard_map mode needs a data/fsdp mesh axis to shard the "
+                f"batch over; mesh axes = {mesh.axis_names}"
+            )
+        data_axis = batch_axes if len(batch_axes) > 1 else batch_axes[0]
 
         def per_device(params, batch):
             logs = dict(step_method(params, batch))
