@@ -270,3 +270,15 @@ class DeviceStatsCallback(Callback):
         if self.peak_memories:
             out["avg_peak_memory_bytes"] = float(np.mean(self.peak_memories))
         return out
+
+    # State round-trips worker→driver (loop.py "callback_states") so the
+    # driver-side object can report summary() after a remote fit.
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "epoch_times": list(self.epoch_times),
+            "peak_memories": list(self.peak_memories),
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.epoch_times = list(state.get("epoch_times", []))
+        self.peak_memories = list(state.get("peak_memories", []))

@@ -1,6 +1,7 @@
 from .boring import BoringModel, BoringDataModule, XORModel, XORDataModule
 from .gpt import GPT, GPTConfig, SyntheticLMDataModule
 from .mnist import MNISTClassifier, MNISTDataModule
+from .resnet import ResNet, CIFARDataModule
 
 __all__ = [
     "BoringModel",
@@ -12,4 +13,6 @@ __all__ = [
     "GPT",
     "GPTConfig",
     "SyntheticLMDataModule",
+    "ResNet",
+    "CIFARDataModule",
 ]

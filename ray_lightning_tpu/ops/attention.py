@@ -67,12 +67,16 @@ def _flash_supported(q: jax.Array) -> bool:
     if platform != "tpu":
         return False
     _, s, _, d = q.shape
-    # Kernel constraints: seq divisible by its q/k block, head_dim lane-able.
     from ray_lightning_tpu.ops import flash_attention as fa
 
-    # Mirror the dispatch target's actual constraint: flash_attention uses
-    # block = min(DEFAULT_BLOCK, s), so short sequences still qualify.
-    return s % min(fa.DEFAULT_BLOCK_Q, s) == 0 and d in (64, 128, 256)
+    # Kernel constraints: the effective block is min(DEFAULT_BLOCK, s), so
+    # seq must divide into it AND the block itself must be a multiple of
+    # the dtype's TPU sublane tile (8 rows for f32, 16 for bf16) — a short
+    # unaligned s (e.g. 100, or 120 in bf16) would otherwise become its own
+    # unaligned block and fail Mosaic lowering.
+    tile = 16 if q.dtype == jnp.bfloat16 else 8
+    block = min(fa.DEFAULT_BLOCK_Q, s)
+    return s % block == 0 and block % tile == 0 and d in (64, 128, 256)
 
 
 def causal_attention(

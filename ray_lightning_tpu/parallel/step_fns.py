@@ -57,7 +57,6 @@ def build_train_step(
     mode: str = "gspmd",
     zero_stage: int = 0,
     state_shardings: Optional[Any] = None,
-    data_axis: str = "data",
 ) -> Callable[[TrainState, Any, jax.Array], Tuple[TrainState, dict]]:
     """Compile one optimizer step over the mesh.
 
@@ -150,7 +149,6 @@ def build_eval_step(
     kind: str = "validation",
     mode: str = "gspmd",
     params_shardings: Optional[Any] = None,
-    data_axis: str = "data",
 ) -> Callable[[Any, Any], dict]:
     """Compile one metric-producing eval step: ``(params, batch) -> logs``."""
     step_method = (
