@@ -1,9 +1,11 @@
 from .actor import ProcessActor, RemoteError, ActorDiedError
+from .agent import NodeAgent, AgentClient, AgentError
 from .queue import DriverQueue, QueueHandle
 from .backend import (
     ObjectRef,
     ClusterBackend,
     LocalBackend,
+    RemoteBackend,
     RayBackend,
     get_backend,
     ray_is_available,
@@ -14,11 +16,15 @@ __all__ = [
     "ProcessActor",
     "RemoteError",
     "ActorDiedError",
+    "NodeAgent",
+    "AgentClient",
+    "AgentError",
     "DriverQueue",
     "QueueHandle",
     "ObjectRef",
     "ClusterBackend",
     "LocalBackend",
+    "RemoteBackend",
     "RayBackend",
     "get_backend",
     "ray_is_available",

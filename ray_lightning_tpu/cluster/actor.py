@@ -157,8 +157,63 @@ def _child_main() -> None:
     sys.exit(0)
 
 
+def build_child_env(env: Dict[str, str]) -> Dict[str, str]:
+    """Child environment = this process's env + overrides + import paths.
+
+    Mirror the spawning process's import environment: cloudpickle
+    serializes functions from importable modules *by reference*, so
+    anything the driver can import (the user's project, this package from a
+    source checkout, pytest-rootdir test modules) must be importable in the
+    child too.  '' means cwd on sys.path; make that explicit.  Called on
+    the host that actually spawns — the driver for local actors, the node
+    agent for remote ones (whose sys.path, not the driver's, is what
+    exists on that host).
+    """
+    child_env = dict(os.environ)
+    child_env.update({k: str(v) for k, v in env.items()})
+    spawner_path = [p if p else os.getcwd() for p in sys.path]
+    pp = child_env.get("PYTHONPATH", "")
+    extra = [p for p in pp.split(os.pathsep) if p and p not in spawner_path]
+    child_env["PYTHONPATH"] = os.pathsep.join(spawner_path + extra)
+    return child_env
+
+
+def spawn_child(
+    connect_host: str, port: int, authkey_hex: str, env: Dict[str, str]
+) -> subprocess.Popen:
+    """Start one actor child that dials ``connect_host:port`` and
+    authenticates with ``authkey_hex`` (fed via stdin, never argv)."""
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         "from ray_lightning_tpu.cluster.actor import _child_main; "
+         "_child_main()",
+         connect_host, str(port)],
+        stdin=subprocess.PIPE,
+        env=build_child_env(env),
+    )
+    assert proc.stdin is not None
+    proc.stdin.write(authkey_hex.encode() + b"\n")
+    proc.stdin.flush()
+    return proc
+
+
+def _local_launcher(
+    connect_host: str, port: int, authkey_hex: str,
+    env: Dict[str, str], name: str,
+):
+    return spawn_child(connect_host, port, authkey_hex, env)
+
+
 class ProcessActor:
-    """A worker subprocess with a generic ``execute`` RPC (≙ ``RayExecutor``)."""
+    """A worker subprocess with a generic ``execute`` RPC (≙ ``RayExecutor``).
+
+    ``launcher`` abstracts *where* the child process starts: the default
+    spawns it on this host; :func:`..agent.agent_launcher` asks a remote
+    node agent to spawn it on another host, with the child dialing back to
+    this driver over TCP.  ``bind_host``/``advertise_host`` follow the
+    queue's pattern: bind loopback for local children, ``0.0.0.0`` + the
+    routable NIC address for remote ones.
+    """
 
     _ids = itertools.count()
 
@@ -167,6 +222,9 @@ class ProcessActor:
         name: Optional[str] = None,
         env: Optional[Dict[str, str]] = None,
         startup_timeout_s: float = 120.0,
+        launcher: Optional[Callable[..., Any]] = None,
+        bind_host: str = "127.0.0.1",
+        advertise_host: Optional[str] = None,
     ):
         self.name = name or f"rlt-actor-{next(self._ids)}"
         self._env = dict(env or {})
@@ -174,33 +232,18 @@ class ProcessActor:
 
         server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        server.bind(("127.0.0.1", 0))
+        server.bind((bind_host, 0))
         server.listen(1)
         host, port = server.getsockname()
+        connect_host = advertise_host or host
 
-        child_env = dict(os.environ)
-        child_env.update({k: str(v) for k, v in self._env.items()})
-        # Mirror the driver's import environment: cloudpickle serializes
-        # functions from importable modules *by reference*, so anything the
-        # driver can import (the user's project, this package from a source
-        # checkout, pytest-rootdir test modules) must be importable in the
-        # child too.  '' means cwd on sys.path; make that explicit.
-        driver_path = [p if p else os.getcwd() for p in sys.path]
-        pp = child_env.get("PYTHONPATH", "")
-        extra = [p for p in pp.split(os.pathsep) if p and p not in driver_path]
-        child_env["PYTHONPATH"] = os.pathsep.join(driver_path + extra)
-
-        self._proc = subprocess.Popen(
-            [sys.executable, "-c",
-             "from ray_lightning_tpu.cluster.actor import _child_main; "
-             "_child_main()",
-             host, str(port)],
-            stdin=subprocess.PIPE,
-            env=child_env,
-        )
-        assert self._proc.stdin is not None
-        self._proc.stdin.write(authkey.hex().encode() + b"\n")
-        self._proc.stdin.flush()
+        try:
+            self._proc = (launcher or _local_launcher)(
+                connect_host, port, authkey.hex(), self._env, self.name
+            )
+        except BaseException:
+            server.close()
+            raise
 
         # Accept with timeout + child liveness polling — a child that dies
         # during startup must surface as ActorDiedError, never a hang.

@@ -1,0 +1,304 @@
+"""Node agent: remote-host worker launch — the laptop-driver property.
+
+The reference gets two things from Ray that the local backend alone cannot
+provide: scheduling actors onto *other* machines, and driving a cluster
+from a workstation that is not part of it (Ray Client, reference
+``README.md:82-95``, ``tests/test_client*.py``).  This module supplies
+both with one small daemon:
+
+* **NodeAgent** — runs on every TPU host (``python -m
+  ray_lightning_tpu.cluster.agent --port 7077``).  It accepts
+  token-authenticated driver connections and spawns/kills actor child
+  processes on its host.  The children dial the *driver* back directly
+  (the same length-prefixed-cloudpickle RPC as local actors), so the
+  agent is control-plane-only: zero bytes of task traffic flow through it.
+* **AgentClient** — the driver side: one persistent connection per host,
+  multiplexing spawn/poll/kill requests.
+* **agent_launcher** — adapts an AgentClient into the ``launcher``
+  callable of :class:`.actor.ProcessActor`, so a remote actor is the same
+  object as a local one from the strategy layer's point of view.
+
+Trust model matches Ray's: a shared secret (``--token`` /
+``RLT_AGENT_TOKEN``) gates the agent, and payloads are cloudpickle —
+agents must only listen on cluster-internal networks.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hmac
+import os
+import socket
+import subprocess
+import threading
+import traceback
+from typing import Any, Dict, Optional, Tuple
+
+from . import rpc
+
+__all__ = [
+    "NodeAgent",
+    "AgentClient",
+    "AgentError",
+    "agent_launcher",
+    "DEFAULT_AGENT_PORT",
+]
+
+DEFAULT_AGENT_PORT = 7077
+
+
+class AgentError(RuntimeError):
+    """A node agent refused or failed a request."""
+
+
+# ---------------------------------------------------------------------------
+# Server side
+# ---------------------------------------------------------------------------
+
+class NodeAgent:
+    """Per-host spawn daemon (see module docstring)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = DEFAULT_AGENT_PORT,
+                 token: Optional[str] = None):
+        self._token = (token if token is not None
+                       else os.environ.get("RLT_AGENT_TOKEN", ""))
+        # An agent executes arbitrary pickled callables for whoever
+        # authenticates; an empty token on a non-loopback bind would be
+        # unauthenticated remote code execution.  Refuse loudly.
+        if not self._token and not host.startswith("127."):
+            raise ValueError(
+                "NodeAgent on a non-loopback interface requires a token "
+                "(--token or RLT_AGENT_TOKEN): it spawns arbitrary code "
+                "for authenticated peers."
+            )
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host, port))
+        self._server.listen(16)
+        self.port = self._server.getsockname()[1]
+        self._procs: Dict[int, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+        self._closed = False
+        self._accept_thread: Optional[threading.Thread] = None
+
+    # -- request handlers ---------------------------------------------------
+    def _handle(self, msg: Tuple) -> Tuple:
+        kind = msg[0]
+        if kind == "ping":
+            return ("ok", {"ip": rpc.get_node_ip(),
+                           "pid_count": len(self._procs)})
+        if kind == "spawn":
+            from .actor import spawn_child
+
+            _, spec = msg
+            proc = spawn_child(
+                spec["connect_host"], spec["port"], spec["authkey_hex"],
+                spec.get("env") or {},
+            )
+            with self._lock:
+                self._procs[proc.pid] = proc
+            return ("ok", proc.pid)
+        if kind == "poll":
+            _, pid = msg
+            with self._lock:
+                proc = self._procs.get(pid)
+            if proc is None:
+                return ("ok", -1)  # unknown pid ≙ long dead
+            code = proc.poll()
+            return ("ok", code)
+        if kind == "kill":
+            _, pid, grace_s = msg
+            with self._lock:
+                proc = self._procs.pop(pid, None)
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(grace_s)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+            return ("ok", None)
+        return ("err", f"unknown agent request {kind!r}")
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # Pre-auth frame: cap the length so an unauthenticated peer
+            # cannot claim a multi-GiB payload and exhaust agent memory.
+            presented = rpc.recv_frame(conn, max_len=1024).decode()
+            if not hmac.compare_digest(presented, self._token):
+                rpc.send_obj(conn, ("err", "bad token"))
+                return
+            rpc.send_obj(conn, ("ok", None))
+            while not self._closed:
+                msg = rpc.loads(rpc.recv_frame(conn))
+                if msg[0] == "bye":
+                    return
+                try:
+                    out = self._handle(msg)
+                except Exception:  # noqa: BLE001 - report, keep serving
+                    out = ("err", traceback.format_exc())
+                rpc.send_obj(conn, out)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def start(self) -> None:
+        """Serve in a background thread (tests / embedded use)."""
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="rlt-agent-accept", daemon=True
+        )
+        self._accept_thread.start()
+
+    def serve_forever(self) -> None:
+        self._accept_loop()
+
+    def shutdown(self) -> None:
+        self._closed = True
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        with self._lock:
+            procs, self._procs = dict(self._procs), {}
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+
+
+# ---------------------------------------------------------------------------
+# Driver side
+# ---------------------------------------------------------------------------
+
+class AgentClient:
+    """One persistent, lock-protected connection to a host's NodeAgent."""
+
+    def __init__(self, address: str, token: Optional[str] = None,
+                 timeout_s: float = 30.0):
+        if ":" in address:
+            host, port_s = address.rsplit(":", 1)
+            port = int(port_s)
+        else:
+            host, port = address, DEFAULT_AGENT_PORT
+        self.host = host
+        self.port = port
+        token = (token if token is not None
+                 else os.environ.get("RLT_AGENT_TOKEN", ""))
+        self._sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+        rpc.send_frame(self._sock, token.encode())
+        status, payload = rpc.recv_obj(self._sock)
+        if status != "ok":
+            self._sock.close()
+            raise AgentError(f"Agent {address}: {payload}")
+
+    def _request(self, msg: Tuple) -> Any:
+        with self._lock:
+            rpc.send_obj(self._sock, msg)
+            status, payload = rpc.recv_obj(self._sock)
+        if status != "ok":
+            raise AgentError(f"Agent {self.host}:{self.port}: {payload}")
+        return payload
+
+    def ping(self) -> Dict[str, Any]:
+        return self._request(("ping",))
+
+    def spawn(self, connect_host: str, port: int, authkey_hex: str,
+              env: Dict[str, str]) -> int:
+        return self._request(("spawn", {
+            "connect_host": connect_host, "port": port,
+            "authkey_hex": authkey_hex, "env": env,
+        }))
+
+    def poll(self, pid: int) -> Optional[int]:
+        return self._request(("poll", pid))
+
+    def kill(self, pid: int, grace_s: float = 5.0) -> None:
+        self._request(("kill", pid, grace_s))
+
+    def close(self) -> None:
+        try:
+            with self._lock:
+                rpc.send_obj(self._sock, ("bye",))
+        except (OSError, ValueError):
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _RemoteProcHandle:
+    """Popen-shaped handle over an agent-spawned child, so ProcessActor's
+    startup/teardown code is identical for local and remote actors."""
+
+    def __init__(self, client: AgentClient, pid: int):
+        self._client = client
+        self.pid = pid
+        self.returncode: Optional[int] = None
+
+    def poll(self) -> Optional[int]:
+        if self.returncode is not None:
+            return self.returncode
+        try:
+            self.returncode = self._client.poll(self.pid)
+        except (AgentError, ConnectionError, OSError):
+            self.returncode = -1  # agent gone ⇒ treat child as dead
+        return self.returncode
+
+    def terminate(self) -> None:
+        try:
+            self._client.kill(self.pid)
+        except (AgentError, ConnectionError, OSError):
+            pass
+        if self.returncode is None:
+            self.returncode = -15
+
+    kill = terminate
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        # kill() on the agent already waited through the grace period.
+        code = self.poll()
+        return code if code is not None else 0
+
+
+def agent_launcher(client: AgentClient):
+    """Adapt an AgentClient into a ProcessActor ``launcher``."""
+
+    def launch(connect_host: str, port: int, authkey_hex: str,
+               env: Dict[str, str], name: str) -> _RemoteProcHandle:
+        pid = client.spawn(connect_host, port, authkey_hex, env)
+        return _RemoteProcHandle(client, pid)
+
+    return launch
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        description="ray_lightning_tpu node agent (run one per TPU host)")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=DEFAULT_AGENT_PORT)
+    parser.add_argument("--token", default=None,
+                        help="shared secret (default: $RLT_AGENT_TOKEN)")
+    args = parser.parse_args(argv)
+    agent = NodeAgent(host=args.host, port=args.port, token=args.token)
+    print(f"[rlt-agent] listening on {args.host}:{agent.port}", flush=True)
+    try:
+        agent.serve_forever()
+    except KeyboardInterrupt:
+        agent.shutdown()
+
+
+if __name__ == "__main__":
+    main()

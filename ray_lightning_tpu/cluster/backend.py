@@ -26,7 +26,7 @@ copy, exactly the ``ray.put(model)`` / implicit-get dance of reference
 from __future__ import annotations
 
 import os
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Union
 
 from . import rpc
 from .actor import ProcessActor
@@ -36,6 +36,7 @@ __all__ = [
     "ObjectRef",
     "ClusterBackend",
     "LocalBackend",
+    "RemoteBackend",
     "RayBackend",
     "get_backend",
     "ray_is_available",
@@ -128,6 +129,68 @@ class LocalBackend(ClusterBackend):
             except Exception:  # noqa: BLE001 - best-effort teardown
                 pass
         self._actors.clear()
+
+
+class RemoteBackend(ClusterBackend):
+    """Multi-host control plane over node agents — the "infinite laptop".
+
+    ≙ Ray Client + multi-node scheduling in the reference (``README.md:
+    82-95``): the driver (a workstation or a CPU-only coordinator VM) holds
+    one :class:`.agent.AgentClient` per TPU host and places actors
+    round-robin across them.  Actors dial the driver back directly, and
+    the distributed queue binds all interfaces — so the only topology
+    requirement is driver↔host TCP reachability, exactly Ray Client's.
+
+    ``hosts``: list of ``"ip[:port]"`` agent addresses (or the
+    ``RLT_HOSTS`` env var, comma-separated, via :func:`get_backend`).
+    """
+
+    def __init__(self, hosts: List[str], token: Optional[str] = None):
+        from .agent import AgentClient
+
+        if not hosts:
+            raise ValueError("RemoteBackend needs at least one agent host")
+        self._clients = [AgentClient(h, token=token) for h in hosts]
+        self._rr = 0
+        self._actors: List[ProcessActor] = []
+
+    def create_actor(
+        self,
+        name: str,
+        env: Optional[Dict[str, str]] = None,
+        num_cpus: float = 1,
+        resources: Optional[Dict[str, float]] = None,
+    ) -> ProcessActor:
+        from .agent import agent_launcher
+
+        client = self._clients[self._rr % len(self._clients)]
+        self._rr += 1
+        actor = ProcessActor(
+            name=name,
+            env=env,
+            launcher=agent_launcher(client),
+            bind_host="0.0.0.0",
+            advertise_host=rpc.get_node_ip(),
+        )
+        self._actors.append(actor)
+        return actor
+
+    def put(self, obj: Any) -> ObjectRef:
+        return ObjectRef.from_object(obj)
+
+    def create_queue(self) -> DriverQueue:
+        return DriverQueue(host="0.0.0.0", advertise_host=rpc.get_node_ip())
+
+    def shutdown(self) -> None:
+        for a in self._actors:
+            try:
+                a.kill()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass
+        self._actors.clear()
+        for c in self._clients:
+            c.close()
+        self._clients = []
 
 
 class _RayActorAdapter:
@@ -256,12 +319,19 @@ class RayBackend(ClusterBackend):
         self._actors.clear()
 
 
-def get_backend(name: Optional[str] = None) -> ClusterBackend:
+def get_backend(
+    name: Union[str, ClusterBackend, None] = None,
+) -> ClusterBackend:
     """Select the control plane.
 
-    Priority: explicit ``name`` > ``RLT_BACKEND`` env var > ``local``.
-    ``name="ray"`` requires Ray to be installed.
+    ``name`` may be a ClusterBackend instance (used as-is — how a
+    configured :class:`RemoteBackend` is passed through a strategy), or a
+    string: priority explicit ``name`` > ``RLT_BACKEND`` env var >
+    ``local``.  ``"remote"`` reads agent addresses from ``RLT_HOSTS``;
+    ``"ray"`` requires Ray installed.
     """
+    if isinstance(name, ClusterBackend):
+        return name
     name = name or os.environ.get("RLT_BACKEND", "local")
     if name == "ray":
         if not ray_is_available():
@@ -270,6 +340,11 @@ def get_backend(name: Optional[str] = None) -> ClusterBackend:
                 "falling back is disabled to avoid silent behavior changes."
             )
         return RayBackend()
+    if name == "remote":
+        hosts = [h for h in os.environ.get("RLT_HOSTS", "").split(",") if h]
+        return RemoteBackend(hosts)
     if name == "local":
         return LocalBackend()
-    raise ValueError(f"Unknown cluster backend {name!r} (expected local|ray)")
+    raise ValueError(
+        f"Unknown cluster backend {name!r} (expected local|remote|ray)"
+    )

@@ -51,8 +51,14 @@ def recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def recv_frame(sock: socket.socket) -> bytes:
+def recv_frame(sock: socket.socket, max_len: int = 0) -> bytes:
+    """Receive one frame; ``max_len`` (if nonzero) rejects oversized
+    claims before any allocation — used on pre-authentication reads."""
     (length,) = _LEN.unpack(recv_exact(sock, _LEN.size))
+    if max_len and length > max_len:
+        raise ConnectionError(
+            f"frame of {length} bytes exceeds limit {max_len}"
+        )
     return recv_exact(sock, length)
 
 

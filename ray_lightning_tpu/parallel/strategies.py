@@ -218,6 +218,11 @@ class TpuStrategy:
         reference ``ray_ddp.py:191-195``)."""
         if self._workers:
             return
+        # A backend *instance* stays owned by the caller (it may span
+        # several trainers); teardown only shuts down backends we built.
+        self._owns_backend = not isinstance(
+            self.backend_name, backend_mod.ClusterBackend
+        )
         self._backend = backend_mod.get_backend(self.backend_name)
         for i in range(self.num_workers):
             worker = self._backend.create_actor(
@@ -295,7 +300,14 @@ class TpuStrategy:
     def teardown(self) -> None:
         """Kill workers (≙ ``post_dispatch`` teardown, ``ray_ddp.py:398-401``)."""
         if self._backend is not None:
-            self._backend.shutdown()
+            if getattr(self, "_owns_backend", True):
+                self._backend.shutdown()
+            else:
+                for w in self._workers:
+                    try:
+                        w.kill()
+                    except Exception:  # noqa: BLE001 - best-effort teardown
+                        pass
         self._workers = []
         self._backend = None
 
