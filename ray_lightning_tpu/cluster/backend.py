@@ -53,28 +53,51 @@ def ray_is_available() -> bool:
 
 
 class ObjectRef:
-    """A by-value object reference (≙ ``ray.ObjectRef``).
+    """An object reference (≙ ``ray.ObjectRef``), by value or by segment.
 
     Serialization happens exactly once at ``put`` time; each ``get`` call
     deserializes a fresh copy (so workers never alias driver state — the
-    property the reference gets from Ray's object store).
+    property the reference gets from Ray's object store).  Large payloads
+    on a single-host backend travel *by segment*: the bytes live in one
+    checksummed tmpfs segment (:mod:`..cluster.shm`, the plasma analogue)
+    and only the path crosses the actor sockets — N local workers cost one
+    write + N page-cache reads instead of N socket copies.
     """
 
-    __slots__ = ("_payload",)
+    __slots__ = ("_payload", "_segment_path", "_nbytes")
 
-    def __init__(self, payload: bytes):
+    def __init__(self, payload: Optional[bytes] = None,
+                 segment_path: Optional[str] = None, nbytes: int = 0):
         self._payload = payload
+        self._segment_path = segment_path
+        self._nbytes = len(payload) if payload is not None else nbytes
 
     @classmethod
     def from_object(cls, obj: Any) -> "ObjectRef":
-        return cls(rpc.dumps(obj))
+        return cls(payload=rpc.dumps(obj))
+
+    @classmethod
+    def from_object_via_store(
+        cls, obj: Any, store, min_segment_bytes: int
+    ) -> "ObjectRef":
+        """Spill to a segment when the payload is worth it; the caller
+        guarantees every reader shares the store's host."""
+        payload = rpc.dumps(obj)
+        if len(payload) < min_segment_bytes:
+            return cls(payload=payload)
+        path = store.put(payload)
+        return cls(segment_path=path, nbytes=len(payload))
 
     def get(self) -> Any:
+        if self._segment_path is not None:
+            from .shm import SegmentStore
+
+            return rpc.loads(SegmentStore.get(self._segment_path))
         return rpc.loads(self._payload)
 
     @property
     def nbytes(self) -> int:
-        return len(self._payload)
+        return self._nbytes
 
 
 class ClusterBackend:
@@ -100,10 +123,23 @@ class ClusterBackend:
 
 
 class LocalBackend(ClusterBackend):
-    """Process actors on the local host (spawn)."""
+    """Process actors on the local host (spawn).
 
-    def __init__(self):
+    All readers share this host, so ``put`` spills payloads above
+    ``min_segment_bytes`` (default 1 MiB, ``RLT_SEGMENT_MIN_BYTES``) into
+    the shared-memory segment store instead of the RPC stream.
+    """
+
+    def __init__(self, min_segment_bytes: Optional[int] = None):
+        from .shm import SegmentStore
+
         self._actors: List[ProcessActor] = []
+        self._store = SegmentStore()
+        self.min_segment_bytes = (
+            min_segment_bytes
+            if min_segment_bytes is not None
+            else int(os.environ.get("RLT_SEGMENT_MIN_BYTES", 1 << 20))
+        )
 
     def create_actor(
         self,
@@ -117,7 +153,9 @@ class LocalBackend(ClusterBackend):
         return actor
 
     def put(self, obj: Any) -> ObjectRef:
-        return ObjectRef.from_object(obj)
+        return ObjectRef.from_object_via_store(
+            obj, self._store, self.min_segment_bytes
+        )
 
     def create_queue(self) -> DriverQueue:
         return DriverQueue()
@@ -129,6 +167,7 @@ class LocalBackend(ClusterBackend):
             except Exception:  # noqa: BLE001 - best-effort teardown
                 pass
         self._actors.clear()
+        self._store.unlink_all()
 
 
 class RemoteBackend(ClusterBackend):

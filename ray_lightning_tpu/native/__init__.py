@@ -1,0 +1,248 @@
+"""Native runtime bindings (C++ via ctypes) with pure-Python fallback.
+
+The reference's runtime substrate is Ray core's C++ (raylet + plasma
+object store, SURVEY §2.2); this package is the TPU build's native layer
+for the host-side data path: checksummed write-once/read-many payload
+segments (see ``src/rlt_native.cc`` for the on-disk format) plus fast
+CRC32C.  The library is compiled on first use with the system ``g++``
+(no pip deps) and cached next to the source; when no compiler is
+available every entry point transparently falls back to pure Python
+writing the *identical* format, so the control plane never hard-depends
+on the toolchain (the ``Unavailable`` degradation pattern, reference
+``util.py:40-44``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+import zlib
+from typing import Optional, Tuple
+
+__all__ = [
+    "native_available",
+    "crc32c",
+    "crc32c_is_hw",
+    "write_segment",
+    "read_segment",
+    "segment_len",
+    "SEGMENT_HEADER_SIZE",
+]
+
+_MAGIC = b"RLTSEG1\0"
+_ALGO_CRC32C = 1
+_ALGO_ZLIB = 2
+SEGMENT_HEADER_SIZE = 32
+_HEADER = struct.Struct("<8sQII8x")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+
+def _src_dir() -> str:
+    return os.path.join(os.path.dirname(__file__), "src")
+
+
+def _so_path() -> str:
+    return os.path.join(_src_dir(), "librlt_native.so")
+
+
+def _build() -> Optional[str]:
+    """Compile the library if missing/stale; None when impossible."""
+    src = os.path.join(_src_dir(), "rlt_native.cc")
+    out = _so_path()
+    if not os.path.exists(src):
+        return None
+    if (os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)):
+        return out
+    tmp = out + f".tmp{os.getpid()}"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           "-o", tmp, src]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, timeout=120,
+        )
+        os.replace(tmp, out)  # atomic: concurrent builders race safely
+        return out
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_tried:
+            return _lib
+        _lib_tried = True
+        if os.environ.get("RLT_DISABLE_NATIVE"):
+            return None
+        path = _build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            return None
+        lib.rlt_crc32c.restype = ctypes.c_uint32
+        lib.rlt_crc32c.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint32]
+        lib.rlt_crc32c_is_hw.restype = ctypes.c_int
+        lib.rlt_write_segment.restype = ctypes.c_int
+        lib.rlt_write_segment.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint32)]
+        lib.rlt_segment_len.restype = ctypes.c_int64
+        lib.rlt_segment_len.argtypes = [ctypes.c_char_p]
+        lib.rlt_read_segment.restype = ctypes.c_int
+        lib.rlt_read_segment.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def crc32c_is_hw() -> bool:
+    lib = _load()
+    return bool(lib and lib.rlt_crc32c_is_hw())
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    """CRC32C when native; callers needing a concrete algo tag should use
+    the (checksum, algo) pair from :func:`_checksum`."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable (CRC32C needs it)")
+    return lib.rlt_crc32c(data, len(data), crc)
+
+
+def _checksum(data: bytes) -> Tuple[int, int]:
+    lib = _load()
+    if lib is not None:
+        return lib.rlt_crc32c(data, len(data), 0), _ALGO_CRC32C
+    return zlib.crc32(data) & 0xFFFFFFFF, _ALGO_ZLIB
+
+
+class SegmentError(RuntimeError):
+    """Corrupt, truncated, or missing payload segment."""
+
+
+def write_segment(path: str, payload: bytes) -> None:
+    """Write-once segment create (fails if ``path`` exists)."""
+    lib = _load()
+    if lib is not None:
+        crc = ctypes.c_uint32(0)
+        rc = lib.rlt_write_segment(
+            path.encode(), payload, len(payload), ctypes.byref(crc))
+        if rc != 0:
+            raise SegmentError(
+                f"native write_segment({path!r}) failed: {os.strerror(-rc)}")
+        return
+    checksum, algo = _checksum(payload)
+    header = _HEADER.pack(_MAGIC, len(payload), checksum, algo)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o600)
+    try:
+        for buf in (header, payload):
+            view = memoryview(buf)
+            while view:  # os.write may be partial (~2 GiB Linux cap)
+                view = view[os.write(fd, view):]
+    finally:
+        os.close(fd)
+
+
+def _read_header(path: str) -> Tuple[int, int, int]:
+    """(payload_len, checksum, algo) — length clamped against the file
+    size so a corrupted header can't drive a huge allocation."""
+    file_len = os.stat(path).st_size
+    with open(path, "rb") as f:
+        raw = f.read(SEGMENT_HEADER_SIZE)
+    if len(raw) < SEGMENT_HEADER_SIZE:
+        raise SegmentError(f"segment {path!r}: truncated header")
+    magic, length, checksum, algo = _HEADER.unpack(raw)
+    if magic != _MAGIC:
+        raise SegmentError(f"segment {path!r}: bad magic")
+    if length > file_len - SEGMENT_HEADER_SIZE:
+        raise SegmentError(
+            f"segment {path!r}: header claims {length} payload bytes but "
+            f"the file holds {file_len - SEGMENT_HEADER_SIZE}"
+        )
+    return length, checksum, algo
+
+
+def segment_len(path: str) -> int:
+    return _read_header(path)[0]
+
+
+def read_segment(path: str, verify: bool = True) -> bytes:
+    """Read + (optionally) checksum-verify a segment's payload.
+
+    Native CRC32C segments are verified in C without the GIL; fallback
+    (zlib-tagged) segments are verified in Python — each side can read
+    the other's files, so a native driver interoperates with a
+    fallback-only worker and vice versa.
+    """
+    length, checksum, algo = _read_header(path)
+    lib = _load()
+    if lib is not None:
+        buf = ctypes.create_string_buffer(length)
+        rc = lib.rlt_read_segment(
+            path.encode(), buf, length, 1 if verify else 0)
+        if rc != 0:
+            raise SegmentError(
+                f"read_segment({path!r}) failed: {os.strerror(-rc)}")
+        payload = buf.raw[:length]
+        # Native verify covers algo-1 only; cross-check zlib-tagged files.
+        if (verify and algo == _ALGO_ZLIB
+                and (zlib.crc32(payload) & 0xFFFFFFFF) != checksum):
+            raise SegmentError(f"segment {path!r}: checksum mismatch")
+        return payload
+
+    with open(path, "rb") as f:
+        f.seek(SEGMENT_HEADER_SIZE)
+        payload = f.read(length)
+    if len(payload) != length:
+        raise SegmentError(f"segment {path!r}: truncated payload")
+    if verify:
+        if algo == _ALGO_ZLIB:
+            ok = (zlib.crc32(payload) & 0xFFFFFFFF) == checksum
+        else:
+            # CRC32C without the native lib: pure-Python table (slow but
+            # correct; only hit when driver had the lib and worker lacks it).
+            ok = _crc32c_py(payload) == checksum
+        if not ok:
+            raise SegmentError(f"segment {path!r}: checksum mismatch")
+    return payload
+
+
+_py_table = None
+
+
+def _crc32c_py(data: bytes) -> int:
+    global _py_table
+    if _py_table is None:
+        poly = 0x82F63B78
+        table = []
+        for i in range(256):
+            crc = i
+            for _ in range(8):
+                crc = (crc >> 1) ^ (poly if crc & 1 else 0)
+            table.append(crc)
+        _py_table = table
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = (crc >> 8) ^ _py_table[(crc ^ b) & 0xFF]
+    return crc ^ 0xFFFFFFFF

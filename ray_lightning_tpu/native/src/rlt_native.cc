@@ -1,0 +1,231 @@
+// Native runtime core for ray_lightning_tpu.
+//
+// The reference's control plane rides on Ray core's C++ runtime (raylet +
+// plasma shared-memory object store); this library is the TPU build's
+// native equivalent for the host-side data path:
+//
+//   * CRC32C (Castagnoli) — hardware-accelerated on SSE4.2, slicing-by-8
+//     in software — for object-store and state-stream integrity.
+//   * Segment I/O — write-once / read-many payload segments under
+//     /dev/shm (tmpfs ⇒ page-cache speed), with the checksum verified on
+//     read.  Calls run without the Python GIL (plain C ABI via ctypes),
+//     so multi-actor reads overlap with driver work.
+//
+// Segment layout (little-endian, 32-byte header):
+//   [0..8)   magic   "RLTSEG1\0"
+//   [8..16)  payload length (u64)
+//   [16..20) checksum (u32)
+//   [20..24) checksum algo (u32): 1 = CRC32C, 2 = zlib CRC32 (py fallback)
+//   [24..32) reserved
+//   [32..)   payload
+//
+// The Python wrapper (ray_lightning_tpu/native/__init__.py) writes the
+// identical format in pure Python when this library is unavailable.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x003147'45'53'54'4c'52ULL;  // "RLTSEG1\0" LE
+constexpr uint32_t kAlgoCrc32c = 1;
+constexpr uint64_t kHeaderSize = 32;
+
+struct Header {
+  uint64_t magic;
+  uint64_t payload_len;
+  uint32_t checksum;
+  uint32_t algo;
+  uint64_t reserved;
+};
+static_assert(sizeof(Header) == kHeaderSize, "header must be 32 bytes");
+
+// ---------------------------------------------------------------------------
+// CRC32C
+// ---------------------------------------------------------------------------
+
+uint32_t g_tables[8][256];
+bool g_tables_ready = false;
+
+void init_tables() {
+  constexpr uint32_t poly = 0x82f63b78u;  // reflected Castagnoli
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t crc = i;
+    for (int j = 0; j < 8; ++j)
+      crc = (crc >> 1) ^ ((crc & 1) ? poly : 0);
+    g_tables[0][i] = crc;
+  }
+  for (uint32_t i = 0; i < 256; ++i)
+    for (int t = 1; t < 8; ++t)
+      g_tables[t][i] =
+          (g_tables[t - 1][i] >> 8) ^ g_tables[0][g_tables[t - 1][i] & 0xff];
+  g_tables_ready = true;
+}
+
+uint32_t crc32c_sw(const uint8_t* p, uint64_t len, uint32_t crc) {
+  if (!g_tables_ready) init_tables();
+  crc = ~crc;
+  while (len >= 8) {
+    uint64_t word;
+    std::memcpy(&word, p, 8);
+    word ^= crc;
+    crc = g_tables[7][word & 0xff] ^ g_tables[6][(word >> 8) & 0xff] ^
+          g_tables[5][(word >> 16) & 0xff] ^ g_tables[4][(word >> 24) & 0xff] ^
+          g_tables[3][(word >> 32) & 0xff] ^ g_tables[2][(word >> 40) & 0xff] ^
+          g_tables[1][(word >> 48) & 0xff] ^ g_tables[0][word >> 56];
+    p += 8;
+    len -= 8;
+  }
+  while (len--) crc = (crc >> 8) ^ g_tables[0][(crc ^ *p++) & 0xff];
+  return ~crc;
+}
+
+#if defined(__x86_64__)
+__attribute__((target("sse4.2"))) uint32_t crc32c_hw(const uint8_t* p,
+                                                     uint64_t len,
+                                                     uint32_t crc) {
+  crc = ~crc;
+  while (len >= 8) {
+    uint64_t word;
+    std::memcpy(&word, p, 8);
+    crc = static_cast<uint32_t>(
+        __builtin_ia32_crc32di(static_cast<uint64_t>(crc), word));
+    p += 8;
+    len -= 8;
+  }
+  while (len >= 1) {
+    crc = __builtin_ia32_crc32qi(crc, *p++);
+    --len;
+  }
+  return ~crc;
+}
+
+bool have_sse42() {
+  static const bool ok = __builtin_cpu_supports("sse4.2");
+  return ok;
+}
+#endif
+
+uint32_t crc32c_dispatch(const void* data, uint64_t len, uint32_t crc) {
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+#if defined(__x86_64__)
+  if (have_sse42()) return crc32c_hw(p, len, crc);
+#endif
+  return crc32c_sw(p, len, crc);
+}
+
+int write_all(int fd, const void* buf, uint64_t len) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (len) {
+    ssize_t n = ::write(fd, p, len);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    p += n;
+    len -= static_cast<uint64_t>(n);
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Incremental CRC32C; pass 0 as the initial crc.
+uint32_t rlt_crc32c(const void* data, uint64_t len, uint32_t crc) {
+  return crc32c_dispatch(data, len, crc);
+}
+
+// 1 when the hardware CRC path is active (introspection/tests).
+int rlt_crc32c_is_hw(void) {
+#if defined(__x86_64__)
+  return have_sse42() ? 1 : 0;
+#else
+  return 0;
+#endif
+}
+
+// Write a complete segment file.  Returns 0 on success, -errno on failure.
+// On success *crc_out holds the payload CRC32C.
+int rlt_write_segment(const char* path, const void* data, uint64_t len,
+                      uint32_t* crc_out) {
+  Header hdr;
+  hdr.magic = kMagic;
+  hdr.payload_len = len;
+  hdr.checksum = crc32c_dispatch(data, len, 0);
+  hdr.algo = kAlgoCrc32c;
+  hdr.reserved = 0;
+
+  int fd = ::open(path, O_WRONLY | O_CREAT | O_EXCL, 0600);
+  if (fd < 0) return -errno;
+  int rc = write_all(fd, &hdr, sizeof(hdr));
+  if (rc == 0) rc = write_all(fd, data, len);
+  if (::close(fd) != 0 && rc == 0) rc = -errno;
+  if (rc != 0) ::unlink(path);
+  if (rc == 0 && crc_out) *crc_out = hdr.checksum;
+  return rc;
+}
+
+// Payload length of a segment, or -errno / -EBADMSG for a bad header.
+int64_t rlt_segment_len(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -errno;
+  Header hdr;
+  ssize_t n = ::read(fd, &hdr, sizeof(hdr));
+  ::close(fd);
+  if (n != static_cast<ssize_t>(sizeof(hdr)) || hdr.magic != kMagic)
+    return -EBADMSG;
+  return static_cast<int64_t>(hdr.payload_len);
+}
+
+// Read a segment's payload into out (capacity out_len).  verify != 0
+// checks the stored CRC32C (only for algo 1 segments; algo 2 segments are
+// verified by the Python side).  Returns 0, -errno, -EBADMSG on a corrupt
+// header/checksum, or -ENOSPC when out_len is too small.
+int rlt_read_segment(const char* path, void* out, uint64_t out_len,
+                     int verify) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -errno;
+
+  struct stat st;
+  if (::fstat(fd, &st) != 0) {
+    int e = -errno;
+    ::close(fd);
+    return e;
+  }
+  uint64_t file_len = static_cast<uint64_t>(st.st_size);
+  if (file_len < kHeaderSize) {
+    ::close(fd);
+    return -EBADMSG;
+  }
+  void* mapped = ::mmap(nullptr, file_len, PROT_READ, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mapped == MAP_FAILED) return -errno;
+
+  const Header* hdr = static_cast<const Header*>(mapped);
+  const uint8_t* payload = static_cast<const uint8_t*>(mapped) + kHeaderSize;
+  int rc = 0;
+  if (hdr->magic != kMagic || hdr->payload_len > file_len - kHeaderSize) {
+    rc = -EBADMSG;
+  } else if (hdr->payload_len > out_len) {
+    rc = -ENOSPC;
+  } else {
+    if (verify && hdr->algo == kAlgoCrc32c &&
+        crc32c_dispatch(payload, hdr->payload_len, 0) != hdr->checksum) {
+      rc = -EBADMSG;
+    } else {
+      std::memcpy(out, payload, hdr->payload_len);
+    }
+  }
+  ::munmap(mapped, file_len);
+  return rc;
+}
+
+}  // extern "C"
