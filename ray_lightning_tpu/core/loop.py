@@ -54,6 +54,11 @@ class FitConfig:
     default_root_dir: str = "."
     resume_from_checkpoint: Optional[str] = None
     fast_dev_run: bool = False
+    # Elastic-restart support (strategy-managed): when set, every
+    # ``restart_every_n_epochs`` the loop writes a topology-independent
+    # checkpoint here so the strategy can respawn dead workers and resume.
+    restart_dir: Optional[str] = None
+    restart_every_n_epochs: int = 1
 
     def __post_init__(self):
         if self.fast_dev_run:
@@ -267,6 +272,12 @@ def run_fit(
         start_epoch = payload["epoch"] + 1
         ctx.global_step = payload["global_step"]
         ctx.callback_metrics.update(payload.get("callback_metrics", {}))
+        # Stateful callbacks (EarlyStopping patience, ModelCheckpoint
+        # best-score/path, …) continue rather than reset on resume.
+        for cb, cb_state in zip(
+            callbacks, payload.get("callback_states", [])
+        ):
+            cb.load_state_dict(cb_state)
     ctx.state = state
 
     params_shardings = (
@@ -338,6 +349,22 @@ def run_fit(
             _call_hooks(callbacks, "on_validation_epoch_end", ctx, module)
 
         _call_hooks(callbacks, "on_train_epoch_end", ctx, module)
+
+        # Elastic-restart checkpoint (collective gather, rank-0 write):
+        # bounds lost work to restart_every_n_epochs on a worker failure.
+        if (
+            config.restart_dir
+            and (epoch + 1) % config.restart_every_n_epochs == 0
+        ):
+            payload = ctx.checkpoint_payload(
+                {"callback_states": [cb.state_dict() for cb in callbacks]}
+            )
+            if ctx.is_global_zero:
+                path = os.path.join(
+                    config.restart_dir, f"restart-epoch-{epoch:06d}.ckpt"
+                )
+                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+                state_stream_to_file(to_state_stream(payload), path)
 
         # Stream per-epoch metrics to the driver (live callback_metrics on
         # the driver trainer — extends the reference, which only streamed

@@ -29,12 +29,17 @@ Flavor map (≙ the reference's three plugins):
 
 from __future__ import annotations
 
+import dataclasses
 import os
+import shutil
+import uuid
+import warnings
 from typing import Any, Callable, Dict, List, Optional
 
 from ray_lightning_tpu import session as session_mod
 from ray_lightning_tpu.cluster import backend as backend_mod
 from ray_lightning_tpu.cluster import rpc
+from ray_lightning_tpu.cluster.actor import ActorDiedError, RemoteError
 from ray_lightning_tpu.core.loop import (
     FitConfig,
     run_eval,
@@ -59,6 +64,18 @@ __all__ = [
 # ---------------------------------------------------------------------------
 # Worker-side entry (top-level: importable in actor children)
 # ---------------------------------------------------------------------------
+
+def _remote_latest_restart_checkpoint(restart_dir: str):
+    """Runs on worker 0: newest elastic-restart checkpoint on its node."""
+    try:
+        names = sorted(
+            n for n in os.listdir(restart_dir)
+            if n.startswith("restart-epoch-") and n.endswith(".ckpt")
+        )
+    except OSError:
+        return None
+    return os.path.join(restart_dir, names[-1]) if names else None
+
 
 def _remote_find_free_port() -> int:
     """Free port on the *worker's* node (≙ reference ``ray_ddp.py:31-35``,
@@ -176,6 +193,8 @@ class TpuStrategy:
         backend: Optional[str] = None,
         mesh_axes: Optional[Dict[str, int]] = None,
         env_per_worker: Optional[Dict[str, str]] = None,
+        max_restarts: int = 0,
+        restart_every_n_epochs: int = 1,
     ):
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
@@ -195,6 +214,17 @@ class TpuStrategy:
         self.backend_name = backend
         self.mesh_axes = mesh_axes
         self.env_per_worker = dict(env_per_worker or {})
+        # Elastic fault tolerance (extends the reference, which only
+        # fails fast — SURVEY §5 "failure detection: ABSENT"): on worker
+        # death during fit, respawn the worker set up to ``max_restarts``
+        # times and resume from the newest restart checkpoint.
+        if max_restarts < 0:
+            raise ValueError("max_restarts must be >= 0")
+        if restart_every_n_epochs < 1:
+            raise ValueError("restart_every_n_epochs must be >= 1")
+        self.max_restarts = max_restarts
+        self.restart_every_n_epochs = restart_every_n_epochs
+        self.restarts_used = 0
 
         self._backend: Optional[backend_mod.ClusterBackend] = None
         self._workers: list = []
@@ -224,6 +254,9 @@ class TpuStrategy:
             self.backend_name, backend_mod.ClusterBackend
         )
         self._backend = backend_mod.get_backend(self.backend_name)
+        self._spawn_workers()
+
+    def _spawn_workers(self) -> None:
         for i in range(self.num_workers):
             worker = self._backend.create_actor(
                 name=f"rlt-worker-{i}",
@@ -238,6 +271,17 @@ class TpuStrategy:
             ]
             for f in futures:
                 f.result()
+
+    def _respawn_workers(self) -> None:
+        """Kill every current worker (peers of a dead one may be stuck in
+        a collective forever) and start a fresh set."""
+        for w in self._workers:
+            try:
+                w.kill()
+            except Exception:  # noqa: BLE001 - some are already dead
+                pass
+        self._workers = []
+        self._spawn_workers()
 
     def _broker_coordinator(self) -> Optional[str]:
         """Worker-0-node coordinator address (≙ MASTER_ADDR/PORT brokering,
@@ -265,8 +309,87 @@ class TpuStrategy:
         ckpt_path: Optional[str] = None,
     ) -> List[Dict[str, Any]]:
         """The execution loop (≙ ``RayPlugin.execution_loop``,
-        reference ``ray_ddp.py:317-360``): ship → submit → pump → collect."""
+        reference ``ray_ddp.py:317-360``): ship → submit → pump → collect.
+
+        With ``max_restarts > 0`` and ``kind="fit"``, worker death does not
+        crash the fit: the whole worker set is respawned and training
+        resumes from the newest elastic-restart checkpoint (at most
+        ``restart_every_n_epochs`` epochs of work are lost).
+        """
         assert self._backend is not None, "setup() must run first"
+        elastic = self.max_restarts > 0 and kind == "fit"
+        restart_dir = None
+        if elastic and config.restart_dir is None:
+            restart_dir = os.path.join(
+                config.default_root_dir,
+                f".rlt-restart-{uuid.uuid4().hex[:8]}",
+            )
+            config = dataclasses.replace(
+                config,
+                restart_dir=restart_dir,
+                restart_every_n_epochs=self.restart_every_n_epochs,
+            )
+        attempt = 0
+        try:
+            while True:
+                try:
+                    return self._run_once(
+                        kind, module, datamodule, config, callbacks,
+                        trainer=trainer, params_stream=params_stream,
+                        ckpt_path=ckpt_path,
+                    )
+                # Retry ONLY process death (≙ preemption/OOM).  A Python
+                # exception in user code (RemoteError) is deterministic —
+                # respawning would retrain epochs just to re-raise it.
+                except ActorDiedError as err:
+                    if not elastic or attempt >= self.max_restarts:
+                        raise
+                    attempt += 1
+                    self.restarts_used += 1
+                    self._respawn_workers()
+                    resume = self._latest_restart_checkpoint(
+                        config.restart_dir
+                    )
+                    warnings.warn(
+                        f"Worker failure ({err}); elastic restart "
+                        f"{attempt}/{self.max_restarts}, resuming from "
+                        f"{resume or 'scratch'}."
+                    )
+                    if resume is not None:
+                        config = dataclasses.replace(
+                            config, resume_from_checkpoint=resume
+                        )
+        finally:
+            # The scratch dir is uuid-named and unreachable for manual
+            # resume; reclaim it on failure too, not just success.
+            if restart_dir is not None:
+                shutil.rmtree(restart_dir, ignore_errors=True)
+
+    def _latest_restart_checkpoint(self, restart_dir) -> Optional[str]:
+        """Newest restart checkpoint, looked up ON WORKER 0's node — the
+        writer's filesystem (restart_dir must be shared storage for
+        multi-node elastic recovery, the same assumption the reference
+        makes for ModelCheckpoint files, ``ray_ddp.py:496-499``)."""
+        if restart_dir is None or not self._workers:
+            return None
+        try:
+            return self._workers[0].execute(
+                _remote_latest_restart_checkpoint, restart_dir
+            )
+        except (ActorDiedError, RemoteError):
+            return None
+
+    def _run_once(
+        self,
+        kind: str,
+        module,
+        datamodule,
+        config: FitConfig,
+        callbacks: List,
+        trainer=None,
+        params_stream: Optional[bytes] = None,
+        ckpt_path: Optional[str] = None,
+    ) -> List[Dict[str, Any]]:
         coordinator = self._broker_coordinator()
         task = {
             "kind": kind,
