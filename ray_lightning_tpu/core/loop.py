@@ -242,6 +242,7 @@ def run_fit(
         tx = tx[0]
 
     ctx = LoopContext(config, global_rank, world_size, mesh, queue, tx)
+    ctx.step_mode = mode
     module.trainer = ctx
     module.precision = config.precision
 
@@ -270,6 +271,9 @@ def run_fit(
         else:
             state = jax.device_put(host_state, state_shardings)
         start_epoch = payload["epoch"] + 1
+        # If the checkpoint already covers max_epochs the loop body never
+        # runs; current_epoch must still report the work as done.
+        ctx.current_epoch = max(start_epoch - 1, 0)
         ctx.global_step = payload["global_step"]
         ctx.callback_metrics.update(payload.get("callback_metrics", {}))
         # Stateful callbacks (EarlyStopping patience, ModelCheckpoint
@@ -365,6 +369,18 @@ def run_fit(
                 )
                 os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
                 state_stream_to_file(to_state_stream(payload), path)
+                # Writes are atomic, so the newest checkpoint is always
+                # loadable — superseded ones are pure disk growth.
+                for name in os.listdir(config.restart_dir):
+                    if (name.startswith("restart-epoch-")
+                            and name.endswith(".ckpt")
+                            and name < os.path.basename(path)):
+                        try:
+                            os.unlink(
+                                os.path.join(config.restart_dir, name)
+                            )
+                        except OSError:
+                            pass
 
         # Stream per-epoch metrics to the driver (live callback_metrics on
         # the driver trainer — extends the reference, which only streamed
@@ -459,6 +475,7 @@ def run_eval(
     ``ray_ddp.py:283-286``)."""
     stage = "validate" if kind == "validation" else "test"
     ctx = LoopContext(config, global_rank, world_size, mesh, queue)
+    ctx.step_mode = mode
     module.trainer = ctx
     module.setup(stage)
     datamodule.set_shard(global_rank, world_size)

@@ -83,13 +83,21 @@ class GPT(TpuModule):
         config: Optional[GPTConfig] = None,
         attn_impl: str = "auto",
         seq_axis: str = "sp",
+        remat: bool = False,
     ):
         super().__init__()
         self.config = config or GPTConfig.tiny()
         self.attn_impl = attn_impl
         self.seq_axis = seq_axis
+        # Rematerialization: recompute block activations in the backward
+        # pass instead of holding them in HBM (bandwidth-bound TPU trade:
+        # ~30% more FLOPs for ~n_layer× less activation memory — enables
+        # bigger per-chip batches / longer sequences).  MXU outputs
+        # (matmul results) are kept; cheap elementwise is recomputed.
+        self.remat = remat
         self.save_hyperparameters(
-            **dataclasses.asdict(self.config), attn_impl=attn_impl
+            **dataclasses.asdict(self.config), attn_impl=attn_impl,
+            remat=remat,
         )
 
     # -- params -------------------------------------------------------------
@@ -131,12 +139,16 @@ class GPT(TpuModule):
         output features ⇒ heads split across devices, no collective
         between the two matmuls of a block half), proj and MLP-out are
         row-parallel (shard the input features ⇒ one psum at the block
-        output, inserted by GSPMD).  Embedding is vocab-sharded.  Axes
-        absent from the active mesh are dropped by the strategy.
+        output, inserted by GSPMD).  The tied embedding is sharded on
+        d_model, not vocab: under GSPMD a gather from a vocab-sharded
+        table forces an involuntary reshard of the lookup output every
+        step, whereas a feature-sharded table keeps both the lookup and
+        the LM-head contraction in natively partitioned form.  Axes absent
+        from the active mesh are dropped by the strategy.
         """
         t = "tensor"
         return {
-            "wte": P(t, None),
+            "wte": P(None, t),
             "wpe": P(),
             "blocks": {
                 "ln1_g": P(), "ln1_b": P(),
@@ -175,12 +187,44 @@ class GPT(TpuModule):
             )
         return causal_attention(q, k, v, impl=self.attn_impl)
 
+    def _constrain_residual(self, x: jax.Array) -> jax.Array:
+        """Anchor the residual stream to its canonical layout: batch over
+        the data(+fsdp) axes, seq over the sp axis when ring attention is
+        active, features replicated.
+
+        Without the anchor, GSPMD propagates the TP parameter shardings
+        into activations and flip-flops between feature-sharded and
+        batch-sharded layouts across the block, hitting its "involuntary
+        full rematerialization" fallback (an all-gather + re-partition per
+        mismatch) in the backward pass.  One explicit constraint per block
+        keeps every reshard a cheap local collective on ICI.
+        """
+        trainer = getattr(self, "trainer", None)
+        mesh = getattr(trainer, "mesh", None)
+        # Under shard_map (the Horovod-duality flavor) the body is already
+        # per-device with Manual axes — a named sharding constraint there
+        # is both meaningless and a trace-time error.  gspmd only.
+        if mesh is None or getattr(trainer, "step_mode", "gspmd") != "gspmd":
+            return x
+        from jax.sharding import NamedSharding
+
+        from ray_lightning_tpu.parallel import sharding as shardlib
+
+        batch = shardlib.data_axes(mesh)
+        seq = self.seq_axis if self.seq_axis in mesh.axis_names else None
+        spec = P(batch if batch else None, seq, None)
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, spec)
+        )
+
     def forward(self, params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
         """tokens (B, T) int32 -> logits (B, T, vocab) float32."""
         cfg = self.config
         c = self._compute_dtype()
         B, T = tokens.shape
-        x = (params["wte"][tokens] + params["wpe"][:T]).astype(c)
+        x = self._constrain_residual(
+            (params["wte"][tokens] + params["wpe"][:T]).astype(c)
+        )
 
         def block(x, p):
             h = _layer_norm(x, p["ln1_g"], p["ln1_b"])
@@ -197,8 +241,13 @@ class GPT(TpuModule):
             h = jax.nn.gelu(h @ p["mlp_in_w"].astype(c)
                             + p["mlp_in_b"].astype(c))
             x = x + h @ p["mlp_out_w"].astype(c) + p["mlp_out_b"].astype(c)
-            return x, None
+            return self._constrain_residual(x), None
 
+        if self.remat:
+            block = jax.checkpoint(
+                block,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            )
         x, _ = jax.lax.scan(block, x, params["blocks"])
         x = _layer_norm(x, params["ln_f_g"], params["ln_f_b"])
         # Tied LM head; logits in float32 for a stable softmax.

@@ -257,9 +257,15 @@ class TpuStrategy:
         self._spawn_workers()
 
     def _spawn_workers(self) -> None:
+        # Generation-unique names: a Ray named actor is deregistered
+        # asynchronously after ray.kill, so a respawn reusing the same
+        # name races the teardown.
+        gen = getattr(self, "_spawn_generation", 0)
+        self._spawn_generation = gen + 1
+        suffix = "" if gen == 0 else f"-r{gen}"
         for i in range(self.num_workers):
             worker = self._backend.create_actor(
-                name=f"rlt-worker-{i}",
+                name=f"rlt-worker-{i}{suffix}",
                 env=self.env_per_worker or None,
                 num_cpus=self.num_cpus_per_worker,
                 resources=self.additional_resources_per_worker or None,

@@ -131,9 +131,18 @@ def load_state_stream(
 
 
 def state_stream_to_file(stream: bytes, path: str) -> None:
-    """Write a state stream to a file (checkpoint transport helper)."""
-    with open(path, "wb") as f:
+    """Write a state stream to a file (checkpoint transport helper).
+
+    Atomic (temp + rename): a writer killed mid-checkpoint — the very
+    event elastic restart recovers from — must never leave a truncated
+    file where a resume would pick it up.
+    """
+    import os
+
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "wb") as f:
         f.write(stream)
+    os.replace(tmp, path)
 
 
 def state_stream_from_file(path: str) -> bytes:
