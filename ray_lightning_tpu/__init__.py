@@ -1,4 +1,4 @@
-"""ray_lightning_tpu — TPU-native distributed training strategies on a Ray-style control plane.
+"""ray_lightning_tpu — TPU-native distributed training strategies.
 
 A brand-new, TPU-first framework with the capabilities of ``ray_lightning``
 (PyTorch Lightning distributed-training plugins on Ray), re-designed for

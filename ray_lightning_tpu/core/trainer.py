@@ -46,7 +46,7 @@ class _ModuleDataModule(TpuDataModule):
         return loader
 
     def train_dataloader(self):
-        return self._sharded(self._module.train_dataloader())  # type: ignore[attr-defined]
+        return self._sharded(self._module.train_dataloader())
 
     def val_dataloader(self):
         fn = getattr(self._module, "val_dataloader", None)
