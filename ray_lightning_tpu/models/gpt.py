@@ -50,6 +50,13 @@ class GPTConfig:
     lr: float = 3e-4
     weight_decay: float = 0.1
     warmup_steps: int = 100
+    # Mixture-of-Experts (0 = dense MLP).  Experts replace every block's
+    # MLP; routed with top-k capacity dispatch (ops/moe.py) and sharded
+    # over an ``expert`` mesh axis when present.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 1e-2
 
     @classmethod
     def tiny(cls) -> "GPTConfig":
@@ -60,6 +67,11 @@ class GPTConfig:
     @classmethod
     def gpt2_small(cls) -> "GPTConfig":
         return cls()  # 124M params
+
+    @classmethod
+    def tiny_moe(cls, n_experts: int = 4, **kw) -> "GPTConfig":
+        return cls(vocab_size=512, n_layer=2, n_head=4, d_model=128,
+                   seq_len=128, warmup_steps=2, n_experts=n_experts, **kw)
 
     @property
     def head_dim(self) -> int:
@@ -111,23 +123,36 @@ class GPT(TpuModule):
 
         # Residual-path projections scaled by 1/sqrt(2L) (GPT-2 init).
         resid_std = 0.02 / np.sqrt(2 * L)
-        return {
-            "wte": norm(keys[0], (cfg.vocab_size, d)),
-            "wpe": norm(keys[1], (cfg.seq_len, d), std=0.01),
-            "blocks": {
-                "ln1_g": jnp.ones((L, d)),
-                "ln1_b": jnp.zeros((L, d)),
-                "qkv_w": norm(keys[2], (L, d, 3 * d)),
-                "qkv_b": jnp.zeros((L, 3 * d)),
-                "proj_w": norm(keys[3], (L, d, d), std=resid_std),
-                "proj_b": jnp.zeros((L, d)),
-                "ln2_g": jnp.ones((L, d)),
-                "ln2_b": jnp.zeros((L, d)),
+        blocks = {
+            "ln1_g": jnp.ones((L, d)),
+            "ln1_b": jnp.zeros((L, d)),
+            "qkv_w": norm(keys[2], (L, d, 3 * d)),
+            "qkv_b": jnp.zeros((L, 3 * d)),
+            "proj_w": norm(keys[3], (L, d, d), std=resid_std),
+            "proj_b": jnp.zeros((L, d)),
+            "ln2_g": jnp.ones((L, d)),
+            "ln2_b": jnp.zeros((L, d)),
+        }
+        E = cfg.n_experts
+        if E > 0:
+            blocks.update({
+                "gate_w": norm(keys[6], (L, d, E)),
+                "moe_in_w": norm(keys[4], (L, E, d, h)),
+                "moe_in_b": jnp.zeros((L, E, h)),
+                "moe_out_w": norm(keys[5], (L, E, h, d), std=resid_std),
+                "moe_out_b": jnp.zeros((L, E, d)),
+            })
+        else:
+            blocks.update({
                 "mlp_in_w": norm(keys[4], (L, d, h)),
                 "mlp_in_b": jnp.zeros((L, h)),
                 "mlp_out_w": norm(keys[5], (L, h, d), std=resid_std),
                 "mlp_out_b": jnp.zeros((L, d)),
-            },
+            })
+        return {
+            "wte": norm(keys[0], (cfg.vocab_size, d)),
+            "wpe": norm(keys[1], (cfg.seq_len, d), std=0.01),
+            "blocks": blocks,
             "ln_f_g": jnp.ones((d,)),
             "ln_f_b": jnp.zeros((d,)),
         }
@@ -146,18 +171,32 @@ class GPT(TpuModule):
         the LM-head contraction in natively partitioned form.  Axes absent
         from the active mesh are dropped by the strategy.
         """
-        t = "tensor"
+        t, e = "tensor", "expert"
+        blocks = {
+            "ln1_g": P(), "ln1_b": P(),
+            "qkv_w": P(None, None, t), "qkv_b": P(None, t),
+            "proj_w": P(None, t, None), "proj_b": P(),
+            "ln2_g": P(), "ln2_b": P(),
+        }
+        if self.config.n_experts > 0:
+            # ep × tp composition: experts over the expert axis, each
+            # expert's hidden dim over tensor (column/row-parallel FFN).
+            blocks.update({
+                "gate_w": P(),
+                "moe_in_w": P(None, e, None, t),
+                "moe_in_b": P(None, e, t),
+                "moe_out_w": P(None, e, t, None),
+                "moe_out_b": P(None, e, None),
+            })
+        else:
+            blocks.update({
+                "mlp_in_w": P(None, None, t), "mlp_in_b": P(None, t),
+                "mlp_out_w": P(None, t, None), "mlp_out_b": P(),
+            })
         return {
             "wte": P(None, t),
             "wpe": P(),
-            "blocks": {
-                "ln1_g": P(), "ln1_b": P(),
-                "qkv_w": P(None, None, t), "qkv_b": P(None, t),
-                "proj_w": P(None, t, None), "proj_b": P(),
-                "ln2_g": P(), "ln2_b": P(),
-                "mlp_in_w": P(None, None, t), "mlp_in_b": P(None, t),
-                "mlp_out_w": P(None, t, None), "mlp_out_b": P(),
-            },
+            "blocks": blocks,
             "ln_f_g": P(), "ln_f_b": P(),
         }
 
@@ -186,6 +225,19 @@ class GPT(TpuModule):
                 q, k, v, mesh, seq_axis=self.seq_axis
             )
         return causal_attention(q, k, v, impl=self.attn_impl)
+
+    def _moe_groups(self) -> int:
+        """Routing groups = data-parallel shard count, so each group's
+        capacity cumsum stays shard-local (GShard's group dim)."""
+        mesh = getattr(getattr(self, "trainer", None), "mesh", None)
+        if mesh is None:
+            return 1
+        from ray_lightning_tpu.parallel import sharding as shardlib
+
+        g = 1
+        for axis in shardlib.data_axes(mesh):
+            g *= mesh.shape[axis]
+        return g
 
     def _constrain_residual(self, x: jax.Array) -> jax.Array:
         """Anchor the residual stream to its canonical layout: batch over
@@ -219,6 +271,12 @@ class GPT(TpuModule):
 
     def forward(self, params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
         """tokens (B, T) int32 -> logits (B, T, vocab) float32."""
+        return self.forward_with_aux(params, tokens)[0]
+
+    def forward_with_aux(
+        self, params: Dict[str, Any], tokens: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        """(logits, moe_aux_loss) — aux is 0.0 for dense configs."""
         cfg = self.config
         c = self._compute_dtype()
         B, T = tokens.shape
@@ -226,7 +284,8 @@ class GPT(TpuModule):
             (params["wte"][tokens] + params["wpe"][:T]).astype(c)
         )
 
-        def block(x, p):
+        def block(carry, p):
+            x, aux = carry
             h = _layer_norm(x, p["ln1_g"], p["ln1_b"])
             qkv = h @ p["qkv_w"].astype(c) + p["qkv_b"].astype(c)
             q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -238,39 +297,62 @@ class GPT(TpuModule):
             att = att.reshape(B, T, cfg.d_model)
             x = x + att @ p["proj_w"].astype(c) + p["proj_b"].astype(c)
             h = _layer_norm(x, p["ln2_g"], p["ln2_b"])
-            h = jax.nn.gelu(h @ p["mlp_in_w"].astype(c)
-                            + p["mlp_in_b"].astype(c))
-            x = x + h @ p["mlp_out_w"].astype(c) + p["mlp_out_b"].astype(c)
-            return self._constrain_residual(x), None
+            if cfg.n_experts > 0:
+                from ray_lightning_tpu.ops.moe import moe_mlp
+
+                y, layer_aux = moe_mlp(
+                    h, p["gate_w"], p["moe_in_w"], p["moe_in_b"],
+                    p["moe_out_w"], p["moe_out_b"],
+                    top_k=cfg.moe_top_k,
+                    capacity_factor=cfg.moe_capacity_factor,
+                    groups=self._moe_groups(),
+                )
+                x = x + y
+                aux = aux + layer_aux
+            else:
+                h = jax.nn.gelu(h @ p["mlp_in_w"].astype(c)
+                                + p["mlp_in_b"].astype(c))
+                x = x + h @ p["mlp_out_w"].astype(c) + p["mlp_out_b"].astype(c)
+            return (self._constrain_residual(x), aux), None
 
         if self.remat:
             block = jax.checkpoint(
                 block,
                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             )
-        x, _ = jax.lax.scan(block, x, params["blocks"])
+        (x, aux), _ = jax.lax.scan(
+            block, (x, jnp.zeros((), jnp.float32)), params["blocks"]
+        )
+        # Per-layer mean: the aux weight is depth-independent (balanced
+        # routing ⇒ aux ≈ 1 at any n_layer).
+        aux = aux / max(cfg.n_layer, 1)
         x = _layer_norm(x, params["ln_f_g"], params["ln_f_b"])
         # Tied LM head; logits in float32 for a stable softmax.
-        return jnp.einsum(
+        logits = jnp.einsum(
             "btd,vd->btv", x, params["wte"].astype(c),
             preferred_element_type=jnp.float32,
         )
+        return logits, aux
 
     # -- steps --------------------------------------------------------------
     def _loss(self, params, tokens):
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        logits = self.forward(params, inputs)
+        logits, aux = self.forward_with_aux(params, inputs)
         loss = optax.softmax_cross_entropy_with_integer_labels(
             logits, targets
         ).mean()
-        return loss
+        return loss, aux
 
     def training_step(self, params, batch, rng):
-        loss = self._loss(params, batch["tokens"])
-        return loss, {"train_loss": loss}
+        loss, aux = self._loss(params, batch["tokens"])
+        logs = {"train_loss": loss}
+        if self.config.n_experts > 0:
+            logs["moe_aux_loss"] = aux
+            loss = loss + self.config.moe_aux_weight * aux
+        return loss, logs
 
     def validation_step(self, params, batch):
-        loss = self._loss(params, batch["tokens"])
+        loss, _ = self._loss(params, batch["tokens"])
         return {"val_loss": loss, "val_ppl": jnp.exp(loss)}
 
     def predict_step(self, params, batch):

@@ -1,0 +1,143 @@
+"""Mixture-of-Experts routing + expert-parallel MLP (GShard/Switch style).
+
+Net-new over the reference (SURVEY §2.3: "EP (expert parallel / MoE):
+absent"), built TPU-first:
+
+* **Dense dispatch, static shapes.** Routing is expressed as einsums
+  against one-hot dispatch/combine tensors (the GShard formulation) —
+  no gathers/scatters with data-dependent shapes, so XLA tiles
+  everything onto the MXU and the program never recompiles.  Capacity
+  ``C`` bounds per-expert work; overflow tokens are dropped from the
+  expert path (they still flow through the residual).
+* **Grouped routing.** Tokens are routed within ``groups`` independent
+  groups (GShard's group dim), sized by the caller to the data-parallel
+  shard count: dispatch tensors are ``[G, s, E, C]`` with ``s = S/G``
+  (linear in S, not quadratic), and the capacity cumsum runs *within*
+  a group — shard-local under GSPMD, no cross-shard router state.
+* **Expert parallelism as an annotation.** Expert-stacked weights
+  ``[E, d, h]`` carry ``P("expert", ...)`` specs; with an ``expert``
+  mesh axis, GSPMD turns the dispatch einsum into the all-to-all that
+  ships token slots to their expert's device, composing with tensor
+  parallelism on the hidden dim.
+* **Load balancing** via the Switch-Transformer auxiliary loss,
+  normalized so a perfectly uniform assignment scores 1.0 for any
+  ``top_k``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["topk_capacity_routing", "moe_mlp", "load_balance_loss"]
+
+
+def topk_capacity_routing(
+    probs: jax.Array, top_k: int, capacity: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Greedy top-k assignment with per-expert capacity (one group).
+
+    probs: ``[s, E]`` router probabilities (f32).
+    Returns ``(combine, dispatch)``, both ``[s, E, C]``: ``dispatch`` is
+    the 0/1 token→(expert, slot) assignment; ``combine`` additionally
+    carries the (renormalized) gate weight of each assignment.
+    """
+    s, E = probs.shape
+    top_k = min(top_k, E)  # k > E would re-route masked tokens to expert 0
+    remaining = probs
+    slots_used = jnp.zeros((1, E), jnp.float32)
+    dispatch = jnp.zeros((s, E, capacity), jnp.float32)
+    combine = jnp.zeros((s, E, capacity), jnp.float32)
+    for _ in range(top_k):  # static, small
+        choice = jnp.argmax(remaining, axis=-1)                   # [s]
+        onehot = jax.nn.one_hot(choice, E, dtype=jnp.float32)     # [s, E]
+        # Queue position of each token within its chosen expert, offset
+        # by slots already consumed in earlier rounds.
+        position = jnp.cumsum(onehot, axis=0) - onehot + slots_used
+        fits = (position < capacity) * onehot                     # [s, E]
+        slot = jax.nn.one_hot(
+            position.astype(jnp.int32), capacity, dtype=jnp.float32
+        )                                                         # [s, E, C]
+        d = slot * fits[..., None]
+        gate = (probs * onehot).sum(-1)                           # [s]
+        dispatch = dispatch + d
+        combine = combine + d * gate[:, None, None]
+        slots_used = slots_used + fits.sum(0, keepdims=True)
+        remaining = remaining * (1.0 - onehot)
+    # Normalize gates over the (≤ top_k) experts that accepted the token.
+    denom = combine.sum(axis=(1, 2), keepdims=True)
+    combine = combine / jnp.maximum(denom, 1e-9)
+    return combine, dispatch
+
+
+def load_balance_loss(probs: jax.Array, dispatch: jax.Array) -> jax.Array:
+    """Switch-Transformer aux loss: ``E · Σ_e f_e · p̄_e``.
+
+    ``f_e`` = fraction of *dispatches* landing on expert e (normalized by
+    the total dispatch count, so the result is 1.0 for a uniform
+    assignment regardless of ``top_k``), ``p̄_e`` = mean router
+    probability.
+    """
+    E = probs.shape[-1]
+    per_expert = dispatch.sum(axis=(0, 2))                        # [E]
+    frac = per_expert / jnp.maximum(per_expert.sum(), 1.0)
+    mean_prob = probs.mean(axis=0)                                # [E]
+    return E * jnp.sum(frac * mean_prob)
+
+
+def moe_mlp(
+    x: jax.Array,
+    gate_w: jax.Array,
+    w_in: jax.Array,
+    b_in: jax.Array,
+    w_out: jax.Array,
+    b_out: jax.Array,
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+    groups: int = 1,
+) -> Tuple[jax.Array, jax.Array]:
+    """Expert-parallel MLP block: route → dispatch → expert FFN → combine.
+
+    x ``[B, T, d]``; gate_w ``[d, E]``; w_in ``[E, d, h]``; b_in
+    ``[E, h]``; w_out ``[E, h, d]``; b_out ``[E, d]``.  ``groups`` should
+    equal the data-parallel shard count (see module docstring); it is
+    clamped to 1 when it does not divide the token count.  Returns
+    ``(y [B, T, d], aux_loss scalar)``.  Router math in f32 regardless of
+    the compute dtype (gate decisions must not flip with bf16 rounding).
+    """
+    B, T, d = x.shape
+    E = gate_w.shape[-1]
+    S = B * T
+    G = groups if groups > 0 and S % groups == 0 else 1
+    s = S // G
+    capacity = max(1, int(math.ceil(s / E * capacity_factor)))
+    xg = x.reshape(G, s, d)
+
+    logits = jnp.einsum(
+        "gsd,de->gse", xg.astype(jnp.float32), gate_w.astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(logits, axis=-1)                       # [G, s, E]
+    combine, dispatch = jax.vmap(
+        lambda p: topk_capacity_routing(p, top_k, capacity)
+    )(probs)
+    aux = jax.vmap(load_balance_loss)(probs, dispatch).mean()
+
+    c = x.dtype
+    # Dispatch: the ep all-to-all under GSPMD (token slots → expert shard).
+    xd = jnp.einsum("gsec,gsd->gecd", dispatch.astype(c), xg,
+                    preferred_element_type=jnp.float32).astype(c)
+    h = jax.nn.gelu(
+        jnp.einsum("gecd,edh->gech", xd, w_in,
+                   preferred_element_type=jnp.float32).astype(c)
+        + b_in[None, :, None, :].astype(c)
+    )
+    yo = (jnp.einsum("gech,ehd->gecd", h, w_out,
+                     preferred_element_type=jnp.float32).astype(c)
+          + b_out[None, :, None, :].astype(c))
+    # Combine: the return all-to-all, weighted by the gates.
+    y = jnp.einsum("gsec,gecd->gsd", combine.astype(c), yo,
+                   preferred_element_type=jnp.float32)
+    return y.reshape(B, T, d).astype(c), aux
