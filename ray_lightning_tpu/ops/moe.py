@@ -123,7 +123,14 @@ def moe_mlp(
     combine, dispatch = jax.vmap(
         lambda p: topk_capacity_routing(p, top_k, capacity)
     )(probs)
-    aux = jax.vmap(load_balance_loss)(probs, dispatch).mean()
+    # Aux loss over *globally aggregated* statistics, not a per-group
+    # mean: E·Σ f_e·p̄_e with f_e and p̄_e formed from the all-group
+    # dispatch counts / router probs.  A per-group mean of the loss is
+    # mesh-dependent (E[f·p] ≠ E[f]·E[p] across groups), which broke
+    # sharded parity; plain sums stay shard-local-friendly under GSPMD.
+    aux = load_balance_loss(
+        probs.reshape(G * s, E), dispatch.reshape(G * s, E, capacity)
+    )
 
     c = x.dtype
     # Dispatch: the ep all-to-all under GSPMD (token slots → expert shard).
