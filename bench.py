@@ -1,16 +1,29 @@
-"""Headline benchmark: flagship GPT training throughput on one TPU chip.
+"""Headline benchmark: flagship GPT training throughput through Trainer.fit().
 
-Prints ONE JSON line ``{"metric", "value", "unit", "vs_baseline"}``.
+Prints ONE JSON line ``{"metric", "value", "unit", "vs_baseline", ...}``.
+
+The north-star metric (BASELINE.md) is **``Trainer.fit()`` steps/sec/chip**
+— so the timed path is the real user path: ``Trainer`` + strategy + loop +
+prefetch + callbacks, NOT a raw ``build_train_step`` call.  The raw-step
+path is measured alongside it and reported as ``fit_vs_raw`` (the loop
+overhead budget: ≥ 0.95 means the Trainer path gives away <5%).
 
 The reference (`sxjscience/ray_lightning`) publishes no performance
-numbers (BASELINE.md: ``"published": {}``), so ``vs_baseline`` is
-reported as the ratio against the framework's own recorded target of
-parity (1.0 ≡ established baseline; >1 is headroom over it).
+numbers (BASELINE.md: ``"published": {}``), so ``vs_baseline`` is the
+ratio against this framework's own first recorded number for the same
+config family (BENCH_r01: 66,010 tokens/s/chip), making round-over-round
+progress visible.
 
-Config: GPT-2-small-shaped model (124M params), bf16 activations, seq
-1024, per-chip batch 8, full optimizer step (adamw + global-norm clip,
-donated buffers) through the same ``build_train_step`` path the
-strategies compile.
+Config: GPT-2-small (124M params), bf16 activations, seq 1024, per-chip
+batch 16, Pallas flash attention (fwd+bwd kernels), rematerialized blocks,
+full optimizer step (adamw + global-norm clip, donated buffers).
+
+MFU = achieved model FLOPs / chip peak bf16 FLOPs, with model FLOPs from
+the standard 6N+attention accounting (no remat-recompute credit).
+Current profile (v5e): ~34% MFU; the remainder is split across the f32
+LM-head+cross-entropy (~17% of step at ~56% matmul efficiency — vocab
+50304 against d_model 768 is a skinny matmul), layer-norm/elementwise HBM
+traffic, and the f32 optimizer update (~3%).
 """
 
 from __future__ import annotations
@@ -22,12 +35,119 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_lightning_tpu.core.callbacks import Callback
 from ray_lightning_tpu.core.module import TrainState
-from ray_lightning_tpu.models.gpt import GPT, GPTConfig
+from ray_lightning_tpu.core.trainer import Trainer
+from ray_lightning_tpu.models.gpt import GPT, GPTConfig, SyntheticLMDataModule
 from ray_lightning_tpu.parallel.step_fns import build_train_step
+from ray_lightning_tpu.parallel.strategies import LocalStrategy
 
 WARMUP_STEPS = 3
 TIMED_STEPS = 10
+# First recorded number for this config family (BENCH_r01.json, round 1:
+# raw-step path, B=8, XLA-recompute attention backward).
+R1_TOKENS_PER_SEC = 66010.1
+
+# Peak bf16 FLOP/s per chip by device_kind substring (dense MXU peak).
+_PEAK_FLOPS = (
+    ("v5 lite", 197e12),   # v5e
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v6", 918e12),        # Trillium
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def _peak_flops_per_chip() -> float:
+    kind = jax.devices()[0].device_kind.lower()
+    for key, peak in _PEAK_FLOPS:
+        if key in kind:
+            return peak
+    return 197e12  # unknown TPU: assume v5e-class
+
+
+def model_flops_per_token(cfg: GPTConfig) -> float:
+    """Fwd+bwd matmul FLOPs per token (standard accounting, full
+    attention matrix, backward = 2x forward, no remat credit)."""
+    d, L, s, V = cfg.d_model, cfg.n_layer, cfg.seq_len, cfg.vocab_size
+    mm = 24 * L * d * d          # qkv + proj + mlp weight matmuls
+    attn = 4 * L * s * d         # QK^T and AV
+    head = 2 * d * V             # tied LM head
+    return 3.0 * (mm + attn + head)
+
+
+class _StepTimer(Callback):
+    """Times TIMED_STEPS steady-state steps inside the fit loop.
+
+    Sync discipline: device->host transfer of the loss (on the
+    experimental remote-TPU platform ``block_until_ready`` can return
+    before execution finishes, but a host copy cannot).
+    """
+
+    def __init__(self):
+        self.t0 = None
+        self.elapsed = None
+
+    def on_train_batch_end(self, trainer, module, logs, batch_idx):
+        step = trainer.global_step  # already incremented for this batch
+        if step == WARMUP_STEPS:
+            float(jax.device_get(logs["train_loss"]))
+            self.t0 = time.perf_counter()
+        elif step == WARMUP_STEPS + TIMED_STEPS:
+            float(jax.device_get(logs["train_loss"]))
+            self.elapsed = time.perf_counter() - self.t0
+
+
+def _bench_raw_step(module: GPT, cfg: GPTConfig, batch_size: int) -> float:
+    """Tokens/s through a bare build_train_step call (no Trainer)."""
+    params = module.init_params(jax.random.PRNGKey(0))
+    tx = module.configure_optimizers()
+    state = TrainState.create(params, tx)
+    step = build_train_step(module, tx, mesh=None)
+    rng = jax.random.PRNGKey(0)
+    tokens = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(batch_size, cfg.seq_len + 1)
+    ).astype(np.int32)
+    batch = {"tokens": jnp.asarray(tokens)}
+    for _ in range(WARMUP_STEPS):
+        state, logs = step(state, batch, rng)
+    float(jax.device_get(logs["loss"]))
+    t0 = time.perf_counter()
+    for _ in range(TIMED_STEPS):
+        state, logs = step(state, batch, rng)
+    loss = float(jax.device_get(logs["loss"]))
+    dt = time.perf_counter() - t0
+    assert np.isfinite(loss), f"non-finite loss {loss}"
+    return TIMED_STEPS * batch_size * cfg.seq_len / dt
+
+
+def _bench_fit(module: GPT, cfg: GPTConfig, batch_size: int) -> float:
+    """Tokens/s through the real Trainer.fit() path."""
+    timer = _StepTimer()
+    trainer = Trainer(
+        strategy=LocalStrategy(),
+        max_epochs=1,
+        limit_train_batches=WARMUP_STEPS + TIMED_STEPS + 1,
+        limit_val_batches=0,
+        enable_checkpointing=False,
+        precision="bf16",
+        log_every_n_steps=10_000,  # keep host syncs out of the hot loop
+        callbacks=[timer],
+    )
+    dm = SyntheticLMDataModule(
+        cfg, batch_size=batch_size,
+        num_batches=WARMUP_STEPS + TIMED_STEPS + 2,
+    )
+    trainer.fit(module, dm)
+    assert timer.elapsed is not None, "fit ended before the timed window"
+    assert np.isfinite(trainer.callback_metrics["train_loss"])
+    # LocalStrategy data-parallels over every local device; the metric is
+    # per-chip, so divide whole-host throughput by the device count (the
+    # raw-step path is genuinely single-device, mesh=None).
+    n_chips = jax.local_device_count()
+    return TIMED_STEPS * batch_size * cfg.seq_len / timer.elapsed / n_chips
 
 
 def main() -> None:
@@ -37,49 +157,38 @@ def main() -> None:
             vocab_size=50304, n_layer=12, n_head=12, d_model=768,
             seq_len=1024, warmup_steps=10,
         )
-        batch_size = 8
+        batch_size = 16
     else:
         # CPU fallback so the harness always produces a line.
         cfg = GPTConfig.tiny()
         batch_size = 4
 
-    module = GPT(cfg)
-    module.precision = "bf16"
+    def make_module():
+        m = GPT(cfg, attn_impl="auto", remat=on_tpu)
+        m.precision = "bf16"
+        return m
 
-    params = module.init_params(jax.random.PRNGKey(0))
-    tx = module.configure_optimizers()
-    state = TrainState.create(params, tx)
-    step = build_train_step(module, tx, mesh=None)
+    raw_tps = _bench_raw_step(make_module(), cfg, batch_size)
+    fit_tps = _bench_fit(make_module(), cfg, batch_size)
 
-    rng = jax.random.PRNGKey(0)
-    tokens = np.random.default_rng(0).integers(
-        0, cfg.vocab_size, size=(batch_size, cfg.seq_len + 1)
-    ).astype(np.int32)
-    batch = {"tokens": jnp.asarray(tokens)}
-
-    for _ in range(WARMUP_STEPS):
-        state, logs = step(state, batch, rng)
-    # Synchronize via host transfer: on the experimental remote-TPU
-    # platform block_until_ready can return before execution finishes,
-    # but a device->host copy of the result cannot.
-    float(logs["loss"])
-
-    t0 = time.perf_counter()
-    for _ in range(TIMED_STEPS):
-        state, logs = step(state, batch, rng)
-    loss = float(logs["loss"])
-    dt = time.perf_counter() - t0
-    assert np.isfinite(loss), f"non-finite loss {loss}"
-
-    steps_per_sec = TIMED_STEPS / dt
-    tokens_per_sec = steps_per_sec * batch_size * cfg.seq_len
+    flops_token = model_flops_per_token(cfg)
+    peak = _peak_flops_per_chip() if on_tpu else None
+    mfu = (fit_tps * flops_token / peak) if peak else None
 
     print(json.dumps({
-        "metric": "gpt2_small_train_tokens_per_sec_per_chip"
-        if on_tpu else "gpt_tiny_train_tokens_per_sec_cpu",
-        "value": round(tokens_per_sec, 1),
+        "metric": "gpt2_small_trainer_fit_tokens_per_sec_per_chip"
+        if on_tpu else "gpt_tiny_trainer_fit_tokens_per_sec_cpu",
+        "value": round(fit_tps, 1),
         "unit": "tokens/s",
-        "vs_baseline": 1.0,
+        "vs_baseline": round(fit_tps / R1_TOKENS_PER_SEC, 3)
+        if on_tpu else 1.0,
+        "steps_per_sec": round(fit_tps / (batch_size * cfg.seq_len), 3),
+        "raw_step_tokens_per_sec": round(raw_tps, 1),
+        "fit_vs_raw": round(fit_tps / raw_tps, 3),
+        "mfu": round(mfu, 3) if mfu is not None else None,
+        "bottleneck": "f32 LM-head+CE matmul (~17% of step, skinny "
+        "50304x768), LN/elementwise HBM traffic, f32 adamw update"
+        if on_tpu else "cpu fallback",
     }))
 
 

@@ -70,13 +70,12 @@ def _flash_supported(q: jax.Array) -> bool:
     from ray_lightning_tpu.ops import flash_attention as fa
 
     # Kernel constraints: the effective block is min(DEFAULT_BLOCK, s), so
-    # seq must divide into it AND the block itself must be a multiple of
-    # the dtype's TPU sublane tile (8 rows for f32, 16 for bf16) — a short
-    # unaligned s (e.g. 100, or 120 in bf16) would otherwise become its own
-    # unaligned block and fail Mosaic lowering.
-    tile = 16 if q.dtype == jnp.bfloat16 else 8
+    # seq must divide into it AND the block must be a multiple of 128 —
+    # per-row softmax stats (lse/delta) are stored broadcast across a
+    # 128-lane minor dim, and the backward kernels tile them in
+    # block_k/128 repeats.
     block = min(fa.DEFAULT_BLOCK_Q, s)
-    return s % block == 0 and block % tile == 0 and d in (64, 128, 256)
+    return s % block == 0 and block % 128 == 0 and d in (64, 128, 256)
 
 
 def causal_attention(

@@ -1,4 +1,4 @@
-"""Pallas TPU flash-attention (causal, forward kernel + recompute VJP).
+"""Pallas TPU flash-attention (causal): forward + fused backward kernels.
 
 The hot op of the transformer family, written TPU-first per the Pallas
 playbook (``/opt/skills/guides/pallas_guide.md``):
@@ -13,10 +13,20 @@ playbook (``/opt/skills/guides/pallas_guide.md``):
 * logits/accumulators in float32, inputs/outputs in the caller's dtype
   (bfloat16 in the mixed-precision recipe).
 
-Backward pass: recompute-based ``custom_vjp`` — residuals are just
-(q, k, v); the VJP re-runs the XLA reference attention under ``jax.vjp``.
-Rematerialization trades FLOPs for HBM exactly like ``jax.checkpoint``;
-a fused Pallas backward kernel is the natural next optimization.
+Backward pass (FlashAttention-2 style, two kernels):
+
+* the forward additionally emits the per-row log-sum-exp ``lse = m +
+  log l``, broadcast across a 128-lane minor dim (the TPU-native layout
+  for per-row scalars — same trick as jax.experimental.pallas.ops.tpu);
+* ``delta = rowsum(dO · O)`` is a cheap bandwidth-bound XLA reduction;
+* **dq kernel**: one program per query block, walks key blocks ``<= i``,
+  recomputes ``p = exp(s − lse)`` and accumulates ``ds @ K``;
+* **dk/dv kernel**: one program per key block, walks query blocks
+  ``>= floor(k/block_q)``, accumulating ``pᵀ @ dO`` and ``dsᵀ @ Q``.
+
+So the O(S²) logits tensor is never materialized in either direction —
+memory stays O(S·D) at any context length, which is what makes long-
+context (ring/sequence-parallel) training viable.
 
 (The reference framework has no analogue — its compute is opaque torch
 modules; this file exists because the TPU build owns its model math.)
@@ -36,10 +46,15 @@ __all__ = ["flash_attention", "DEFAULT_BLOCK_Q", "DEFAULT_BLOCK_K"]
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
 _NEG_INF = -1e30
+# Per-row scalars (lse, delta) are stored broadcast across this many
+# lanes so they tile natively on the TPU vector units (8×128 vregs) —
+# slicing column 0 of a (rows, 128) block is free; a (rows, 1) layout
+# would force a relayout on every use.
+_LANE = 128
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, block_q, block_k,
-                head_dim):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, scale, block_q,
+                block_k, head_dim):
     q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
     qi = pl.program_id(1)
     q_base = qi * block_q
@@ -75,32 +90,204 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, block_q, block_k,
     acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
     m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc, _, l = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
+    acc, m, l = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
+    if lse_ref is not None:
+        lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), (block_q, _LANE))
 
 
-def _flash_fwd_bhsd(q, k, v, scale, block_q, block_k):
-    """q/k/v: (BH, S, D) merged batch-heads layout."""
+def _interpret() -> bool:
+    # Mosaic compiles only for TPU; CPU test meshes run the kernels under
+    # the Pallas interpreter (same program, host execution).
+    return jax.default_backend() != "tpu"
+
+
+def _flash_fwd_bhsd(q, k, v, scale, block_q, block_k, want_lse=True):
+    """q/k/v: (BH, S, D) merged batch-heads layout -> (out, lse|None).
+
+    ``want_lse=False`` (the primal, non-differentiated path — eval/
+    predict) compiles a forward-only kernel with a single output, so no
+    O(BH·S·lane) f32 lse tensor is allocated or written.
+    """
     bh, s, d = q.shape
     grid = (bh, s // block_q)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, block_q=block_q, block_k=block_k,
         head_dim=d,
     )
-    return pl.pallas_call(
+    out_shape = jax.ShapeDtypeStruct((bh, s, d), q.dtype)
+    out_spec = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0))
+    lse_spec = pl.BlockSpec((1, block_q, _LANE), lambda b, i: (b, i, 0))
+    result = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        out_shape=(
+            out_shape,
+            jax.ShapeDtypeStruct((bh, s, _LANE), jnp.float32),
+        ) if want_lse else out_shape,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        # Mosaic compiles only for TPU; CPU test meshes run the kernel
-        # under the Pallas interpreter (same program, host execution).
-        interpret=(jax.default_backend() != "tpu"),
+        out_specs=(out_spec, lse_spec) if want_lse else out_spec,
+        interpret=_interpret(),
     )(q, k, v)
+    return result if want_lse else (result, None)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dq_ref, *,
+                   scale, block_q, block_k, head_dim):
+    qi = pl.program_id(1)
+    q_base = qi * block_q
+    q = q_ref[0].astype(jnp.float32) * scale          # (block_q, d)
+    do = do_ref[0].astype(jnp.float32)                # (block_q, d)
+    reps = block_k // _LANE
+    lse = jnp.tile(lse_ref[0], (1, reps))             # (block_q, block_k)
+    di = jnp.tile(di_ref[0], (1, reps))
+
+    def body(kb, acc):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        q_pos = q_base + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)                          # normalized probs
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - di)
+        return acc + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    num_kb = pl.cdiv(q_base + block_q, block_k)
+    acc = jax.lax.fori_loop(
+        0, num_kb, body, jnp.zeros((block_q, head_dim), jnp.float32)
+    )
+    dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dk_ref,
+                    dv_ref, *, scale, block_q, block_k, head_dim, seq_len):
+    ki = pl.program_id(1)
+    k_base = ki * block_k
+    k = k_ref[0].astype(jnp.float32)                  # (block_k, d)
+    v = v_ref[0].astype(jnp.float32)
+    reps = block_k // _LANE
+
+    def body(qb, carry):
+        dk_acc, dv_acc = carry
+        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(
+            jnp.float32) * scale                      # scale folded into q
+        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(
+            jnp.float32)
+        lse = jnp.tile(
+            lse_ref[0, pl.ds(qb * block_q, block_q), :], (1, reps)
+        )                                             # (block_q, block_k)
+        di = jnp.tile(di_ref[0, pl.ds(qb * block_q, block_q), :], (1, reps))
+        s = jax.lax.dot_general(
+            q_blk, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                             # (block_q, block_k)
+        q_pos = qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = k_base + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_new = dv_acc + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                             # (block_k, d)
+        dp = jax.lax.dot_general(
+            do_blk, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - di)
+        dk_new = dk_acc + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                             # scale via q_blk
+        return dk_new, dv_new
+
+    # Causal bound from below: query blocks before this key block see
+    # nothing here.
+    qb_start = k_base // block_q
+    zeros = jnp.zeros((block_k, head_dim), jnp.float32)
+    dk, dv = jax.lax.fori_loop(
+        qb_start, seq_len // block_q, body, (zeros, zeros)
+    )
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd_bhsd(q, k, v, out, lse, g, scale, block_q, block_k):
+    """Backward over (BH, S, D) tensors; returns (dq, dk, dv)."""
+    bh, s, d = q.shape
+    # delta_i = rowsum(dO · O): a bandwidth-bound elementwise-reduce XLA
+    # handles optimally; broadcast to the lane layout the kernels expect.
+    di = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )
+    di = jnp.broadcast_to(di[..., None], (bh, s, _LANE))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, block_q=block_q, block_k=block_k,
+            head_dim=d,
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        grid=(bh, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        interpret=_interpret(),
+    )(q, k, v, g, lse, di)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, block_q=block_q, block_k=block_k,
+            head_dim=d, seq_len=s,
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((bh, s, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+        ),
+        grid=(bh, s // block_k),
+        in_specs=[
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s, _LANE), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s, _LANE), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+        ),
+        interpret=_interpret(),
+    )(q, k, v, g, lse, di)
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
@@ -110,24 +297,38 @@ def _flash(scale, block_q, block_k, q, k, v):
     def to_bhsd(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
-    out = _flash_fwd_bhsd(
-        to_bhsd(q), to_bhsd(k), to_bhsd(v), scale, block_q, block_k
+    out, _ = _flash_fwd_bhsd(
+        to_bhsd(q), to_bhsd(k), to_bhsd(v), scale, block_q, block_k,
+        want_lse=False,
     )
     return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
 def _flash_vjp_fwd(scale, block_q, block_k, q, k, v):
-    return _flash(scale, block_q, block_k, q, k, v), (q, k, v)
+    b, s, h, d = q.shape
+
+    def to_bhsd(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    qm, km, vm = to_bhsd(q), to_bhsd(k), to_bhsd(v)
+    out, lse = _flash_fwd_bhsd(qm, km, vm, scale, block_q, block_k)
+    return (
+        out.reshape(b, h, s, d).transpose(0, 2, 1, 3),
+        (qm, km, vm, out, lse, (b, s, h, d)),
+    )
 
 
 def _flash_vjp_bwd(scale, block_q, block_k, residuals, g):
-    from ray_lightning_tpu.ops.attention import xla_causal_attention
-
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: xla_causal_attention(q_, k_, v_, scale), q, k, v
+    qm, km, vm, out, lse, (b, s, h, d) = residuals
+    gm = g.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    dq, dk, dv = _flash_bwd_bhsd(
+        qm, km, vm, out, lse, gm, scale, block_q, block_k
     )
-    return vjp(g)
+
+    def from_bhsd(x):
+        return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+    return from_bhsd(dq), from_bhsd(dk), from_bhsd(dv)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -150,5 +351,10 @@ def flash_attention(
         raise ValueError(
             f"seq_len {s} must be divisible by block_q={block_q} and "
             f"block_k={block_k}"
+        )
+    if block_k % _LANE:
+        raise ValueError(
+            f"block_k={block_k} must be a multiple of {_LANE} (per-row "
+            f"stats are stored {_LANE}-lane broadcast)"
         )
     return _flash(scale, block_q, block_k, q, k, v)

@@ -49,6 +49,54 @@ def test_flash_grad_matches_xla(qkv):
         assert float(jnp.abs(a - b).max()) < 1e-4
 
 
+@pytest.mark.parametrize("block_q,block_k", [(128, 256), (256, 128)])
+def test_flash_grad_uneven_blocks(qkv, block_q, block_k):
+    """The dq/dkv kernels walk each other's axis in the *other* block
+    size — both divisibility directions must stay correct."""
+    q, k, v = qkv
+
+    def loss_flash(q, k, v):
+        return (flash_attention(
+            q, k, v, block_q=block_q, block_k=block_k) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        return (xla_causal_attention(q, k, v) ** 2).sum()
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        assert float(jnp.abs(a - b).max()) < 1e-4
+
+
+def test_flash_grad_matches_xla_bf16(qkv):
+    """bf16 inputs: f32 accumulators inside the kernels keep the error at
+    bf16-rounding scale (the VERDICT-specified 1e-2 budget)."""
+    q, k, v = (x.astype(jnp.bfloat16) for x in qkv)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(
+            q, k, v, block_q=128, block_k=128).astype(jnp.float32) ** 2
+        ).sum()
+
+    def loss_ref(q, k, v):
+        return (xla_causal_attention(q, k, v).astype(jnp.float32) ** 2).sum()
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        denom = max(float(jnp.abs(b.astype(jnp.float32)).max()), 1.0)
+        rel = float(
+            jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)).max()
+        ) / denom
+        assert rel < 1e-2
+
+
+def test_flash_rejects_lane_misaligned_block_k(qkv):
+    q, k, v = qkv
+    with pytest.raises(ValueError, match="multiple of 128"):
+        flash_attention(q, k, v, block_q=128, block_k=64)
+
+
 def test_flash_rejects_ragged_seq(qkv):
     q, k, v = qkv
     with pytest.raises(ValueError, match="divisible"):
