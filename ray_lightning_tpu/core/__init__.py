@@ -4,6 +4,7 @@ from .callbacks import (
     Callback,
     ModelCheckpoint,
     EarlyStopping,
+    CSVLogger,
     DeviceStatsCallback,
 )
 from .loop import FitConfig
@@ -19,6 +20,7 @@ __all__ = [
     "Callback",
     "ModelCheckpoint",
     "EarlyStopping",
+    "CSVLogger",
     "DeviceStatsCallback",
     "FitConfig",
     "Trainer",

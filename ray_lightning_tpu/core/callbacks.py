@@ -25,6 +25,7 @@ __all__ = [
     "Callback",
     "ModelCheckpoint",
     "EarlyStopping",
+    "CSVLogger",
     "DeviceStatsCallback",
     "ProfilerCallback",
 ]
@@ -221,6 +222,77 @@ class EarlyStopping(Callback):
     def load_state_dict(self, state: Dict[str, Any]) -> None:
         self.best = state.get("best")
         self.wait = state.get("wait", 0)
+
+
+class CSVLogger(Callback):
+    """Persist the training/validation curves to ``metrics.csv``.
+
+    ≙ the Lightning loggers (CSV/TensorBoard) the reference inherits for
+    free (``trainer.logged_metrics`` consumers, reference
+    ``ray_ddp.py:377-385``): one row per epoch (and per val epoch) with
+    the union of all metric keys seen so far.  Rank-0-only file writes;
+    rows also round-trip worker→driver via ``state_dict`` so the
+    driver-side callback object can be queried (``.rows`` / ``.path``)
+    after a remote fit even without a shared filesystem.
+    """
+
+    def __init__(self, dirpath: Optional[str] = None,
+                 filename: str = "metrics.csv"):
+        self.dirpath = dirpath
+        self.filename = filename
+        self.rows: list = []
+
+    @property
+    def path(self) -> Optional[str]:
+        if self.dirpath is None:
+            return None
+        return os.path.join(self.dirpath, self.filename)
+
+    def setup(self, trainer, module, stage: str) -> None:
+        if self.dirpath is None:
+            self.dirpath = os.path.join(trainer.default_root_dir, "csv")
+
+    def _append(self, trainer) -> None:
+        row = {
+            "epoch": trainer.current_epoch,
+            "step": trainer.global_step,
+            **{k: float(v) for k, v in trainer.callback_metrics.items()},
+        }
+        self.rows.append(row)
+        if trainer.is_global_zero:
+            self._flush()
+
+    def _flush(self) -> None:
+        import csv
+
+        # Key sets can grow (val metrics appear after the first val epoch),
+        # so rewrite the whole file each flush — atomically, so a reader
+        # (or a crashed run) never sees a torn file.
+        keys: list = []
+        for row in self.rows:
+            for k in row:
+                if k not in keys:
+                    keys.append(k)
+        os.makedirs(self.dirpath, exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", newline="") as f:
+            writer = csv.DictWriter(f, fieldnames=keys)
+            writer.writeheader()
+            writer.writerows(self.rows)
+        os.replace(tmp, self.path)
+
+    def on_train_epoch_end(self, trainer, module) -> None:
+        self._append(trainer)
+
+    def on_validation_epoch_end(self, trainer, module) -> None:
+        self._append(trainer)
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"rows": list(self.rows), "dirpath": self.dirpath}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.rows = list(state.get("rows", []))
+        self.dirpath = state.get("dirpath", self.dirpath)
 
 
 class ProfilerCallback(Callback):

@@ -50,6 +50,12 @@ class FitConfig:
     limit_train_batches: int = -1
     limit_val_batches: int = -1
     log_every_n_steps: int = 50
+    # Apply the optimizer once every k micro-batches (optax.MultiSteps
+    # under the hood): k micro-steps of batch B train like one step of
+    # batch k*B (≙ Lightning's ``accumulate_grad_batches``).  As in
+    # Lightning, ``max_steps`` counts OPTIMIZER steps (k micro-batches
+    # each); ``global_step``/``log_every_n_steps`` count micro-batches.
+    accumulate_grad_batches: int = 1
     seed: int = 0
     precision: str = "f32"
     default_root_dir: str = "."
@@ -149,6 +155,15 @@ class LoopContext:
 def _call_hooks(callbacks: List[Callback], hook: str, *args) -> None:
     for cb in callbacks:
         getattr(cb, hook)(*args)
+
+
+def _log_lr(ctx: "LoopContext", lr_schedule, accum: int) -> None:
+    """Log the schedule's current learning rate (the second half of the
+    ``configure_optimizers`` contract).  One optimizer step happens per
+    ``accum`` micro-steps, so the schedule is indexed by optimizer steps."""
+    if lr_schedule is None:
+        return
+    ctx.log_metrics({"lr": float(lr_schedule(ctx.global_step // accum))})
 
 
 def _mean_logs(device_logs: List[Dict[str, Any]]) -> Dict[str, float]:
@@ -298,8 +313,18 @@ def run_fit(
     # configure_optimizers may return (tx, lr_schedule); careful — a bare
     # optax.GradientTransformation is itself a NamedTuple, so test for the
     # optimizer interface rather than tuple-ness.
+    lr_schedule = None
     if isinstance(tx, tuple) and not hasattr(tx, "init"):
-        tx = tx[0]
+        tx, lr_schedule = tx[0], (tx[1] if len(tx) > 1 else None)
+    accum = max(int(config.accumulate_grad_batches), 1)
+    if accum > 1:
+        import optax
+
+        # MultiSteps keeps the grad accumulator inside opt_state, so ZeRO
+        # sharding, donation and checkpointing all see it as ordinary
+        # optimizer state (params-shaped ⇒ the suffix-matching sharding
+        # rule reuses the parameter specs).
+        tx = optax.MultiSteps(tx, every_k_schedule=accum)
 
     ctx = LoopContext(config, global_rank, world_size, mesh, queue, tx)
     ctx.step_mode = mode
@@ -384,7 +409,9 @@ def run_fit(
             if config.limit_train_batches >= 0 else None
         )
         if config.max_steps >= 0:
-            remaining = max(config.max_steps - ctx.global_step, 0)
+            # max_steps counts optimizer steps; the loop (and the cap)
+            # run in micro-batches.
+            remaining = max(config.max_steps * accum - ctx.global_step, 0)
             cap = remaining if cap is None else min(cap, remaining)
         source = (
             train_loader if cap is None
@@ -399,7 +426,10 @@ def run_fit(
             ):
                 break
             # Check BEFORE executing: max_steps=0 must train zero steps.
-            if config.max_steps >= 0 and ctx.global_step >= config.max_steps:
+            if (
+                config.max_steps >= 0
+                and ctx.global_step // accum >= config.max_steps
+            ):
                 stop = True
                 break
             rng = jax.random.fold_in(base_rng, ctx.global_step)
@@ -408,12 +438,14 @@ def run_fit(
             ctx.global_step += 1
             if ctx.global_step % config.log_every_n_steps == 0:
                 ctx.log_metrics(jax.device_get(logs))
+                _log_lr(ctx, lr_schedule, accum)
             _call_hooks(
                 callbacks, "on_train_batch_end", ctx, module, logs, batch_idx
             )
 
         train_metrics = _mean_logs(epoch_logs)
         ctx.log_metrics(train_metrics)
+        _log_lr(ctx, lr_schedule, accum)
         module.on_train_epoch_end(epoch, train_metrics)
 
         # -- validation ----------------------------------------------------
@@ -512,9 +544,16 @@ def _resolve_params(
     mesh,
     params_stream: Optional[bytes],
     ckpt_path: Optional[str],
+    zero_stage: int = 0,
 ):
     """Parameter source for fit-less eval/predict (≙ test-without-fit,
-    reference ``test_ddp_sharded.py:108-116``)."""
+    reference ``test_ddp_sharded.py:108-116``).
+
+    Placement honors the module's TP specs and ZeRO-3 param sharding —
+    a sharded model is never replicated onto every device just to eval
+    (returns ``(params, params_shardings)``; shardings are ``None`` off
+    -mesh).
+    """
     if ckpt_path:
         payload = load_state_stream(state_stream_from_file(ckpt_path))
         host_params = payload["state"].params
@@ -522,15 +561,29 @@ def _resolve_params(
         host_params = load_state_stream(params_stream)
     else:
         host_params = None
+    if mesh is None:
+        if host_params is None:
+            params = jax.jit(module.init_params)(
+                jax.random.PRNGKey(config.seed)
+            )
+        else:
+            params = jax.device_put(host_params)
+        return params, None
+    abstract = (
+        jax.eval_shape(module.init_params, jax.random.PRNGKey(config.seed))
+        if host_params is None
+        else jax.eval_shape(lambda: host_params)
+    )
+    shardings = shardlib.params_shardings_for_module(
+        module, abstract, mesh, zero_stage
+    )
     if host_params is None:
-        params = jax.jit(module.init_params)(jax.random.PRNGKey(config.seed))
-    elif mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as P
-
-        params = jax.device_put(host_params, NamedSharding(mesh, P()))
+        params = jax.jit(
+            module.init_params, out_shardings=shardings
+        )(jax.random.PRNGKey(config.seed))
     else:
-        params = jax.device_put(host_params)
-    return params
+        params = jax.device_put(host_params, shardings)
+    return params, shardings
 
 
 def run_eval(
@@ -543,6 +596,7 @@ def run_eval(
     world_size: int = 1,
     mesh=None,
     mode: str = "gspmd",
+    zero_stage: int = 0,
     params_stream: Optional[bytes] = None,
     ckpt_path: Optional[str] = None,
     queue=None,
@@ -558,7 +612,9 @@ def run_eval(
     datamodule.setup(stage)
     _call_hooks(callbacks, "setup", ctx, module, stage)
 
-    params = _resolve_params(module, config, mesh, params_stream, ckpt_path)
+    params, params_shardings = _resolve_params(
+        module, config, mesh, params_stream, ckpt_path, zero_stage
+    )
     ctx.state = TrainState(params, None, 0)
 
     loader = (
@@ -568,7 +624,9 @@ def run_eval(
     )
     if loader is None:
         raise ValueError(f"datamodule provides no {kind} dataloader")
-    eval_step = step_fns.build_eval_step(module, mesh, kind, mode=mode)
+    eval_step = step_fns.build_eval_step(
+        module, mesh, kind, mode=mode, params_shardings=params_shardings
+    )
     metrics = _run_validation(
         module, eval_step, loader, ctx, config.limit_val_batches
     )
@@ -587,6 +645,7 @@ def run_predict(
     global_rank: int = 0,
     world_size: int = 1,
     mesh=None,
+    zero_stage: int = 0,
     params_stream: Optional[bytes] = None,
     ckpt_path: Optional[str] = None,
 ) -> Dict[str, Any]:
@@ -599,8 +658,12 @@ def run_predict(
     module.setup("predict")
     datamodule.set_shard(global_rank, world_size)
     datamodule.setup("predict")
-    params = _resolve_params(module, config, mesh, params_stream, ckpt_path)
-    predict_step = step_fns.build_predict_step(module, mesh)
+    params, params_shardings = _resolve_params(
+        module, config, mesh, params_stream, ckpt_path, zero_stage
+    )
+    predict_step = step_fns.build_predict_step(
+        module, mesh, params_shardings=params_shardings
+    )
     loader = datamodule.predict_dataloader() or datamodule.test_dataloader()
     if loader is None:
         raise ValueError("datamodule provides no predict/test dataloader")

@@ -84,6 +84,7 @@ class Trainer:
         limit_train_batches: int = -1,
         limit_val_batches: int = -1,
         log_every_n_steps: int = 50,
+        accumulate_grad_batches: int = 1,
         enable_checkpointing: bool = True,
         fast_dev_run: bool = False,
         resume_from_checkpoint: Optional[str] = None,
@@ -113,6 +114,7 @@ class Trainer:
             limit_train_batches=limit_train_batches,
             limit_val_batches=limit_val_batches,
             log_every_n_steps=log_every_n_steps,
+            accumulate_grad_batches=accumulate_grad_batches,
             seed=seed,
             precision=precision,
             default_root_dir=default_root_dir,
@@ -269,7 +271,17 @@ class Trainer:
         # results only.)
         ordered = sorted(results, key=lambda r: r["rank"])
         per_rank = [r["prediction_batches"] for r in ordered]
-        num_batches = min(len(b) for b in per_rank)
+        counts = {len(b) for b in per_rank}
+        if len(counts) > 1:
+            # A rank with fewer batches would silently drop the other
+            # ranks' tail predictions; make the data-sharding bug loud.
+            raise ValueError(
+                "Ragged per-rank prediction batch counts "
+                f"{[len(b) for b in per_rank]}: every rank must see the "
+                "same number of batches (check the datamodule's sharding "
+                "/ drop_last handling)."
+            )
+        num_batches = counts.pop() if counts else 0
         batches = [
             np.concatenate([per_rank[rank][b] for rank in range(len(per_rank))])
             for b in range(num_batches)

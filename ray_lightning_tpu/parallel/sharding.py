@@ -32,6 +32,7 @@ __all__ = [
     "shard_leaf_spec",
     "zero_state_shardings",
     "state_shardings_for_module",
+    "params_shardings_for_module",
     "make_global_batch",
 ]
 
@@ -159,6 +160,62 @@ def _merge_zero_axis(
     return P(*entries)
 
 
+def _zero_axis_size(mesh: Mesh, zero_stage: int):
+    """(zero_stage, axis_name, axis_size) with stage forced to 0 on a
+    mesh with no batch-parallel axis to shard state over."""
+    zero_axis = default_zero_axis(mesh)
+    if zero_axis is None:
+        return 0, None, 1
+    return zero_stage, zero_axis, mesh.shape[zero_axis]
+
+
+def _module_param_specs(module: Any, abstract_params: Any, mesh: Mesh) -> Any:
+    """The module's published TP/SP PartitionSpecs (sanitized against the
+    active mesh), or all-replicated specs if it publishes none."""
+    spec_fn = getattr(module, "param_partition_specs", None)
+    if spec_fn is not None:
+        return jax.tree_util.tree_map(
+            lambda s: _sanitize_spec(s, mesh),
+            spec_fn(),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+    return jax.tree_util.tree_map(lambda _: P(), abstract_params)
+
+
+def params_shardings_for_module(
+    module: Any,
+    abstract_params: Any,
+    mesh: Mesh,
+    zero_stage: int = 0,
+    min_leaf_size: int = 2**12,
+) -> Any:
+    """NamedShardings for a bare params pytree (module TP specs + ZeRO-3).
+
+    The params half of :func:`state_shardings_for_module` (which delegates
+    here, so fit-time and eval-time param layouts can never diverge) —
+    fit-less eval/predict must place a ZeRO-3 model with its *sharded*
+    layout rather than replicating it onto every host (which would defeat
+    param sharding at exactly the model sizes it targets).
+    """
+    zero_stage, zero_axis, axis_size = _zero_axis_size(mesh, zero_stage)
+    param_specs = _module_param_specs(module, abstract_params, mesh)
+
+    def finalize(spec: P, leaf) -> NamedSharding:
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if zero_stage >= 3:
+            spec = _merge_zero_axis(
+                spec, shape, axis_size, zero_axis, min_leaf_size
+            )
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map(
+        finalize,
+        param_specs,
+        abstract_params,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
 def state_shardings_for_module(
     module: Any,
     abstract_state: Any,
@@ -193,23 +250,14 @@ def state_shardings_for_module(
             default_zero_axis(mesh), min_leaf_size,
         )
 
-    zero_axis = default_zero_axis(mesh)
-    if zero_axis is None:
-        zero_stage = 0  # pure model-parallel mesh: TP specs only
-        axis_size = 1
-    else:
-        axis_size = mesh.shape[zero_axis]
-    spec_fn = getattr(module, "param_partition_specs", None)
-    if spec_fn is not None:
-        param_specs = jax.tree_util.tree_map(
-            lambda s: _sanitize_spec(s, mesh),
-            spec_fn(),
-            is_leaf=lambda x: isinstance(x, P),
-        )
-    else:
-        param_specs = jax.tree_util.tree_map(
-            lambda _: P(), abstract_state.params
-        )
+    zero_stage, zero_axis, axis_size = _zero_axis_size(mesh, zero_stage)
+    # TP specs (unmerged — the opt-state lookup below layers its own ZeRO
+    # merge, which must start from the pre-ZeRO spec) and the final param
+    # shardings, via the shared params path.
+    param_specs = _module_param_specs(module, abstract_state.params, mesh)
+    params_sh = params_shardings_for_module(
+        module, abstract_state.params, mesh, zero_stage, min_leaf_size
+    )
 
     def finalize(spec: P, leaf, shard_it: bool) -> NamedSharding:
         shape = tuple(getattr(leaf, "shape", ()) or ())
@@ -218,13 +266,6 @@ def state_shardings_for_module(
                 spec, shape, axis_size, zero_axis, min_leaf_size
             )
         return NamedSharding(mesh, spec)
-
-    params_sh = jax.tree_util.tree_map(
-        lambda spec, leaf: finalize(spec, leaf, zero_stage >= 3),
-        param_specs,
-        abstract_state.params,
-        is_leaf=lambda x: isinstance(x, P),
-    )
 
     # Path-indexed spec lookup for optimizer moments.
     flat_params = jax.tree_util.tree_flatten_with_path(abstract_state.params)[0]

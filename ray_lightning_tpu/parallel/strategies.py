@@ -140,6 +140,7 @@ def _execute_remote(task_ref, global_rank: int, queue_handle) -> Dict[str, Any]:
                 callbacks=task["callbacks"],
                 kind=kind,
                 mode=task["mode"],
+                zero_stage=task["zero_stage"],
                 params_stream=task.get("params_stream"),
                 ckpt_path=task.get("ckpt_path"),
                 queue=queue_handle,
@@ -147,6 +148,7 @@ def _execute_remote(task_ref, global_rank: int, queue_handle) -> Dict[str, Any]:
             )
         if kind == "predict":
             return run_predict(
+                zero_stage=task["zero_stage"],
                 params_stream=task.get("params_stream"),
                 ckpt_path=task.get("ckpt_path"),
                 **common,
@@ -493,10 +495,12 @@ class LocalStrategy(TpuStrategy):
                             zero_stage=self.zero_stage, **common)]
         if kind in ("validation", "test"):
             return [run_eval(callbacks=callbacks, kind=kind, mode=self.mode,
+                             zero_stage=self.zero_stage,
                              params_stream=params_stream,
                              ckpt_path=ckpt_path, **common)]
         if kind == "predict":
-            return [run_predict(params_stream=params_stream,
+            return [run_predict(zero_stage=self.zero_stage,
+                                params_stream=params_stream,
                                 ckpt_path=ckpt_path, **common)]
         raise ValueError(f"Unknown stage kind {kind!r}")
 

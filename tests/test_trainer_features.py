@@ -1,0 +1,211 @@
+"""Trainer-surface features: lr schedules, gradient accumulation, CSV
+logging, predict/eval hardening, shard_map x ZeRO/TP refusal.
+
+≙ the Lightning-inherited surface the reference gets for free
+(``accumulate_grad_batches``, loggers, ``configure_optimizers`` returning
+scheduler info) — here first-class framework features (VERDICT r1 items
+7-10).
+"""
+
+import csv
+import types
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from ray_lightning_tpu.core.callbacks import CSVLogger
+from ray_lightning_tpu.core.data import ArrayDataset, NumpyLoader, TpuDataModule
+from ray_lightning_tpu.core.trainer import Trainer
+from ray_lightning_tpu.models import BoringDataModule, BoringModel
+from ray_lightning_tpu.models.gpt import GPT, GPTConfig, SyntheticLMDataModule
+from ray_lightning_tpu.parallel.strategies import LocalStrategy
+
+
+class ScheduledBoring(BoringModel):
+    """BoringModel whose configure_optimizers returns (tx, lr_schedule)."""
+
+    def configure_optimizers(self):
+        schedule = optax.linear_schedule(0.1, 0.0, 100)
+        return optax.sgd(schedule), schedule
+
+
+class FixedDataModule(TpuDataModule):
+    """Deterministic rows so two runs see byte-identical data."""
+
+    def __init__(self, x: np.ndarray, batch_size: int):
+        super().__init__()
+        self.x = x
+        self.batch_size = batch_size
+
+    def train_dataloader(self):
+        return NumpyLoader(
+            ArrayDataset(x=self.x), batch_size=self.batch_size,
+            shard_index=self.shard_index, num_shards=self.num_shards,
+        )
+
+
+def test_lr_schedule_is_logged(tmp_path):
+    trainer = Trainer(
+        strategy=LocalStrategy(), max_epochs=1, limit_train_batches=2,
+        default_root_dir=str(tmp_path), enable_checkpointing=False,
+    )
+    trainer.fit(ScheduledBoring(), BoringDataModule())
+    assert "lr" in trainer.callback_metrics
+    expected = float(optax.linear_schedule(0.1, 0.0, 100)(
+        trainer.global_step))
+    assert trainer.callback_metrics["lr"] == pytest.approx(expected)
+
+
+def test_grad_accumulation_parity(tmp_path):
+    """k micro-steps of batch B must train exactly like 1 step of batch
+    k*B for SGD (the VERDICT-specified accumulation contract)."""
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 32)).astype(np.float32)
+
+    def run(batch_size, accumulate):
+        trainer = Trainer(
+            strategy=LocalStrategy(), max_epochs=1,
+            accumulate_grad_batches=accumulate,
+            default_root_dir=str(tmp_path), enable_checkpointing=False,
+        )
+        trainer.fit(
+            BoringModel(), FixedDataModule(x, batch_size=batch_size)
+        )
+        return trainer.params
+
+    p_micro = run(batch_size=8, accumulate=2)    # 2 micro-steps of 8
+    p_full = run(batch_size=16, accumulate=1)    # 1 step of 16
+    for a, b in zip(
+        jax.tree_util.tree_leaves(p_micro), jax.tree_util.tree_leaves(p_full)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_max_steps_counts_optimizer_steps(tmp_path):
+    """max_steps means optimizer steps (Lightning semantics): with
+    accumulate_grad_batches=2, max_steps=1 runs TWO micro-batches."""
+    x = np.random.default_rng(0).standard_normal((32, 32)).astype(np.float32)
+    trainer = Trainer(
+        strategy=LocalStrategy(), max_epochs=10, max_steps=1,
+        accumulate_grad_batches=2, default_root_dir=str(tmp_path),
+        enable_checkpointing=False,
+    )
+    trainer.fit(BoringModel(), FixedDataModule(x, batch_size=8))
+    assert trainer.global_step == 2  # micro-batches; 1 optimizer update
+
+
+def test_shard_map_eval_refuses_sharded_params(tmp_path):
+    trainer = Trainer(
+        strategy=LocalStrategy(
+            mode="shard_map", mesh_axes={"data": 4, "tensor": 2}
+        ),
+        default_root_dir=str(tmp_path), enable_checkpointing=False,
+        limit_val_batches=1,
+    )
+    cfg = GPTConfig.tiny()
+    with pytest.raises(ValueError, match="shard_map"):
+        trainer.validate(
+            GPT(cfg), SyntheticLMDataModule(cfg, batch_size=8, num_batches=1)
+        )
+
+
+def test_csv_logger_writes_curves(tmp_path):
+    logger = CSVLogger(dirpath=str(tmp_path / "csv"))
+    trainer = Trainer(
+        strategy=LocalStrategy(), max_epochs=2,
+        default_root_dir=str(tmp_path), enable_checkpointing=False,
+        callbacks=[logger],
+    )
+    trainer.fit(BoringModel(), BoringDataModule())
+    assert logger.path is not None
+    with open(logger.path, newline="") as f:
+        rows = list(csv.DictReader(f))
+    assert len(rows) >= 2
+    assert "train_loss" in rows[0] and "epoch" in rows[0]
+    # Val metrics appear in the header once validation has run.
+    assert "val_loss" in rows[-1]
+    assert float(rows[-1]["train_loss"]) == pytest.approx(
+        trainer.callback_metrics["train_loss"], rel=1e-6
+    )
+    # Driver-side object holds the rows too (worker->driver round trip).
+    assert len(logger.rows) == len(rows)
+
+
+def test_predict_raises_on_ragged_rank_batches(tmp_path):
+    trainer = Trainer(
+        strategy=LocalStrategy(), default_root_dir=str(tmp_path),
+        enable_checkpointing=False,
+    )
+    ragged = [
+        {"rank": 0, "prediction_batches": [np.zeros(4), np.zeros(4)]},
+        {"rank": 1, "prediction_batches": [np.zeros(4)]},
+    ]
+    trainer.strategy = types.SimpleNamespace(
+        setup=lambda t: None,
+        run=lambda *a, **k: ragged,
+        teardown=lambda: None,
+    )
+    with pytest.raises(ValueError, match="Ragged"):
+        trainer.predict(BoringModel(), BoringDataModule())
+
+
+def test_shard_map_refuses_zero_stage(tmp_path):
+    trainer = Trainer(
+        strategy=LocalStrategy(mode="shard_map", zero_stage=1),
+        max_epochs=1, default_root_dir=str(tmp_path),
+        enable_checkpointing=False,
+    )
+    with pytest.raises(ValueError, match="shard_map.*zero_stage"):
+        trainer.fit(BoringModel(), BoringDataModule())
+
+
+def test_shard_map_refuses_tensor_parallel_module(tmp_path):
+    trainer = Trainer(
+        strategy=LocalStrategy(
+            mode="shard_map", mesh_axes={"data": 4, "tensor": 2}
+        ),
+        max_epochs=1, default_root_dir=str(tmp_path),
+        enable_checkpointing=False,
+    )
+    cfg = GPTConfig.tiny()
+    with pytest.raises(ValueError, match="shard_map"):
+        trainer.fit(
+            GPT(cfg), SyntheticLMDataModule(cfg, batch_size=8, num_batches=1)
+        )
+
+
+def test_fitless_eval_uses_zero3_shardings():
+    """_resolve_params must place a ZeRO-3 model sharded, not replicated."""
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    from ray_lightning_tpu.core.loop import FitConfig, _resolve_params
+
+    mesh = Mesh(mesh_utils.create_device_mesh((8,)), ("data",))
+    module = GPT(GPTConfig.tiny())
+    params, shardings = _resolve_params(
+        module, FitConfig(), mesh, params_stream=None, ckpt_path=None,
+        zero_stage=3,
+    )
+    specs = [
+        leaf.sharding.spec
+        for leaf in jax.tree_util.tree_leaves(params)
+    ]
+    assert any(
+        any(e is not None for e in spec) for spec in specs
+    ), "ZeRO-3 eval params ended up fully replicated"
+
+
+def test_fitless_validate_runs_sharded(tmp_path):
+    cfg = GPTConfig.tiny()
+    trainer = Trainer(
+        strategy=LocalStrategy(mesh_axes={"data": 8}, zero_stage=3),
+        default_root_dir=str(tmp_path), enable_checkpointing=False,
+        limit_val_batches=1,
+    )
+    metrics = trainer.validate(
+        GPT(cfg), SyntheticLMDataModule(cfg, batch_size=8, num_batches=1)
+    )
+    assert np.isfinite(metrics["val_loss"])
