@@ -276,7 +276,25 @@ class GPT(TpuModule):
     def forward_with_aux(
         self, params: Dict[str, Any], tokens: jax.Array
     ) -> Tuple[jax.Array, jax.Array]:
-        """(logits, moe_aux_loss) — aux is 0.0 for dense configs."""
+        """(logits, moe_aux_loss) — aux is 0.0 for dense configs.
+
+        Materializes the full ``(B, T, V)`` logits tensor — inference /
+        predict path only.  The training loss goes through
+        :meth:`forward_hidden` + the vocab-chunked fused cross-entropy
+        (``ops/cross_entropy.py``) so that tensor never exists.
+        """
+        x, aux = self.forward_hidden(params, tokens)
+        c = self._compute_dtype()
+        logits = jnp.einsum(
+            "btd,vd->btv", x, params["wte"].astype(c),
+            preferred_element_type=jnp.float32,
+        )
+        return logits, aux
+
+    def forward_hidden(
+        self, params: Dict[str, Any], tokens: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        """Transformer trunk: tokens -> (final hidden (B, T, d), moe_aux)."""
         cfg = self.config
         c = self._compute_dtype()
         B, T = tokens.shape
@@ -327,19 +345,22 @@ class GPT(TpuModule):
         # routing ⇒ aux ≈ 1 at any n_layer).
         aux = aux / max(cfg.n_layer, 1)
         x = _layer_norm(x, params["ln_f_g"], params["ln_f_b"])
-        # Tied LM head; logits in float32 for a stable softmax.
-        logits = jnp.einsum(
-            "btd,vd->btv", x, params["wte"].astype(c),
-            preferred_element_type=jnp.float32,
-        )
-        return logits, aux
+        return x, aux
 
     # -- steps --------------------------------------------------------------
     def _loss(self, params, tokens):
+        from ray_lightning_tpu.ops.cross_entropy import (
+            fused_lm_head_cross_entropy,
+        )
+
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        logits, aux = self.forward_with_aux(params, inputs)
-        loss = optax.softmax_cross_entropy_with_integer_labels(
-            logits, targets
+        x, aux = self.forward_hidden(params, inputs)
+        # Fused tied-LM-head CE: the (B, T, V) logits tensor (3.3 GB f32
+        # for GPT-2-small at B=16) is never materialized — the head
+        # matmul, logsumexp and label gather run per vocab chunk.
+        loss = fused_lm_head_cross_entropy(
+            x, params["wte"], targets,
+            compute_dtype=self._compute_dtype(),
         ).mean()
         return loss, aux
 

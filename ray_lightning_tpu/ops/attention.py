@@ -69,13 +69,10 @@ def _flash_supported(q: jax.Array) -> bool:
     _, s, _, d = q.shape
     from ray_lightning_tpu.ops import flash_attention as fa
 
-    # Kernel constraints: the effective block is min(DEFAULT_BLOCK, s), so
-    # seq must divide into it AND the block must be a multiple of 128 —
-    # per-row softmax stats (lse/delta) are stored broadcast across a
-    # 128-lane minor dim, and the backward kernels tile them in
-    # block_k/128 repeats.
-    block = min(fa.DEFAULT_BLOCK_Q, s)
-    return s % block == 0 and block % 128 == 0 and d in (64, 128, 256)
+    # Kernel constraints: some 128-multiple block must divide seq (per-row
+    # softmax stats are stored broadcast across a 128-lane minor dim, and
+    # the backward kernels tile them in block_k/128 repeats).
+    return fa.pick_block(s) is not None and d in (64, 128, 256)
 
 
 def causal_attention(

@@ -1,0 +1,227 @@
+"""Fused LM-head + cross-entropy, vocab-chunked — no (B, T, V) tensor.
+
+The naive LM loss (``logits = x @ wte.T`` then softmax-CE) materializes a
+``(B, T, V)`` float32 logits tensor in HBM — for GPT-2-small at B=16,
+T=1024, V=50304 that is a ~3.3 GB intermediate written and re-read every
+step (and its ``(B, T, V)`` gradient again in the backward), which alone
+costs ~17% of the step on a v5e.  The reference framework never faces
+this because its models are external torch modules
+(``/root/reference/examples/ray_ddp_sharded_example.py:48-71``); a
+TPU-native framework that owns its flagship LM must own the fix.
+
+Design (TPU/XLA-first):
+
+* **Vocab chunking with online logsumexp.**  ``lax.scan`` over chunks of
+  the vocabulary: each iteration computes ``(B, T, Vc)`` logits on the
+  fly (bf16 MXU matmul, f32 accumulation), folds them into running
+  ``(max, sumexp)`` statistics and the gathered gold-label logit, then
+  discards them.  Peak live logits memory drops from ``N*V`` to
+  ``N*Vc``.
+* **Why chunk vocab, not tokens:** under GSPMD the batch/seq dims are
+  sharded over the ``data``(+``fsdp``/``sp``) mesh axes and ``wte`` is
+  feature-sharded ``P(None, "tensor")`` (see
+  ``models/gpt.py:param_partition_specs``).  Scanning over *vocab* rows
+  slices only the replicated dim — no resharding, no cross-device
+  gathers; the contraction over the tensor-sharded ``d`` stays a local
+  matmul + psum exactly as in the unchunked head.
+* **Custom VJP with chunk recompute.**  Residuals are just
+  ``(x, wte, targets, lse)`` — the backward rebuilds each chunk's
+  logits, forms ``dlogits = (softmax - onehot) * g`` chunk-locally, and
+  accumulates ``dx`` (f32 carry) and the per-chunk ``dwte`` rows.  The
+  ``(B, T, V)`` gradient tensor never exists either.
+
+Numerics: matmuls run in ``compute_dtype`` (bf16 on TPU) with float32
+``preferred_element_type`` accumulation; softmax statistics, the loss and
+both gradients accumulate in float32.  With ``compute_dtype=float32``
+the result matches the naive path to ~1e-6 (tested in
+``tests/test_ops.py``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["fused_lm_head_cross_entropy", "naive_lm_head_cross_entropy"]
+
+_NEG_INF = -1e30  # finite stand-in for -inf: keeps exp/max well-defined
+
+
+def _pick_num_chunks(vocab_size: int, target_chunk: int = 8192) -> int:
+    return max(1, -(-vocab_size // target_chunk))  # ceil div
+
+
+def _chunk_wte(wte: jax.Array, num_chunks: int) -> Tuple[jax.Array, int]:
+    """(V, d) -> (K, Vc, d), zero-padding V up to K*Vc.
+
+    Vc is rounded up to a multiple of 128 so every chunk matmul and the
+    (..., Vc) softmax/onehot ops tile cleanly on the 8x128 vector lanes
+    (the valid-mask already neutralizes the padded rows)."""
+    V, d = wte.shape
+    Vc = -(-V // num_chunks)
+    Vc = -(-Vc // 128) * 128
+    pad = num_chunks * Vc - V
+    if pad:
+        wte = jnp.concatenate(
+            [wte, jnp.zeros((pad, d), wte.dtype)], axis=0
+        )
+    return wte.reshape(num_chunks, Vc, d), Vc
+
+
+def _chunk_logits(x, wte_chunk, offset, vocab_size, compute_dtype):
+    """x (..., d) @ wte_chunk (Vc, d)^T -> (..., Vc) f32, padded rows
+    masked to -inf."""
+    Vc = wte_chunk.shape[0]
+    logits = jnp.einsum(
+        "...d,vd->...v",
+        x.astype(compute_dtype),
+        wte_chunk.astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    )
+    # Mask vocab ids >= vocab_size (zero-padded rows of the last chunk).
+    valid = (offset + jnp.arange(Vc)) < vocab_size
+    return jnp.where(valid, logits, _NEG_INF)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _fused_ce(x, wte, targets, num_chunks, compute_dtype):
+    loss, _ = _fused_ce_fwd(x, wte, targets, num_chunks, compute_dtype)
+    return loss
+
+
+def _fused_ce_fwd(x, wte, targets, num_chunks, compute_dtype):
+    V = wte.shape[0]
+    wte_chunks, Vc = _chunk_wte(wte, num_chunks)
+
+    def scan_body(carry, inp):
+        m, s, gold = carry
+        k, wc = inp
+        offset = k * Vc
+        logits = _chunk_logits(x, wc, offset, V, compute_dtype)
+        cmax = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, cmax)
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[..., None]), axis=-1
+        )
+        # Gold-label logit if the target falls in this chunk.
+        shifted = targets - offset
+        in_chunk = (shifted >= 0) & (shifted < Vc)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(shifted, 0, Vc - 1)[..., None], axis=-1
+        )[..., 0]
+        gold = jnp.where(in_chunk, picked, gold)
+        return (m_new, s, gold), None
+
+    # Derive the init carry from `targets` so it inherits the input's
+    # varying-manual-axes type under shard_map (a constant init makes the
+    # scan carry type mismatch its output inside a Manual-mesh region).
+    zeros = (targets * 0).astype(jnp.float32)
+    init = (zeros + _NEG_INF, zeros, zeros)
+    (m, s, gold), _ = jax.lax.scan(
+        scan_body, init, (jnp.arange(num_chunks), wte_chunks)
+    )
+    lse = m + jnp.log(s)
+    loss = lse - gold
+    return loss, (x, wte, targets, lse)
+
+
+def _match_vma(val: jax.Array, ref: jax.Array) -> jax.Array:
+    """psum ``val`` over manual mesh axes it varies over but ``ref`` does
+    not.  Under shard_map the cotangent of a *replicated* (unvarying)
+    primal must itself be unvarying — for built-in ops JAX inserts this
+    psum when transposing the implicit ``pvary``; a custom_vjp bwd rule
+    must do it by hand (VMA type checking rejects the rule otherwise)."""
+    try:
+        extra = tuple(sorted(jax.typeof(val).vma - jax.typeof(ref).vma))
+    except (AttributeError, TypeError):
+        return val
+    return jax.lax.psum(val, extra) if extra else val
+
+
+def _fused_ce_bwd(num_chunks, compute_dtype, res, g):
+    x, wte, targets, lse = res
+    V, d = wte.shape
+    wte_chunks, Vc = _chunk_wte(wte, num_chunks)
+    g32 = g.astype(jnp.float32)
+
+    def scan_body(dx, inp):
+        k, wc = inp
+        offset = k * Vc
+        logits = _chunk_logits(x, wc, offset, V, compute_dtype)
+        p = jnp.exp(logits - lse[..., None])
+        shifted = targets - offset
+        onehot = (
+            (shifted[..., None] == jnp.arange(Vc))
+        ).astype(jnp.float32)
+        dlogits = (p - onehot) * g32[..., None]
+        dl_c = dlogits.astype(compute_dtype)
+        dx = dx + jnp.einsum(
+            "...v,vd->...d", dl_c, wc.astype(compute_dtype),
+            preferred_element_type=jnp.float32,
+        )
+        dw_c = jnp.einsum(
+            "...v,...d->vd", dl_c, x.astype(compute_dtype),
+            preferred_element_type=jnp.float32,
+        )
+        return dx, dw_c
+
+    dx, dw_chunks = jax.lax.scan(
+        scan_body,
+        x.astype(jnp.float32) * 0,  # varying-typed zeros (see fwd init)
+        (jnp.arange(num_chunks), wte_chunks),
+    )
+    dwte = dw_chunks.reshape(num_chunks * Vc, d)[:V]
+    dtargets = np.zeros(targets.shape, jax.dtypes.float0)
+    return (
+        _match_vma(dx.astype(x.dtype), x),
+        _match_vma(dwte.astype(wte.dtype), wte),
+        dtargets,
+    )
+
+
+_fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
+
+
+def fused_lm_head_cross_entropy(
+    x: jax.Array,
+    wte: jax.Array,
+    targets: jax.Array,
+    *,
+    num_chunks: Optional[int] = None,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+) -> jax.Array:
+    """Per-token CE loss of the tied LM head, without materializing logits.
+
+    Args:
+        x: final hidden states ``(..., d)`` (any float dtype).
+        wte: tied embedding table ``(V, d)``.
+        targets: int labels, shape ``x.shape[:-1]``.
+        num_chunks: vocab chunks to scan over (default: ~8192-wide chunks).
+        compute_dtype: matmul input dtype (f32 accumulation regardless).
+
+    Returns:
+        float32 per-token losses, shape ``targets.shape``.
+    """
+    if num_chunks is None:
+        num_chunks = _pick_num_chunks(wte.shape[0])
+    return _fused_ce(x, wte, targets, num_chunks, jnp.dtype(compute_dtype))
+
+
+def naive_lm_head_cross_entropy(
+    x: jax.Array, wte: jax.Array, targets: jax.Array,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+) -> jax.Array:
+    """Reference path: full ``(..., V)`` f32 logits + softmax CE.  Used
+    for parity tests and as the small-vocab fallback."""
+    import optax
+
+    logits = jnp.einsum(
+        "...d,vd->...v",
+        x.astype(compute_dtype), wte.astype(compute_dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return optax.softmax_cross_entropy_with_integer_labels(logits, targets)

@@ -43,8 +43,22 @@ from jax.experimental import pallas as pl
 
 __all__ = ["flash_attention", "DEFAULT_BLOCK_Q", "DEFAULT_BLOCK_K"]
 
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 256
+DEFAULT_BLOCK_Q = 512  # tuned on v5e: 512² beats 256² by ~30% fwd+bwd
+DEFAULT_BLOCK_K = 512
+
+
+def pick_block(seq_len: int, prefer: int = DEFAULT_BLOCK_Q) -> Optional[int]:
+    """Largest lane-aligned block (<= prefer) that divides ``seq_len``.
+
+    Keeps short/odd sequence lengths (768, 1280, ...) on the flash path
+    instead of silently falling back when they don't divide the tuned
+    default.  Returns None when no 128-multiple block fits."""
+    block = min(prefer, seq_len)
+    while block >= 128:
+        if seq_len % block == 0 and block % 128 == 0:
+            return block
+        block //= 2
+    return None
 _NEG_INF = -1e30
 # Per-row scalars (lse, delta) are stored broadcast across this many
 # lanes so they tile natively on the TPU vector units (8×128 vregs) —
@@ -55,42 +69,54 @@ _LANE = 128
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, scale, block_q,
                 block_k, head_dim):
-    q = q_ref[0].astype(jnp.float32) * scale  # (block_q, d)
+    # MXU discipline: dot inputs stay in the CALLER's dtype (bf16 in the
+    # mixed-precision recipe — f32 inputs would run the MXU at a fraction
+    # of peak); accumulation is always f32 via preferred_element_type, and
+    # the softmax statistics never leave f32.  ``scale`` is folded into
+    # the f32 scores, not pre-multiplied into q (no bf16 rounding of q).
+    q = q_ref[0]  # (block_q, d)
     qi = pl.program_id(1)
     q_base = qi * block_q
 
-    def body(kb, carry):
-        acc, m, l = carry
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # (block_q, block_k)
-        q_pos = q_base + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
-        )
-        k_pos = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
-        acc_new = acc * corr + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        return acc_new, m_new, l_new
+    def make_body(masked):
+        def body(kb, carry):
+            acc, m, l = carry
+            k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+            v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
+            s = jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # (block_q, block_k) f32
+            if masked:
+                q_pos = q_base + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0
+                )
+                k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1
+                )
+                s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m - m_new)
+            l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
+            acc_new = acc * corr + jax.lax.dot_general(
+                p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return acc_new, m_new, l_new
+        return body
 
-    # Causal bound: the last key position this query block can see is
-    # q_base + block_q - 1, so visit cdiv(q_base + block_q, block_k) blocks.
+    # Causal structure: key blocks entirely below the diagonal need no
+    # mask (saves the iota/compare/where VPU passes on ~all blocks); only
+    # blocks straddling the diagonal mask.  Last visible block index:
+    # cdiv(q_base + block_q, block_k).
+    num_full = q_base // block_k            # fully-visible blocks
     num_kb = pl.cdiv(q_base + block_q, block_k)
     acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
     m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, num_kb, body, (acc0, m0, l0))
+    carry = jax.lax.fori_loop(0, num_full, make_body(False), (acc0, m0, l0))
+    acc, m, l = jax.lax.fori_loop(num_full, num_kb, make_body(True), carry)
     o_ref[0] = (acc / l).astype(o_ref.dtype)
     if lse_ref is not None:
         lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), (block_q, _LANE))
@@ -140,95 +166,111 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dq_ref, *,
                    scale, block_q, block_k, head_dim):
     qi = pl.program_id(1)
     q_base = qi * block_q
-    q = q_ref[0].astype(jnp.float32) * scale          # (block_q, d)
-    do = do_ref[0].astype(jnp.float32)                # (block_q, d)
+    q = q_ref[0]                                      # (block_q, d)
+    do = do_ref[0]
     reps = block_k // _LANE
     lse = jnp.tile(lse_ref[0], (1, reps))             # (block_q, block_k)
     di = jnp.tile(di_ref[0], (1, reps))
 
-    def body(kb, acc):
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        q_pos = q_base + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
-        )
-        k_pos = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse)                          # normalized probs
-        dp = jax.lax.dot_general(
-            do, v_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - di)
-        return acc + jax.lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+    def make_body(masked):
+        def body(kb, acc):
+            k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+            v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
+            s = jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            if masked:
+                q_pos = q_base + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0
+                )
+                k_pos = kb * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1
+                )
+                s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            p = jnp.exp(s - lse)                      # normalized probs
+            dp = jax.lax.dot_general(
+                do, v_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            # scale folded into ds: dq = (ds * scale) @ K.
+            ds = p * (dp - di) * scale
+            return acc + jax.lax.dot_general(
+                ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        return body
 
+    num_full = q_base // block_k
     num_kb = pl.cdiv(q_base + block_q, block_k)
     acc = jax.lax.fori_loop(
-        0, num_kb, body, jnp.zeros((block_q, head_dim), jnp.float32)
+        0, num_full, make_body(False),
+        jnp.zeros((block_q, head_dim), jnp.float32),
     )
-    dq_ref[0] = (acc * scale).astype(dq_ref.dtype)
+    acc = jax.lax.fori_loop(num_full, num_kb, make_body(True), acc)
+    dq_ref[0] = acc.astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dk_ref,
                     dv_ref, *, scale, block_q, block_k, head_dim, seq_len):
     ki = pl.program_id(1)
     k_base = ki * block_k
-    k = k_ref[0].astype(jnp.float32)                  # (block_k, d)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]                                      # (block_k, d)
+    v = v_ref[0]
     reps = block_k // _LANE
 
-    def body(qb, carry):
-        dk_acc, dv_acc = carry
-        q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(
-            jnp.float32) * scale                      # scale folded into q
-        do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(
-            jnp.float32)
-        lse = jnp.tile(
-            lse_ref[0, pl.ds(qb * block_q, block_q), :], (1, reps)
-        )                                             # (block_q, block_k)
-        di = jnp.tile(di_ref[0, pl.ds(qb * block_q, block_q), :], (1, reps))
-        s = jax.lax.dot_general(
-            q_blk, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )                                             # (block_q, block_k)
-        q_pos = qb * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0
-        )
-        k_pos = k_base + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1
-        )
-        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        p = jnp.exp(s - lse)
-        dv_new = dv_acc + jax.lax.dot_general(
-            p, do_blk, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )                                             # (block_k, d)
-        dp = jax.lax.dot_general(
-            do_blk, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        ds = p * (dp - di)
-        dk_new = dk_acc + jax.lax.dot_general(
-            ds, q_blk, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )                                             # scale via q_blk
-        return dk_new, dv_new
+    def make_body(masked):
+        def body(qb, carry):
+            dk_acc, dv_acc = carry
+            q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :]
+            do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :]
+            lse = jnp.tile(
+                lse_ref[0, pl.ds(qb * block_q, block_q), :], (1, reps)
+            )                                         # (block_q, block_k)
+            di = jnp.tile(
+                di_ref[0, pl.ds(qb * block_q, block_q), :], (1, reps)
+            )
+            s = jax.lax.dot_general(
+                q_blk, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale                                 # (block_q, block_k)
+            if masked:
+                q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0
+                )
+                k_pos = k_base + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 1
+                )
+                s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+            p = jnp.exp(s - lse)
+            dv_new = dv_acc + jax.lax.dot_general(
+                p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                         # (block_k, d)
+            dp = jax.lax.dot_general(
+                do_blk, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            # scale folded into ds: dk = (ds * scale)^T @ Q.
+            ds = p * (dp - di) * scale
+            dk_new = dk_acc + jax.lax.dot_general(
+                ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return dk_new, dv_new
+        return body
 
     # Causal bound from below: query blocks before this key block see
-    # nothing here.
+    # nothing here; blocks straddling the diagonal mask, later blocks see
+    # the whole key block and skip the mask.
     qb_start = k_base // block_q
+    qb_mask_end = pl.cdiv(k_base + block_k, block_q)
     zeros = jnp.zeros((block_k, head_dim), jnp.float32)
+    carry = jax.lax.fori_loop(
+        qb_start, qb_mask_end, make_body(True), (zeros, zeros)
+    )
     dk, dv = jax.lax.fori_loop(
-        qb_start, seq_len // block_q, body, (zeros, zeros)
+        qb_mask_end, seq_len // block_q, make_body(False), carry
     )
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
@@ -312,6 +354,13 @@ def _flash_vjp_fwd(scale, block_q, block_k, q, k, v):
 
     qm, km, vm = to_bhsd(q), to_bhsd(k), to_bhsd(v)
     out, lse = _flash_fwd_bhsd(qm, km, vm, scale, block_q, block_k)
+    # Named so a rematerialized block can SAVE the kernel outputs (policy
+    # save_only_these_names / save_from_both_policies) instead of
+    # re-running the forward kernel to regenerate backward residuals.
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return (
         out.reshape(b, h, s, d).transpose(0, 2, 1, 3),
         (qm, km, vm, out, lse, (b, s, h, d)),
@@ -345,8 +394,10 @@ def flash_attention(
     """Causal flash attention, (B, S, H, D) -> (B, S, H, D)."""
     _, s, _, d = q.shape
     scale = (d ** -0.5) if scale is None else scale
-    block_q = min(DEFAULT_BLOCK_Q, s) if block_q is None else block_q
-    block_k = min(DEFAULT_BLOCK_K, s) if block_k is None else block_k
+    if block_q is None:
+        block_q = pick_block(s) or min(DEFAULT_BLOCK_Q, s)
+    if block_k is None:
+        block_k = pick_block(s, DEFAULT_BLOCK_K) or min(DEFAULT_BLOCK_K, s)
     if s % block_q or s % block_k:
         raise ValueError(
             f"seq_len {s} must be divisible by block_q={block_q} and "

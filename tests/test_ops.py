@@ -118,18 +118,21 @@ def test_ring_forward_matches_xla(qkv, mesh_shape, axes):
 
 
 def test_ring_grad_matches_xla(qkv):
+    """Full grad parity: dq AND dk/dv through the ppermute re-scan."""
     q, k, v = qkv
     mesh = Mesh(mesh_utils.create_device_mesh((2, 4)), ("data", "sp"))
 
-    def loss_ring(q):
+    def loss_ring(q, k, v):
         return (ring_attention_sharded(q, k, v, mesh) ** 2).sum()
 
-    def loss_ref(q):
+    def loss_ref(q, k, v):
         return (xla_causal_attention(q, k, v) ** 2).sum()
 
-    g1 = jax.grad(loss_ring)(q)
-    g2 = jax.grad(loss_ref)(q)
-    assert float(jnp.abs(g1 - g2).max()) < 1e-4
+    g1 = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g1, g2, ("dq", "dk", "dv")):
+        err = float(jnp.abs(a - b).max())
+        assert err < 1e-4, f"{name} max err {err}"
 
 
 def test_ring_under_jit(qkv):
@@ -143,3 +146,81 @@ def test_ring_under_jit(qkv):
     )
     ref = xla_causal_attention(q, k, v)
     assert float(jnp.abs(fn(q, k, v) - ref).max()) < 1e-5
+
+
+# -- fused LM-head cross-entropy (ops/cross_entropy.py) ----------------------
+
+class TestFusedCrossEntropy:
+    """Chunked-vs-naive parity (VERDICT r3 item #1: f32, 1e-5)."""
+
+    def _inputs(self, V=515, B=2, T=32, d=64):
+        rng = jax.random.PRNGKey(42)
+        kx, kw, kt = jax.random.split(rng, 3)
+        x = jax.random.normal(kx, (B, T, d), jnp.float32)
+        wte = jax.random.normal(kw, (V, d), jnp.float32) * 0.1
+        targets = jax.random.randint(kt, (B, T), 0, V)
+        return x, wte, targets
+
+    @pytest.mark.parametrize("num_chunks", [1, 3, 4])
+    def test_loss_parity_f32(self, num_chunks):
+        from ray_lightning_tpu.ops.cross_entropy import (
+            fused_lm_head_cross_entropy, naive_lm_head_cross_entropy)
+        x, wte, t = self._inputs()  # V=515: exercises padded last chunk
+        fused = fused_lm_head_cross_entropy(
+            x, wte, t, num_chunks=num_chunks, compute_dtype=jnp.float32)
+        naive = naive_lm_head_cross_entropy(
+            x, wte, t, compute_dtype=jnp.float32)
+        assert fused.shape == t.shape
+        assert float(jnp.abs(fused - naive).max()) < 1e-5
+
+    def test_grad_parity_f32(self):
+        from ray_lightning_tpu.ops.cross_entropy import (
+            fused_lm_head_cross_entropy, naive_lm_head_cross_entropy)
+        x, wte, t = self._inputs()
+
+        def loss_f(x, w):
+            return fused_lm_head_cross_entropy(
+                x, w, t, num_chunks=4, compute_dtype=jnp.float32).mean()
+
+        def loss_n(x, w):
+            return naive_lm_head_cross_entropy(
+                x, w, t, compute_dtype=jnp.float32).mean()
+
+        gf = jax.grad(loss_f, argnums=(0, 1))(x, wte)
+        gn = jax.grad(loss_n, argnums=(0, 1))(x, wte)
+        for a, b, name in zip(gf, gn, ("dx", "dwte")):
+            err = float(jnp.abs(a - b).max())
+            assert err < 1e-5, f"{name} max err {err}"
+
+    def test_bf16_close_to_f32(self):
+        from ray_lightning_tpu.ops.cross_entropy import (
+            fused_lm_head_cross_entropy, naive_lm_head_cross_entropy)
+        x, wte, t = self._inputs()
+        fused = jax.jit(
+            lambda x, w: fused_lm_head_cross_entropy(x, w, t, num_chunks=4)
+        )(x, wte).mean()
+        naive = naive_lm_head_cross_entropy(
+            x, wte, t, compute_dtype=jnp.float32).mean()
+        assert abs(float(fused) - float(naive)) < 5e-2
+
+    def test_sharded_under_mesh(self):
+        """Fused CE under a dp×tp GSPMD mesh: batch sharded over data,
+        wte feature-sharded over tensor — matches the replicated result."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ray_lightning_tpu.ops.cross_entropy import (
+            fused_lm_head_cross_entropy, naive_lm_head_cross_entropy)
+        x, wte, t = self._inputs(V=512, B=4, T=32, d=64)
+        mesh = Mesh(
+            mesh_utils.create_device_mesh((2, 4)), ("data", "tensor"))
+        xs = jax.device_put(x, NamedSharding(mesh, P("data", None, None)))
+        ws = jax.device_put(wte, NamedSharding(mesh, P(None, "tensor")))
+        ts = jax.device_put(t, NamedSharding(mesh, P("data", None)))
+
+        fused = jax.jit(
+            lambda x, w, t: fused_lm_head_cross_entropy(
+                x, w, t, num_chunks=4, compute_dtype=jnp.float32)
+        )(xs, ws, ts)
+        naive = naive_lm_head_cross_entropy(
+            x, wte, t, compute_dtype=jnp.float32)
+        assert float(jnp.abs(fused - naive).max()) < 1e-5
