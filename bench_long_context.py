@@ -1,0 +1,88 @@
+"""Long-context attention bench: seq-4096 flash vs ring (zig-zag vs
+contiguous).  Prints ONE JSON line.
+
+On real TPU hardware this records the single-chip flash-attention
+fwd+bwd number at seq 4096 (the baseline sequence parallelism must beat
+at scale).  Multi-chip SP cannot be timed meaningfully in this
+environment (one physical chip; the CPU-mesh ring measures thread
+scheduling, not ICI) — so the ring layouts are additionally compared by
+their *causal work balance*: the max-over-devices count of unmasked
+(query, key) block pairs per hop, the quantity that sets ring wall-clock.
+Zig-zag's bound is ~half of contiguous — the same 2x the Megatron
+context-parallel striped layout reports on hardware.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+from ray_lightning_tpu.ops.ring_attention import zigzag_indices  # noqa: F401
+
+
+def _work_balance(n: int, layout: str) -> float:
+    """Max-over-devices share of unmasked key chunks summed over hops,
+    normalized by the contiguous layout's worst case (= n hops)."""
+    # Chunk ownership per device.
+    if layout == "zigzag":
+        chunks = {j: (j, 2 * n - 1 - j) for j in range(n)}
+        n_chunks = 2 * n
+    else:
+        chunks = {j: (j,) for j in range(n)}
+        n_chunks = n
+    worst = 0.0
+    for dev in range(n):
+        total = 0.0
+        for src in range(n):  # one hop per source device
+            for qc in chunks[dev]:
+                for kc in chunks[src]:
+                    if kc < qc:
+                        total += 1.0
+                    elif kc == qc:
+                        total += 0.5
+        worst = max(worst, total / n_chunks)
+    return worst
+
+
+def main() -> None:
+    on_tpu = jax.default_backend() == "tpu"
+    S, B, H, D = 4096, 4, 12, 64
+    result = {
+        "metric": "long_context_seq4096",
+        "ring_balance_contiguous": round(_work_balance(8, "contiguous"), 3),
+        "ring_balance_zigzag": round(_work_balance(8, "zigzag"), 3),
+    }
+    if on_tpu:
+        from ray_lightning_tpu.ops.flash_attention import flash_attention
+
+        key = jax.random.PRNGKey(0)
+        q = jax.random.normal(key, (B, S, H, D), jnp.bfloat16)
+        k, v = q * 0.99, q * 1.01
+
+        def fb(q, k, v):
+            g = jax.grad(
+                lambda q, k, v: flash_attention(q, k, v)
+                .astype(jnp.float32).sum(), argnums=(0, 1, 2),
+            )(q, k, v)
+            return sum(x.astype(jnp.float32).sum() for x in g)
+
+        f = jax.jit(fb)
+        s = f(q, k, v)
+        float(jax.device_get(s))
+        t0 = time.perf_counter()
+        for _ in range(10):
+            s = f(q, k, v)
+        float(jax.device_get(s))
+        dt = (time.perf_counter() - t0) / 10
+        result.update({
+            "flash_seq4096_fwd_bwd_ms_single_chip": round(dt * 1000, 2),
+            "flash_seq4096_tokens_per_sec": round(B * S / dt, 1),
+        })
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -31,6 +31,7 @@ import os
 import socket
 import subprocess
 import threading
+import time
 import traceback
 from typing import Any, Dict, Optional, Tuple
 
@@ -248,13 +249,30 @@ class _RemoteProcHandle:
         self.pid = pid
         self.returncode: Optional[int] = None
 
+    # Transient-transport retry budget: one slow/dropped agent RPC must
+    # not read as "child died" (that verdict triggers a full elastic
+    # respawn upstream, parallel/strategies.py).
+    _POLL_RETRIES = 3
+    _POLL_BACKOFF_S = 0.2
+
     def poll(self) -> Optional[int]:
         if self.returncode is not None:
             return self.returncode
-        try:
-            self.returncode = self._client.poll(self.pid)
-        except (AgentError, ConnectionError, OSError):
-            self.returncode = -1  # agent gone ⇒ treat child as dead
+        for attempt in range(self._POLL_RETRIES):
+            try:
+                self.returncode = self._client.poll(self.pid)
+                return self.returncode
+            except AgentError:
+                # A structured agent REPLY (unknown pid): deterministic —
+                # the child is genuinely gone; retrying can't change it.
+                self.returncode = -1
+                return self.returncode
+            except (ConnectionError, OSError, TimeoutError):
+                # Transport hiccup: back off and re-ask before declaring
+                # death.
+                if attempt + 1 < self._POLL_RETRIES:
+                    time.sleep(self._POLL_BACKOFF_S * (attempt + 1))
+        self.returncode = -1  # agent unreachable after retries
         return self.returncode
 
     def terminate(self) -> None:

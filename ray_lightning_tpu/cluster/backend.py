@@ -95,6 +95,21 @@ class ObjectRef:
             return rpc.loads(SegmentStore.get(self._segment_path))
         return rpc.loads(self._payload)
 
+    def release(self) -> None:
+        """Reclaim the backing segment NOW (idempotent).
+
+        Segments otherwise live until backend shutdown — a strategy that
+        runs many fits on one backend (the PBT path) would leak tmpfs RAM
+        proportional to fits × model size.  After release, ``get()`` on
+        this ref is invalid."""
+        if self._segment_path is not None:
+            try:
+                os.unlink(self._segment_path)
+            except OSError:
+                pass
+            self._segment_path = None
+        self._payload = None
+
     @property
     def nbytes(self) -> int:
         return self._nbytes

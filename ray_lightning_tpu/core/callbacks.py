@@ -241,6 +241,8 @@ class CSVLogger(Callback):
         self.dirpath = dirpath
         self.filename = filename
         self.rows: list = []
+        self._flushed_rows = 0
+        self._flushed_keys: list = []
 
     @property
     def path(self) -> Optional[str]:
@@ -265,21 +267,41 @@ class CSVLogger(Callback):
     def _flush(self) -> None:
         import csv
 
-        # Key sets can grow (val metrics appear after the first val epoch),
-        # so rewrite the whole file each flush — atomically, so a reader
-        # (or a crashed run) never sees a torn file.
+        # Key sets can grow (val metrics appear after the first val
+        # epoch).  Same keys ⇒ append only the new rows (per-step logging
+        # must not rewrite an ever-growing file each batch); new keys ⇒
+        # rewrite atomically so a reader never sees a torn file.
         keys: list = []
         for row in self.rows:
             for k in row:
                 if k not in keys:
                     keys.append(k)
         os.makedirs(self.dirpath, exist_ok=True)
-        tmp = self.path + ".tmp"
-        with open(tmp, "w", newline="") as f:
-            writer = csv.DictWriter(f, fieldnames=keys)
-            writer.writeheader()
-            writer.writerows(self.rows)
-        os.replace(tmp, self.path)
+        if (keys == self._flushed_keys and self._flushed_rows
+                and os.path.exists(self.path)):
+            with open(self.path, "a", newline="") as f:
+                writer = csv.DictWriter(f, fieldnames=keys)
+                writer.writerows(self.rows[self._flushed_rows:])
+        else:
+            tmp = self.path + ".tmp"
+            with open(tmp, "w", newline="") as f:
+                writer = csv.DictWriter(f, fieldnames=keys)
+                writer.writeheader()
+                writer.writerows(self.rows)
+            os.replace(tmp, self.path)
+        self._flushed_rows = len(self.rows)
+        self._flushed_keys = keys
+
+    def on_train_batch_end(self, trainer, module, logs, batch_idx) -> None:
+        # Per-step rows on the trainer's log_every_n_steps cadence (the
+        # loop refreshes callback_metrics just before this hook fires) —
+        # a 1-epoch LM run gets a real training curve, not a single row.
+        n = getattr(
+            getattr(trainer, "config", None), "log_every_n_steps", 0
+        )
+        micro = getattr(trainer, "micro_step", None)
+        if n and micro and micro % n == 0:
+            self._append(trainer)
 
     def on_train_epoch_end(self, trainer, module) -> None:
         self._append(trainer)

@@ -19,6 +19,7 @@ import os
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ray_lightning_tpu.core.callbacks import Callback, ModelCheckpoint
@@ -53,8 +54,11 @@ class FitConfig:
     # Apply the optimizer once every k micro-batches (optax.MultiSteps
     # under the hood): k micro-steps of batch B train like one step of
     # batch k*B (≙ Lightning's ``accumulate_grad_batches``).  As in
-    # Lightning, ``max_steps`` counts OPTIMIZER steps (k micro-batches
-    # each); ``global_step``/``log_every_n_steps`` count micro-batches.
+    # Lightning, ``max_steps`` AND ``global_step`` count OPTIMIZER steps;
+    # ``log_every_n_steps`` fires on micro-batches (Lightning's batch
+    # cadence).  A partial accumulation window left at epoch end is
+    # FLUSHED (one optimizer step from the averaged micro-grads), again
+    # matching Lightning.
     accumulate_grad_batches: int = 1
     seed: int = 0
     precision: str = "f32"
@@ -93,7 +97,11 @@ class LoopContext:
         self.queue = queue
         self.tx = tx
         self.current_epoch = 0
+        # Lightning convention: global_step counts OPTIMIZER steps;
+        # micro_step counts micro-batches (they differ only under
+        # gradient accumulation).
         self.global_step = 0
+        self.micro_step = 0
         self.should_stop = False
         self.callback_metrics: Dict[str, float] = {}
         self.logged_metrics: Dict[str, float] = {}
@@ -140,6 +148,7 @@ class LoopContext:
             "state": self._gathered_state(),
             "epoch": self.current_epoch,
             "global_step": self.global_step,
+            "micro_step": self.micro_step,
             "callback_metrics": dict(self.callback_metrics),
             **(extra or {}),
         }
@@ -157,13 +166,72 @@ def _call_hooks(callbacks: List[Callback], hook: str, *args) -> None:
         getattr(cb, hook)(*args)
 
 
-def _log_lr(ctx: "LoopContext", lr_schedule, accum: int) -> None:
-    """Log the schedule's current learning rate (the second half of the
-    ``configure_optimizers`` contract).  One optimizer step happens per
-    ``accum`` micro-steps, so the schedule is indexed by optimizer steps."""
+def _mesh_barrier(mesh) -> None:
+    """Block until every process of the mesh reaches this point: a tiny
+    all-reduce over a mesh-sharded vector (completion of the local result
+    requires every participant's contribution)."""
+    if mesh is None or len(mesh.devices.flat) <= 1:
+        return
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = len(mesh.devices.flat)
+    vec = jnp.ones((n,), jnp.int32)
+    sharded = NamedSharding(mesh, P(mesh.axis_names))
+    total = jax.jit(
+        jnp.sum, in_shardings=(sharded,), out_shardings=NamedSharding(
+            mesh, P())
+    )(jax.device_put(vec, sharded))
+    assert int(jax.device_get(total)) == n
+
+
+def _build_accum_flush(inner_tx, mesh, state_shardings):
+    """Compile the partial-accumulation flush: one optimizer update from
+    ``MultiStepsState.acc_grads`` (the running MEAN of the window's
+    micro-grads), with the window counters reset.
+
+    Without this, micro-batches left in an unfinished window at epoch/fit
+    end were silently dropped (their gradients never reached the params)
+    — diverging from Lightning, where the last incomplete window of an
+    epoch still steps.
+    """
+    import optax
+
+    def flush(state: TrainState) -> TrainState:
+        ms = state.opt_state
+        updates, inner2 = inner_tx.update(
+            ms.acc_grads, ms.inner_opt_state, state.params
+        )
+        new_params = optax.apply_updates(state.params, updates)
+        new_ms = optax.MultiStepsState(
+            mini_step=jnp.zeros_like(ms.mini_step),
+            gradient_step=ms.gradient_step + 1,
+            inner_opt_state=inner2,
+            acc_grads=jax.tree_util.tree_map(
+                jnp.zeros_like, ms.acc_grads
+            ),
+        )
+        return TrainState(new_params, new_ms, state.step + 1)
+
+    if mesh is None or state_shardings is None:
+        return jax.jit(flush, donate_argnums=0)
+    return jax.jit(
+        flush,
+        in_shardings=(state_shardings,),
+        out_shardings=state_shardings,
+        donate_argnums=0,
+    )
+
+
+def _log_lr(ctx: "LoopContext", lr_schedule) -> None:
+    """Log the learning rate that the MOST RECENT optimizer step applied
+    (Lightning's LearningRateMonitor convention).  An optax schedule is
+    indexed by completed updates when the update is computed, so update
+    ``k`` used ``schedule(k-1)``."""
     if lr_schedule is None:
         return
-    ctx.log_metrics({"lr": float(lr_schedule(ctx.global_step // accum))})
+    ctx.log_metrics(
+        {"lr": float(lr_schedule(max(ctx.global_step - 1, 0)))}
+    )
 
 
 def _mean_logs(device_logs: List[Dict[str, Any]]) -> Dict[str, float]:
@@ -317,6 +385,7 @@ def run_fit(
     if isinstance(tx, tuple) and not hasattr(tx, "init"):
         tx, lr_schedule = tx[0], (tx[1] if len(tx) > 1 else None)
     accum = max(int(config.accumulate_grad_batches), 1)
+    inner_tx = tx
     if accum > 1:
         import optax
 
@@ -347,9 +416,19 @@ def run_fit(
     )
     start_epoch = 0
     if config.resume_from_checkpoint:
-        payload = load_state_stream(
-            state_stream_from_file(config.resume_from_checkpoint)
-        )
+        from ray_lightning_tpu.utils import sharded_ckpt
+
+        if sharded_ckpt.is_sharded_ckpt(config.resume_from_checkpoint):
+            # Sharded restart checkpoint: reassembled on host, then
+            # re-placed below onto THIS run's shardings — resume works on
+            # any topology, including fewer workers than wrote it.
+            payload = sharded_ckpt.load_sharded(
+                config.resume_from_checkpoint
+            )
+        else:
+            payload = load_state_stream(
+                state_stream_from_file(config.resume_from_checkpoint)
+            )
         host_state = payload["state"]
         if mesh is None:
             state = jax.device_put(host_state)
@@ -359,7 +438,14 @@ def run_fit(
         # If the checkpoint already covers max_epochs the loop body never
         # runs; current_epoch must still report the work as done.
         ctx.current_epoch = max(start_epoch - 1, 0)
-        ctx.global_step = payload["global_step"]
+        if "micro_step" in payload:
+            ctx.global_step = payload["global_step"]
+            ctx.micro_step = payload["micro_step"]
+        else:
+            # Legacy streams predate the optimizer-step convention: their
+            # "global_step" stored the MICRO-batch count.
+            ctx.micro_step = payload["global_step"]
+            ctx.global_step = payload["global_step"] // accum
         ctx.callback_metrics.update(payload.get("callback_metrics", {}))
         # Stateful callbacks (EarlyStopping patience, ModelCheckpoint
         # best-score/path, …) continue rather than reset on resume.
@@ -392,6 +478,18 @@ def run_fit(
     base_rng = jax.random.PRNGKey(config.seed)
     train_loader = datamodule.train_dataloader()
     stop = False
+    flush_step = None  # built lazily on the first partial-window flush
+    # Host-side mirror of MultiSteps' window position: micro-batches since
+    # the last optimizer update.  `micro_step % accum` is NOT equivalent
+    # once a partial-window flush has reset the window mid-cycle.
+    since_update = 0
+    if config.resume_from_checkpoint and accum > 1:
+        try:
+            since_update = int(
+                jax.device_get(ctx.state.opt_state.mini_step)
+            )
+        except AttributeError:
+            since_update = ctx.micro_step % accum
     for epoch in range(start_epoch, config.max_epochs):
         ctx.current_epoch = epoch
         if hasattr(train_loader, "set_epoch"):
@@ -410,8 +508,12 @@ def run_fit(
         )
         if config.max_steps >= 0:
             # max_steps counts optimizer steps; the loop (and the cap)
-            # run in micro-batches.
-            remaining = max(config.max_steps * accum - ctx.global_step, 0)
+            # run in micro-batches.  Position within the current window
+            # comes from since_update (flushes reset it mid-cycle).
+            remaining = max(
+                (config.max_steps - ctx.global_step) * accum - since_update,
+                0,
+            )
             cap = remaining if cap is None else min(cap, remaining)
         source = (
             train_loader if cap is None
@@ -428,24 +530,45 @@ def run_fit(
             # Check BEFORE executing: max_steps=0 must train zero steps.
             if (
                 config.max_steps >= 0
-                and ctx.global_step // accum >= config.max_steps
+                and ctx.global_step >= config.max_steps
             ):
                 stop = True
                 break
-            rng = jax.random.fold_in(base_rng, ctx.global_step)
+            rng = jax.random.fold_in(base_rng, ctx.micro_step)
             ctx.state, logs = train_step(ctx.state, gbatch, rng)
             epoch_logs.append(logs)
-            ctx.global_step += 1
-            if ctx.global_step % config.log_every_n_steps == 0:
+            ctx.micro_step += 1
+            since_update += 1
+            if since_update == accum:
+                ctx.global_step += 1  # one optimizer step completed
+                since_update = 0
+            if ctx.micro_step % config.log_every_n_steps == 0:
                 ctx.log_metrics(jax.device_get(logs))
-                _log_lr(ctx, lr_schedule, accum)
+                _log_lr(ctx, lr_schedule)
             _call_hooks(
                 callbacks, "on_train_batch_end", ctx, module, logs, batch_idx
             )
 
+        # Flush a partial accumulation window (Lightning semantics: the
+        # last incomplete window of an epoch still steps, from the mean
+        # of the micro-grads seen).  Skipped when stopping at max_steps —
+        # that contract promises exactly max_steps optimizer updates.
+        if (
+            accum > 1
+            and not stop
+            and int(jax.device_get(ctx.state.opt_state.mini_step)) > 0
+        ):
+            if flush_step is None:
+                flush_step = _build_accum_flush(
+                    inner_tx, mesh, state_shardings
+                )
+            ctx.state = flush_step(ctx.state)
+            ctx.global_step += 1
+            since_update = 0  # the flush reset MultiSteps' window
+
         train_metrics = _mean_logs(epoch_logs)
         ctx.log_metrics(train_metrics)
-        _log_lr(ctx, lr_schedule, accum)
+        _log_lr(ctx, lr_schedule)
         module.on_train_epoch_end(epoch, train_metrics)
 
         # -- validation ----------------------------------------------------
@@ -462,33 +585,53 @@ def run_fit(
 
         _call_hooks(callbacks, "on_train_epoch_end", ctx, module)
 
-        # Elastic-restart checkpoint (collective gather, rank-0 write):
-        # bounds lost work to restart_every_n_epochs on a worker failure.
+        # Elastic-restart checkpoint — SHARDED, no all-gather: each host
+        # writes only its addressable shards (utils/sharded_ckpt.py), so a
+        # ZeRO-3 run's restart cost stays O(state/hosts) per host instead
+        # of replicating the world every restart_every_n_epochs.
         if (
             config.restart_dir
             and (epoch + 1) % config.restart_every_n_epochs == 0
         ):
-            payload = ctx.checkpoint_payload(
-                {"callback_states": [cb.state_dict() for cb in callbacks]}
+            from ray_lightning_tpu.utils import sharded_ckpt
+
+            tag = os.path.join(
+                config.restart_dir, f"restart-epoch-{epoch:06d}.ckpt"
             )
+            sharded_ckpt.save_shard(
+                ctx.state, tag, global_rank, world_size
+            )
+            # Barrier before the completeness marker: META must only
+            # appear once every host's shard file is durable.
+            _mesh_barrier(mesh)
             if ctx.is_global_zero:
-                path = os.path.join(
-                    config.restart_dir, f"restart-epoch-{epoch:06d}.ckpt"
+                sharded_ckpt.save_meta(
+                    ctx.state, tag, world_size,
+                    extra={
+                        "epoch": ctx.current_epoch,
+                        "global_step": ctx.global_step,
+                        "micro_step": ctx.micro_step,
+                        "callback_metrics": dict(ctx.callback_metrics),
+                        "callback_states": [
+                            cb.state_dict() for cb in callbacks
+                        ],
+                    },
                 )
-                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-                state_stream_to_file(to_state_stream(payload), path)
-                # Writes are atomic, so the newest checkpoint is always
-                # loadable — superseded ones are pure disk growth.
+                # The newest COMPLETE checkpoint is always loadable —
+                # superseded ones are pure disk growth.
                 for name in os.listdir(config.restart_dir):
+                    stale = os.path.join(config.restart_dir, name)
                     if (name.startswith("restart-epoch-")
                             and name.endswith(".ckpt")
-                            and name < os.path.basename(path)):
-                        try:
-                            os.unlink(
-                                os.path.join(config.restart_dir, name)
-                            )
-                        except OSError:
-                            pass
+                            and name < os.path.basename(tag)):
+                        import shutil
+
+                        shutil.rmtree(stale, ignore_errors=True)
+                        if os.path.isfile(stale):  # legacy single-file
+                            try:
+                                os.unlink(stale)
+                            except OSError:
+                                pass
 
         # Stream per-epoch metrics to the driver (live callback_metrics on
         # the driver trainer — extends the reference, which only streamed
@@ -535,6 +678,7 @@ def run_fit(
         "callback_states": [cb.state_dict() for cb in callbacks],
         "epochs_run": ctx.current_epoch + 1,
         "global_step": ctx.global_step,
+        "micro_step": ctx.micro_step,
     }
 
 

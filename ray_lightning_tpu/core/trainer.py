@@ -129,7 +129,9 @@ class Trainer:
         self.state = None  # host-side TrainState (numpy leaves) after fit
         self.predictions: Optional[np.ndarray] = None
         self.epochs_run: int = 0
-        self.global_step: int = 0
+        self.global_step: int = 0   # optimizer steps (Lightning convention)
+        self.micro_step: int = 0    # micro-batches (= global_step unless
+        # gradient accumulation is active)
         self._state_stream: Optional[bytes] = None
 
     # -- live metric streaming (driver-side queue pump hook) ----------------
@@ -178,6 +180,7 @@ class Trainer:
         self.best_model_path = rank0["best_model_path"]
         self.epochs_run = rank0["epochs_run"]
         self.global_step = rank0["global_step"]
+        self.micro_step = rank0.get("micro_step", self.global_step)
         # Driver-side callback objects reflect what happened remotely
         # (≙ best_model_path adoption, ray_ddp.py:393-395 — generalized).
         for cb, cb_state in zip(self.callbacks, rank0["callback_states"]):

@@ -334,9 +334,19 @@ class GPT(TpuModule):
             return (self._constrain_residual(x), aux), None
 
         if self.remat:
+            # Save matmul outputs AND the flash-attention kernel outputs
+            # (out/lse, named in its vjp fwd) — recomputing elementwise is
+            # the remat bargain; re-running the attention kernel is not.
+            cp = jax.checkpoint_policies
             block = jax.checkpoint(
                 block,
-                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                policy=cp.save_from_both_policies(
+                    cp.dots_with_no_batch_dims_saveable,
+                    cp.save_only_these_names(
+                        "flash_out", "flash_lse",
+                        "flash_q", "flash_k", "flash_v",
+                    ),
+                ),
             )
         (x, aux), _ = jax.lax.scan(
             block, (x, jnp.zeros((), jnp.float32)), params["blocks"]
@@ -357,10 +367,16 @@ class GPT(TpuModule):
         x, aux = self.forward_hidden(params, inputs)
         # Fused tied-LM-head CE: the (B, T, V) logits tensor (3.3 GB f32
         # for GPT-2-small at B=16) is never materialized — the head
-        # matmul, logsumexp and label gather run per vocab chunk.
+        # matmul, logsumexp and label gather run per vocab chunk.  On an
+        # unsharded (single-chip) step the forward further drops to the
+        # Pallas tile kernel; under a multi-device mesh the GSPMD-safe
+        # scan path is kept (pallas_call is opaque to the partitioner).
+        mesh = getattr(getattr(self, "trainer", None), "mesh", None)
+        single = mesh is None or getattr(mesh, "size", 1) == 1
         loss = fused_lm_head_cross_entropy(
             x, params["wte"], targets,
             compute_dtype=self._compute_dtype(),
+            use_pallas=single and jax.default_backend() == "tpu",
         ).mean()
         return loss, aux
 

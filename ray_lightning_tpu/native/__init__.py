@@ -122,11 +122,12 @@ def crc32c_is_hw() -> bool:
 
 
 def crc32c(data: bytes, crc: int = 0) -> int:
-    """CRC32C when native; callers needing a concrete algo tag should use
-    the (checksum, algo) pair from :func:`_checksum`."""
+    """CRC32C (Castagnoli).  Hardware/native when the C++ library loads,
+    else the table-driven Python fallback — same polynomial, same chaining
+    semantics, so checksums are portable across the two paths."""
     lib = _load()
     if lib is None:
-        raise RuntimeError("native library unavailable (CRC32C needs it)")
+        return _crc32c_py(data, crc)
     return lib.rlt_crc32c(data, len(data), crc)
 
 
@@ -231,18 +232,20 @@ def read_segment(path: str, verify: bool = True) -> bytes:
 _py_table = None
 
 
-def _crc32c_py(data: bytes) -> int:
+def _crc32c_py(data: bytes, crc: int = 0) -> int:
+    """Software CRC32C with the same seed-chaining contract as the native
+    entry point: ``crc32c(b, crc32c(a)) == crc32c(a + b)``."""
     global _py_table
     if _py_table is None:
         poly = 0x82F63B78
         table = []
         for i in range(256):
-            crc = i
+            c = i
             for _ in range(8):
-                crc = (crc >> 1) ^ (poly if crc & 1 else 0)
-            table.append(crc)
+                c = (c >> 1) ^ (poly if c & 1 else 0)
+            table.append(c)
         _py_table = table
-    crc = 0xFFFFFFFF
+    c = crc ^ 0xFFFFFFFF
     for b in data:
-        crc = (crc >> 8) ^ _py_table[(crc ^ b) & 0xFF]
-    return crc ^ 0xFFFFFFFF
+        c = (c >> 8) ^ _py_table[(c ^ b) & 0xFF]
+    return c ^ 0xFFFFFFFF

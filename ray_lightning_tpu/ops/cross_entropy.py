@@ -87,10 +87,135 @@ def _chunk_logits(x, wte_chunk, offset, vocab_size, compute_dtype):
     return jnp.where(valid, logits, _NEG_INF)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _fused_ce(x, wte, targets, num_chunks, compute_dtype):
-    loss, _ = _fused_ce_fwd(x, wte, targets, num_chunks, compute_dtype)
+_CE_BLOCK_T = 1024
+_CE_BLOCK_V = 1024
+_LANE = 128
+
+
+def _ce_fwd_kernel(x_ref, w_ref, t_ref, loss_ref, lse_ref, m_sc, s_sc, g_sc,
+                   *, vocab_size, block_v, num_vb):
+    """Forward CE tile: one (token-block × vocab-block) step.
+
+    Grid is (token blocks, vocab blocks) with vocab innermost: the online
+    softmax statistics (running max / sumexp / gold logit) live in VMEM
+    scratch across the vocab sweep, so the (Tb, Vb) logits tile never
+    leaves VMEM — zero HBM logits traffic (the scan fallback writes and
+    re-reads every chunk).
+    """
+    from jax.experimental import pallas as pl
+
+    vi = pl.program_id(1)
+
+    @pl.when(vi == 0)
+    def _init():
+        m_sc[...] = jnp.full(m_sc.shape, _NEG_INF, jnp.float32)
+        s_sc[...] = jnp.zeros(s_sc.shape, jnp.float32)
+        g_sc[...] = jnp.zeros(g_sc.shape, jnp.float32)
+
+    logits = jax.lax.dot_general(
+        x_ref[...], w_ref[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                  # (Tb, Vb) f32
+    tb, vb = logits.shape
+    vpos = vi * block_v + jax.lax.broadcasted_iota(jnp.int32, (tb, vb), 1)
+    logits = jnp.where(vpos < vocab_size, logits, _NEG_INF)
+    m_old = m_sc[:, :1]
+    m_new = jnp.maximum(m_old, jnp.max(logits, axis=1, keepdims=True))
+    corr = jnp.exp(m_old - m_new)
+    s_new = s_sc[:, :1] * corr + jnp.sum(
+        jnp.exp(logits - m_new), axis=1, keepdims=True
+    )
+    # Gold logit: exactly one (or zero) hit per row in this vocab block.
+    hit = vpos == t_ref[:, :1]
+    g_new = g_sc[:, :1] + jnp.sum(
+        jnp.where(hit, logits, 0.0), axis=1, keepdims=True
+    )
+    m_sc[...] = jnp.broadcast_to(m_new, m_sc.shape)
+    s_sc[...] = jnp.broadcast_to(s_new, s_sc.shape)
+    g_sc[...] = jnp.broadcast_to(g_new, g_sc.shape)
+
+    @pl.when(vi == num_vb - 1)
+    def _emit():
+        lse = m_new + jnp.log(s_new)
+        lse_ref[...] = jnp.broadcast_to(lse, lse_ref.shape)
+        loss_ref[...] = jnp.broadcast_to(lse - g_new, loss_ref.shape)
+
+
+def _ce_fwd_pallas(x, wte, targets, compute_dtype):
+    """Kernel-path forward over flattened tokens.  Returns (loss, lse),
+    both f32 with ``targets``'s shape."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    shape = targets.shape
+    d = x.shape[-1]
+    x2 = x.reshape(-1, d).astype(compute_dtype)
+    t1 = targets.reshape(-1)
+    n = x2.shape[0]
+    V = wte.shape[0]
+    bt = _CE_BLOCK_T
+    bv = _CE_BLOCK_V
+    vpad = -(-V // bv) * bv
+    wp = wte.astype(compute_dtype)
+    if vpad != V:
+        wp = jnp.concatenate(
+            [wp, jnp.zeros((vpad - V, d), wp.dtype)], axis=0
+        )
+    t2 = jnp.broadcast_to(t1[:, None], (n, _LANE))
+    num_vb = vpad // bv
+    kernel = partial(
+        _ce_fwd_kernel, vocab_size=V, block_v=bv, num_vb=num_vb,
+    )
+    loss, lse = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((n, _LANE), jnp.float32),
+            jax.ShapeDtypeStruct((n, _LANE), jnp.float32),
+        ),
+        grid=(n // bt, num_vb),
+        in_specs=[
+            pl.BlockSpec((bt, d), lambda t, v: (t, 0)),
+            pl.BlockSpec((bv, d), lambda t, v: (v, 0)),
+            pl.BlockSpec((bt, _LANE), lambda t, v: (t, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((bt, _LANE), lambda t, v: (t, 0)),
+            pl.BlockSpec((bt, _LANE), lambda t, v: (t, 0)),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bt, _LANE), jnp.float32),
+            pltpu.VMEM((bt, _LANE), jnp.float32),
+            pltpu.VMEM((bt, _LANE), jnp.float32),
+        ],
+        interpret=jax.default_backend() != "tpu",
+    )(x2, wp, t2)
+    return loss[:, 0].reshape(shape), lse[:, 0].reshape(shape)
+
+
+def _pallas_fwd_ok(x, wte, targets) -> bool:
+    """The kernel path needs lane-aligned flattened tokens; oddly-shaped
+    inputs (or explicit opt-out) use the scan path.  Both paths share the
+    scan backward, so the choice is invisible to callers."""
+    n = 1
+    for s in targets.shape:
+        n *= s
+    return n % _CE_BLOCK_T == 0 and x.shape[-1] % 128 == 0
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _fused_ce(x, wte, targets, num_chunks, compute_dtype, use_pallas):
+    loss, _ = _fused_ce_vjp_fwd(
+        x, wte, targets, num_chunks, compute_dtype, use_pallas
+    )
     return loss
+
+
+def _fused_ce_vjp_fwd(x, wte, targets, num_chunks, compute_dtype,
+                      use_pallas):
+    if use_pallas:
+        loss, lse = _ce_fwd_pallas(x, wte, targets, compute_dtype)
+        return loss, (x, wte, targets, lse)
+    return _fused_ce_fwd(x, wte, targets, num_chunks, compute_dtype)
 
 
 def _fused_ce_fwd(x, wte, targets, num_chunks, compute_dtype):
@@ -142,7 +267,7 @@ def _match_vma(val: jax.Array, ref: jax.Array) -> jax.Array:
     return jax.lax.psum(val, extra) if extra else val
 
 
-def _fused_ce_bwd(num_chunks, compute_dtype, res, g):
+def _fused_ce_bwd(num_chunks, compute_dtype, use_pallas, res, g):
     x, wte, targets, lse = res
     V, d = wte.shape
     wte_chunks, Vc = _chunk_wte(wte, num_chunks)
@@ -183,7 +308,7 @@ def _fused_ce_bwd(num_chunks, compute_dtype, res, g):
     )
 
 
-_fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
+_fused_ce.defvjp(_fused_ce_vjp_fwd, _fused_ce_bwd)
 
 
 def fused_lm_head_cross_entropy(
@@ -193,6 +318,7 @@ def fused_lm_head_cross_entropy(
     *,
     num_chunks: Optional[int] = None,
     compute_dtype: jnp.dtype = jnp.bfloat16,
+    use_pallas: Optional[bool] = None,
 ) -> jax.Array:
     """Per-token CE loss of the tied LM head, without materializing logits.
 
@@ -202,13 +328,21 @@ def fused_lm_head_cross_entropy(
         targets: int labels, shape ``x.shape[:-1]``.
         num_chunks: vocab chunks to scan over (default: ~8192-wide chunks).
         compute_dtype: matmul input dtype (f32 accumulation regardless).
+        use_pallas: run the FORWARD through the Pallas tile kernel (zero
+            HBM logits traffic).  Callers that know they are on one chip
+            (no GSPMD-sharded operands — a ``pallas_call`` is opaque to
+            the partitioner) opt in; default off.  The backward is the
+            chunk-recompute scan either way.
 
     Returns:
         float32 per-token losses, shape ``targets.shape``.
     """
     if num_chunks is None:
         num_chunks = _pick_num_chunks(wte.shape[0])
-    return _fused_ce(x, wte, targets, num_chunks, jnp.dtype(compute_dtype))
+    pallas = bool(use_pallas) and _pallas_fwd_ok(x, wte, targets)
+    return _fused_ce(
+        x, wte, targets, num_chunks, jnp.dtype(compute_dtype), pallas
+    )
 
 
 def naive_lm_head_cross_entropy(

@@ -18,7 +18,8 @@ Backward pass (FlashAttention-2 style, two kernels):
 * the forward additionally emits the per-row log-sum-exp ``lse = m +
   log l``, broadcast across a 128-lane minor dim (the TPU-native layout
   for per-row scalars — same trick as jax.experimental.pallas.ops.tpu);
-* ``delta = rowsum(dO · O)`` is a cheap bandwidth-bound XLA reduction;
+* ``delta = rowsum(dO · O)`` is computed in-kernel from the O block (a
+  few VPU ops on resident data — no O(S·lane) HBM round-trip);
 * **dq kernel**: one program per query block, walks key blocks ``<= i``,
   recomputes ``p = exp(s − lse)`` and accumulates ``ds @ K``;
 * **dk/dv kernel**: one program per key block, walks query blocks
@@ -60,11 +61,15 @@ def pick_block(seq_len: int, prefer: int = DEFAULT_BLOCK_Q) -> Optional[int]:
         block //= 2
     return None
 _NEG_INF = -1e30
-# Per-row scalars (lse, delta) are stored broadcast across this many
-# lanes so they tile natively on the TPU vector units (8×128 vregs) —
-# slicing column 0 of a (rows, 128) block is free; a (rows, 1) layout
-# would force a relayout on every use.
+# Lane quantum for block_k (per-row stats are broadcast across lanes in
+# VMEM, and the backward tiles them in block_k-wide sweeps).
 _LANE = 128
+# HBM width of the per-row lse stat.  In VMEM the tile is lane-padded
+# anyway, but the HBM array is (BH, S, _STAT_W) — at 128 the saved-
+# residual traffic was ~100 MB/layer of 128x-redundant f32 (the single
+# largest line in the step profile); 8 keeps a legal f32 tile while
+# cutting that 16x.
+_STAT_W = 8
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, scale, block_q,
@@ -119,7 +124,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref=None, *, scale, block_q,
     acc, m, l = jax.lax.fori_loop(num_full, num_kb, make_body(True), carry)
     o_ref[0] = (acc / l).astype(o_ref.dtype)
     if lse_ref is not None:
-        lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), (block_q, _LANE))
+        lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), (block_q, _STAT_W))
 
 
 def _interpret() -> bool:
@@ -143,12 +148,12 @@ def _flash_fwd_bhsd(q, k, v, scale, block_q, block_k, want_lse=True):
     )
     out_shape = jax.ShapeDtypeStruct((bh, s, d), q.dtype)
     out_spec = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0))
-    lse_spec = pl.BlockSpec((1, block_q, _LANE), lambda b, i: (b, i, 0))
+    lse_spec = pl.BlockSpec((1, block_q, _STAT_W), lambda b, i: (b, i, 0))
     result = pl.pallas_call(
         kernel,
         out_shape=(
             out_shape,
-            jax.ShapeDtypeStruct((bh, s, _LANE), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s, _STAT_W), jnp.float32),
         ) if want_lse else out_shape,
         grid=grid,
         in_specs=[
@@ -162,74 +167,50 @@ def _flash_fwd_bhsd(q, k, v, scale, block_q, block_k, want_lse=True):
     return result if want_lse else (result, None)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dq_ref, *,
-                   scale, block_q, block_k, head_dim):
-    qi = pl.program_id(1)
-    q_base = qi * block_q
-    q = q_ref[0]                                      # (block_q, d)
-    do = do_ref[0]
-    reps = block_k // _LANE
-    lse = jnp.tile(lse_ref[0], (1, reps))             # (block_q, block_k)
-    di = jnp.tile(di_ref[0], (1, reps))
+def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, o_ref, dk_ref,
+                dv_ref, dqp_ref, *, scale, block_q, block_k, head_dim,
+                seq_len):
+    """One program per KEY block: dk/dv accumulate in registers across the
+    query-block walk, and dq contributions are written as a per-key-block
+    PARTIAL plane (summed by one cheap XLA reduction afterwards).
 
-    def make_body(masked):
-        def body(kb, acc):
-            k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
-            v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
-            s = jax.lax.dot_general(
-                q, k_blk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale
-            if masked:
-                q_pos = q_base + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 0
-                )
-                k_pos = kb * block_k + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 1
-                )
-                s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-            p = jnp.exp(s - lse)                      # normalized probs
-            dp = jax.lax.dot_general(
-                do, v_blk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            # scale folded into ds: dq = (ds * scale) @ K.
-            ds = p * (dp - di) * scale
-            return acc + jax.lax.dot_general(
-                ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-        return body
-
-    num_full = q_base // block_k
-    num_kb = pl.cdiv(q_base + block_q, block_k)
-    acc = jax.lax.fori_loop(
-        0, num_full, make_body(False),
-        jnp.zeros((block_q, head_dim), jnp.float32),
-    )
-    acc = jax.lax.fori_loop(num_full, num_kb, make_body(True), acc)
-    dq_ref[0] = acc.astype(dq_ref.dtype)
-
-
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dk_ref,
-                    dv_ref, *, scale, block_q, block_k, head_dim, seq_len):
+    Fusing dq into the dk/dv walk shares the s/p/dp/ds recomputation both
+    would otherwise do independently — 5 MXU dots per block pair instead
+    of 7 across two kernels.
+    """
     ki = pl.program_id(1)
     k_base = ki * block_k
     k = k_ref[0]                                      # (block_k, d)
     v = v_ref[0]
-    reps = block_k // _LANE
+    # Query blocks before the causal frontier contribute nothing — zero
+    # exactly those rows (the walk below rewrites everything from the
+    # frontier on; zeroing the whole plane would double-write ~half of it
+    # on this bandwidth-sensitive path).
+    zero_blk = jnp.zeros((block_q, head_dim), dqp_ref.dtype)
+
+    def _zero_dead(qb, _):
+        dqp_ref[0, 0, pl.ds(qb * block_q, block_q), :] = zero_blk
+        return 0
+
+    jax.lax.fori_loop(0, k_base // block_q, _zero_dead, 0)
 
     def make_body(masked):
         def body(qb, carry):
             dk_acc, dv_acc = carry
             q_blk = q_ref[0, pl.ds(qb * block_q, block_q), :]
             do_blk = do_ref[0, pl.ds(qb * block_q, block_q), :]
-            lse = jnp.tile(
-                lse_ref[0, pl.ds(qb * block_q, block_q), :], (1, reps)
-            )                                         # (block_q, block_k)
-            di = jnp.tile(
-                di_ref[0, pl.ds(qb * block_q, block_q), :], (1, reps)
+            lse = jnp.broadcast_to(
+                lse_ref[0, pl.ds(qb * block_q, block_q), :1],
+                (block_q, block_k),
             )
+            o_blk = o_ref[0, pl.ds(qb * block_q, block_q), :]
+            # delta = rowsum(dO · O) in-kernel: a few VPU ops on resident
+            # data instead of an O(S·lane) f32 HBM round-trip per layer.
+            delta = jnp.sum(
+                do_blk.astype(jnp.float32) * o_blk.astype(jnp.float32),
+                axis=1, keepdims=True,
+            )
+            di = jnp.broadcast_to(delta, (block_q, block_k))
             s = jax.lax.dot_general(
                 q_blk, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -251,11 +232,18 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dk_ref,
                 do_blk, v, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            # scale folded into ds: dk = (ds * scale)^T @ Q.
-            ds = p * (dp - di) * scale
+            # scale folded into ds: dk = (ds*scale)^T @ Q, dq = (ds*scale) @ K.
+            ds = (p * (dp - di) * scale).astype(q_blk.dtype)
             dk_new = dk_acc + jax.lax.dot_general(
-                ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
+                ds, q_blk, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
+            )
+            dq_part = jax.lax.dot_general(
+                ds, k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                         # (block_q, d)
+            dqp_ref[0, 0, pl.ds(qb * block_q, block_q), :] = (
+                dq_part.astype(dqp_ref.dtype)
             )
             return dk_new, dv_new
         return body
@@ -279,56 +267,39 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref, dk_ref,
 def _flash_bwd_bhsd(q, k, v, out, lse, g, scale, block_q, block_k):
     """Backward over (BH, S, D) tensors; returns (dq, dk, dv)."""
     bh, s, d = q.shape
-    # delta_i = rowsum(dO · O): a bandwidth-bound elementwise-reduce XLA
-    # handles optimally; broadcast to the lane layout the kernels expect.
-    di = jnp.sum(
-        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
-    )
-    di = jnp.broadcast_to(di[..., None], (bh, s, _LANE))
-
-    dq = pl.pallas_call(
+    nkb = s // block_k
+    dk, dv, dqp = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, scale=scale, block_q=block_q, block_k=block_k,
-            head_dim=d,
-        ),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-        grid=(bh, s // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANE), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, _LANE), lambda b, i: (b, i, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        interpret=_interpret(),
-    )(q, k, v, g, lse, di)
-
-    dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_dkv_kernel, scale=scale, block_q=block_q, block_k=block_k,
+            _bwd_kernel, scale=scale, block_q=block_q, block_k=block_k,
             head_dim=d, seq_len=s,
         ),
         out_shape=(
             jax.ShapeDtypeStruct((bh, s, d), k.dtype),
             jax.ShapeDtypeStruct((bh, s, d), v.dtype),
+            # dq partials per key block, in the input dtype: each partial
+            # is one f32-accumulated dot rounded once (same rounding the
+            # two-kernel design paid), and the few-term cross-block sum
+            # below runs in f32 — while the partial plane's HBM round-trip
+            # is half the width.
+            jax.ShapeDtypeStruct((bh, nkb, s, d), q.dtype),
         ),
-        grid=(bh, s // block_k),
+        grid=(bh, nkb),
         in_specs=[
             pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s, _LANE), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s, _LANE), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s, _STAT_W), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
         ],
         out_specs=(
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda b, i: (b, i, 0, 0)),
         ),
         interpret=_interpret(),
-    )(q, k, v, g, lse, di)
+    )(q, k, v, g, lse, out)
+    dq = jnp.sum(dqp.astype(jnp.float32), axis=1).astype(q.dtype)
     return dq, dk, dv
 
 
@@ -352,13 +323,16 @@ def _flash_vjp_fwd(scale, block_q, block_k, q, k, v):
     def to_bhsd(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
-    qm, km, vm = to_bhsd(q), to_bhsd(k), to_bhsd(v)
-    out, lse = _flash_fwd_bhsd(qm, km, vm, scale, block_q, block_k)
-    # Named so a rematerialized block can SAVE the kernel outputs (policy
+    # Named so a rematerialized block can SAVE these residuals (policy
     # save_only_these_names / save_from_both_policies) instead of
-    # re-running the forward kernel to regenerate backward residuals.
+    # re-running the forward kernel (out/lse) or re-transposing the
+    # inputs (q/k/v in kernel layout) to regenerate them.
     from jax.ad_checkpoint import checkpoint_name
 
+    qm = checkpoint_name(to_bhsd(q), "flash_q")
+    km = checkpoint_name(to_bhsd(k), "flash_k")
+    vm = checkpoint_name(to_bhsd(v), "flash_v")
+    out, lse = _flash_fwd_bhsd(qm, km, vm, scale, block_q, block_k)
     out = checkpoint_name(out, "flash_out")
     lse = checkpoint_name(lse, "flash_lse")
     return (
@@ -405,7 +379,7 @@ def flash_attention(
         )
     if block_k % _LANE:
         raise ValueError(
-            f"block_k={block_k} must be a multiple of {_LANE} (per-row "
-            f"stats are stored {_LANE}-lane broadcast)"
+            f"block_k={block_k} must be a multiple of {_LANE} (lane "
+            f"quantum of the blocked score sweeps)"
         )
     return _flash(scale, block_q, block_k, q, k, v)

@@ -18,8 +18,14 @@ SPMD style and must run inside ``shard_map`` with the sequence axis mapped;
 :func:`ring_attention_sharded` is the convenience wrapper that builds the
 ``shard_map`` for a given mesh.
 
-Known inefficiency (future work): with causal masking half the ring hops
-carry fully-masked blocks; the zig-zag/striped layout rebalances this.
+Causal load balance: with the plain contiguous layout half the ring hops
+deliver fully-masked blocks to the low-index devices (device 0's queries
+see only chunk 0 — it idles through n-1 hops while device n-1 works every
+hop).  The **zig-zag layout** (``layout="zigzag"``, ≙ Megatron context-
+parallel's striped sharding) fixes this: the sequence is split into ``2n``
+chunks and device ``j`` holds chunks ``j`` and ``2n-1-j`` — one early and
+one late chunk — so every device does ~equal unmasked work on every hop
+(~2× better causal wall-clock at the same communication volume).
 """
 
 from __future__ import annotations
@@ -29,11 +35,36 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["ring_causal_attention", "ring_attention_sharded"]
+__all__ = [
+    "ring_causal_attention",
+    "ring_attention_sharded",
+    "zigzag_indices",
+]
 
 _NEG_INF = -1e30
+
+
+def zigzag_indices(seq_len: int, n_shards: int) -> np.ndarray:
+    """Permutation taking a normally-ordered sequence to zig-zag shard
+    order: shard ``j``'s rows are chunks ``j`` and ``2n-1-j`` of ``2n``
+    equal chunks.  ``inverse_permutation(zigzag_indices(...))`` restores
+    order; integrated users apply this at the DATA layer (token loader)
+    so no runtime gather is needed."""
+    if seq_len % (2 * n_shards):
+        raise ValueError(
+            f"zigzag layout needs seq_len ({seq_len}) divisible by "
+            f"2*n_shards ({2 * n_shards})"
+        )
+    c = seq_len // (2 * n_shards)
+    order = []
+    for j in range(n_shards):
+        order.extend(range(j * c, (j + 1) * c))
+        lo = (2 * n_shards - 1 - j) * c
+        order.extend(range(lo, lo + c))
+    return np.asarray(order, np.int32)
 
 
 def ring_causal_attention(
@@ -42,12 +73,16 @@ def ring_causal_attention(
     v: jax.Array,
     axis_name: str,
     scale: Optional[float] = None,
+    layout: str = "contiguous",
 ) -> jax.Array:
     """Per-device body: q/k/v are the LOCAL sequence shards (B, S/n, H, D).
 
     Must execute inside ``shard_map`` with ``axis_name`` mapped over the
     sequence-parallel mesh axis.  Differentiable (reverse-mode flows back
-    through the ``ppermute`` ring).
+    through the ``ppermute`` ring).  With ``layout="zigzag"`` the local
+    shard must hold global chunks ``(j, 2n-1-j)`` (see
+    :func:`zigzag_indices`); masking is driven purely by global positions,
+    so the fold logic is layout-agnostic.
     """
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
@@ -55,7 +90,19 @@ def ring_causal_attention(
     scale = (d ** -0.5) if scale is None else scale
 
     qf = q.astype(jnp.float32) * scale
-    q_pos = my_idx * s_loc + jnp.arange(s_loc)  # global query positions
+
+    def shard_positions(dev_idx):
+        """Global sequence positions of device ``dev_idx``'s local rows."""
+        if layout == "zigzag":
+            c = s_loc // 2
+            lo = dev_idx * c
+            hi = (2 * axis_size - 1 - dev_idx) * c
+            return jnp.concatenate(
+                [lo + jnp.arange(c), hi + jnp.arange(c)]
+            )
+        return dev_idx * s_loc + jnp.arange(s_loc)
+
+    q_pos = shard_positions(my_idx)  # global query positions
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
 
     def fold(acc, m, l, k_cur, v_cur, i):
@@ -63,7 +110,7 @@ def ring_causal_attention(
         # data moves j -> j+1 each hop, so after i hops we hold chunk
         # (my_idx - i) mod n.
         src_idx = jax.lax.rem(my_idx - i + axis_size, axis_size)
-        k_pos = src_idx * s_loc + jnp.arange(s_loc)
+        k_pos = shard_positions(src_idx)
         logits = jnp.einsum(
             "bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32),
             preferred_element_type=jnp.float32,
@@ -119,6 +166,7 @@ def ring_attention_sharded(
     seq_axis: str = "sp",
     data_axis="auto",
     scale: Optional[float] = None,
+    layout: str = "contiguous",
 ) -> jax.Array:
     """Global-view wrapper: (B, S, H, D) arrays, S sharded over ``seq_axis``.
 
@@ -126,6 +174,12 @@ def ring_attention_sharded(
     mesh axis (``data`` and ``fsdp`` — matching the train step's batch
     sharding, so no resharding happens at the attention boundary);
     pass ``None`` for a pure sequence-parallel mesh.
+
+    ``layout="zigzag"``: inputs/outputs stay NORMALLY ordered — this
+    wrapper applies the zig-zag permutation going in and inverts it going
+    out (two sequence-dim gathers).  Long-running training integrations
+    should instead permute tokens once at the data layer
+    (:func:`zigzag_indices`) and call the per-device body directly.
     """
     from jax import shard_map
 
@@ -139,8 +193,17 @@ def ring_attention_sharded(
         batch_axes = None
     spec = P(batch_axes, seq_axis, None, None)
     fn = functools.partial(
-        ring_causal_attention, axis_name=seq_axis, scale=scale
+        ring_causal_attention, axis_name=seq_axis, scale=scale,
+        layout=layout,
     )
-    return shard_map(
+    if layout == "zigzag":
+        n = mesh.shape[seq_axis]
+        order = jnp.asarray(zigzag_indices(q.shape[1], n))
+        inv = jnp.argsort(order)
+        q, k, v = (jnp.take(x, order, axis=1) for x in (q, k, v))
+    out = shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)
+    if layout == "zigzag":
+        out = jnp.take(out, inv, axis=1)
+    return out

@@ -20,6 +20,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
     "compute_host_ranks",
+    "partition_host_chips",
     "bootstrap_distributed",
     "build_mesh",
     "MeshSpec",
@@ -47,6 +48,48 @@ def compute_host_ranks(
         local_counts[ip] += 1
         mapping[global_rank] = (node_rank, local_rank)
     return mapping
+
+
+def partition_host_chips(
+    node_ips: Sequence[str],
+    chips_per_host: int = 4,
+) -> Dict[int, Optional[str]]:
+    """Disjoint per-worker ``TPU_VISIBLE_CHIPS`` values for co-located
+    workers.
+
+    ≙ the reference's per-node ``CUDA_VISIBLE_DEVICES`` computation
+    (``ray_ddp.py:230-274``, tested ``test_ddp_gpu.py:85-122``) — but
+    where NCCL wants every co-located worker to see the node's full GPU
+    union, a TPU host's chips must be PARTITIONED: each PJRT process
+    exclusively owns its chips, so k workers sharing a host each get a
+    disjoint ``chips_per_host / k`` slice (by local rank, in submission
+    order).
+
+    Returns global rank → chips string (``"0,1"``) for workers that share
+    a host, or ``None`` for a host's sole worker (no constraint: it owns
+    every chip, and clobbering an externally-set visibility would be
+    wrong).
+    """
+    ranks = compute_host_ranks(node_ips)
+    counts: Dict[str, int] = collections.Counter(node_ips)
+    out: Dict[int, Optional[str]] = {}
+    for global_rank, ip in enumerate(node_ips):
+        k = counts[ip]
+        if k <= 1:
+            out[global_rank] = None
+            continue
+        if chips_per_host % k:
+            raise ValueError(
+                f"{k} workers share host {ip} but {chips_per_host} chips "
+                f"per host do not divide evenly; use a worker count that "
+                f"divides the chip count or one worker per host."
+            )
+        per = chips_per_host // k
+        _, local_rank = ranks[global_rank]
+        out[global_rank] = ",".join(
+            str(c) for c in range(local_rank * per, (local_rank + 1) * per)
+        )
+    return out
 
 
 def bootstrap_distributed(

@@ -66,7 +66,11 @@ __all__ = [
 # ---------------------------------------------------------------------------
 
 def _remote_latest_restart_checkpoint(restart_dir: str):
-    """Runs on worker 0: newest elastic-restart checkpoint on its node."""
+    """Runs on worker 0: newest COMPLETE elastic-restart checkpoint on
+    its node.  Sharded checkpoints (directories) count only once their
+    META marker exists — a crash mid-write must never be resumed from."""
+    from ray_lightning_tpu.utils.sharded_ckpt import is_sharded_ckpt
+
     try:
         names = sorted(
             n for n in os.listdir(restart_dir)
@@ -74,7 +78,11 @@ def _remote_latest_restart_checkpoint(restart_dir: str):
         )
     except OSError:
         return None
-    return os.path.join(restart_dir, names[-1]) if names else None
+    for name in reversed(names):
+        path = os.path.join(restart_dir, name)
+        if os.path.isfile(path) or is_sharded_ckpt(path):
+            return path
+    return None
 
 
 def _remote_find_free_port() -> int:
@@ -273,12 +281,42 @@ class TpuStrategy:
                 resources=self.additional_resources_per_worker or None,
             )
             self._workers.append(worker)
+        if self.use_tpu:
+            self._partition_host_chips()
         if self.init_hook is not None:
             futures = [
                 w.submit(self.init_hook) for w in self._workers
             ]
             for f in futures:
                 f.result()
+
+    def _partition_host_chips(self) -> None:
+        """Split ``TPU_VISIBLE_CHIPS`` between co-located workers.
+
+        ≙ reference ``_setup_env_vars``'s per-node device-visibility push
+        (``ray_ddp.py:230-274``) with TPU partition semantics (each PJRT
+        process must own its chips exclusively — see
+        :func:`..mesh.partition_host_chips`).  Pushed BEFORE the worker's
+        first jax import (workers import jax lazily when the task runs),
+        so visibility is in place at PJRT init.  Sole-owner hosts are
+        left untouched.
+        """
+        from ray_lightning_tpu.parallel.mesh import partition_host_chips
+
+        ips = [w.get_node_ip() for w in self._workers]
+        chips_per_host = int(os.environ.get("RLT_TPU_CHIPS_PER_HOST", 4))
+        try:
+            chip_map = partition_host_chips(ips, chips_per_host)
+        except ValueError as err:
+            # CPU-simulated meshes co-locate freely; on real TPU an
+            # un-partitionable layout will fail at PJRT init anyway, with
+            # this warning naming the cause first.
+            warnings.warn(f"TPU chip partitioning skipped: {err}")
+            return
+        for rank, worker in enumerate(self._workers):
+            chips = chip_map.get(rank)
+            if chips is not None:
+                worker.set_env_vars({"TPU_VISIBLE_CHIPS": chips})
 
     def _respawn_workers(self) -> None:
         """Kill every current worker (peers of a dead one may be stuck in
@@ -426,6 +464,9 @@ class TpuStrategy:
             results = process_results(futures, queue, on_item=on_item)
         finally:
             queue.shutdown()
+            # Segment-backed task payloads are per-fit; without this,
+            # repeated fits on one backend (PBT) leak tmpfs ∝ fits × size.
+            task_ref.release()
         return results
 
     def teardown(self) -> None:

@@ -1,0 +1,182 @@
+"""Per-host sharded restart checkpoints — no all-gather, any-topology load.
+
+The elastic-restart path used to funnel every checkpoint through
+``LoopContext._gathered_state`` — a full replication of the train state
+onto every host (an XLA all-gather) just so rank 0 could write one file.
+For a ZeRO-3 run that defeats parameter sharding exactly at the scale it
+targets (SURVEY §7 hard-part #4; VERDICT r3 weak #2).
+
+Here every process writes only its ADDRESSABLE shards:
+
+* ``save_shard``: one file per process inside a checkpoint DIRECTORY
+  (``<tag>/shard-00002-of-00008.ckpt``), holding, for every pytree leaf,
+  the host-local shard byte blocks plus their global index — deduped per
+  unique index, so replicated leaves cost one copy per host, and ZeRO-3
+  parameters cost exactly ``1/hosts`` of the model per file.
+* ``save_meta`` (rank 0, AFTER a mesh barrier): the pickled treedef, the
+  shard count, and the loop metadata (epoch/step/callback states).  A
+  directory without ``META.ckpt`` is an incomplete write and is ignored
+  by resume discovery — the same torn-file discipline as the atomic
+  single-file path.
+* ``load_sharded``: reads all shard files, reassembles full host numpy
+  leaves by index, and returns the same payload dict the single-file
+  format yields — so resume stays topology-independent (save on N hosts,
+  restore on 1 or M; the caller re-places onto its own shardings).
+
+Trust model matches ``state_stream``: leaf DATA is raw msgpack bytes;
+the treedef/metadata are pickled, so checkpoints are only as trustworthy
+as their source.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, List, Optional
+
+import jax
+import msgpack
+import numpy as np
+
+__all__ = ["save_shard", "save_meta", "load_sharded", "is_sharded_ckpt"]
+
+_META = "META.ckpt"
+
+
+def _shard_name(rank: int, world: int) -> str:
+    return f"shard-{rank:05d}-of-{world:05d}.ckpt"
+
+
+def _np_of(x) -> np.ndarray:
+    return np.asarray(jax.device_get(x))
+
+
+def _leaf_record(leaf: Any) -> Dict[str, Any]:
+    """Encode the host-addressable pieces of one pytree leaf."""
+    entries: List[Dict[str, Any]] = []
+    if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
+        shape = leaf.shape
+        seen = set()
+        for sh in leaf.addressable_shards:
+            idx = tuple(
+                (
+                    0 if s.start is None else int(s.start),
+                    dim if s.stop is None else int(s.stop),
+                )
+                for s, dim in zip(sh.index, shape)
+            )
+            if idx in seen:  # local replicas: one copy per host
+                continue
+            seen.add(idx)
+            data = _np_of(sh.data)
+            entries.append({"i": [list(p) for p in idx], "b": data.tobytes()})
+        return {"s": list(shape), "d": str(leaf.dtype), "e": entries}
+    arr = _np_of(leaf) if leaf is not None else None
+    if arr is None:
+        return {"s": None, "d": None, "e": []}
+    idx = [[0, dim] for dim in arr.shape]
+    return {
+        "s": list(arr.shape),
+        "d": str(arr.dtype),
+        "e": [{"i": idx, "b": arr.tobytes()}],
+    }
+
+
+def _dtype_of(name: str) -> np.dtype:
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def save_shard(tree: Any, dirpath: str, rank: int, world: int) -> str:
+    """Write this process's addressable shards of ``tree`` (atomic)."""
+    os.makedirs(dirpath, exist_ok=True)
+    leaves, _ = jax.tree_util.tree_flatten(tree)
+    blob = msgpack.packb(
+        {"rank": rank, "world": world,
+         "leaves": [_leaf_record(leaf) for leaf in leaves]},
+        use_bin_type=True,
+    )
+    path = os.path.join(dirpath, _shard_name(rank, world))
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+    return path
+
+
+def save_meta(tree: Any, dirpath: str, world: int,
+              extra: Optional[Dict[str, Any]] = None) -> str:
+    """Rank-0 completeness marker.  Callers MUST barrier after
+    ``save_shard`` and before this — META asserts every shard is durable."""
+    _, treedef = jax.tree_util.tree_flatten(tree)
+    blob = msgpack.packb(
+        {"world": world,
+         "treedef": pickle.dumps(treedef),
+         "extra": pickle.dumps(extra or {})},
+        use_bin_type=True,
+    )
+    path = os.path.join(dirpath, _META)
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+    return path
+
+
+def is_sharded_ckpt(path: str) -> bool:
+    return os.path.isdir(path) and os.path.exists(
+        os.path.join(path, _META)
+    )
+
+
+def load_sharded(dirpath: str) -> Dict[str, Any]:
+    """Reassemble a payload dict: ``{"state": host_tree, **extra}``."""
+    with open(os.path.join(dirpath, _META), "rb") as f:
+        meta = msgpack.unpackb(f.read(), raw=False)
+    world = meta["world"]
+    treedef = pickle.loads(meta["treedef"])
+    extra = pickle.loads(meta["extra"])
+
+    shard_files = [
+        os.path.join(dirpath, _shard_name(r, world)) for r in range(world)
+    ]
+    missing = [p for p in shard_files if not os.path.exists(p)]
+    if missing:
+        raise FileNotFoundError(
+            f"sharded checkpoint {dirpath} is missing "
+            f"{len(missing)}/{world} shard files (e.g. {missing[0]})"
+        )
+
+    leaves: List[Optional[np.ndarray]] = []
+    filled: List[int] = []
+    for path in shard_files:
+        with open(path, "rb") as f:
+            payload = msgpack.unpackb(f.read(), raw=False)
+        records = payload["leaves"]
+        if not leaves:
+            leaves = [None] * len(records)
+            filled = [0] * len(records)
+        for i, rec in enumerate(records):
+            if rec["s"] is None:
+                continue
+            shape = tuple(rec["s"])
+            dtype = _dtype_of(rec["d"])
+            if leaves[i] is None:
+                leaves[i] = np.empty(shape, dtype)
+            for entry in rec["e"]:
+                idx = tuple(slice(a, b) for a, b in entry["i"])
+                block_shape = tuple(b - a for a, b in entry["i"])
+                block = np.frombuffer(
+                    entry["b"], dtype=dtype
+                ).reshape(block_shape)
+                if idx:
+                    leaves[i][idx] = block
+                else:  # 0-d leaf
+                    leaves[i] = block.copy()
+                filled[i] += int(np.prod(block_shape)) if block_shape else 1
+
+    tree = jax.tree_util.tree_unflatten(treedef, leaves)
+    return {"state": tree, **extra}

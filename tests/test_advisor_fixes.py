@@ -1,0 +1,301 @@
+"""Regression tests for the round-1/round-2 advisor findings (VERDICT r3
+weak #4-5): agent-RPC retry, segment release, crc32c fallback, partial
+accumulation-window flush, lr/optimizer-step conventions.
+"""
+
+import os
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from ray_lightning_tpu.core.trainer import Trainer
+from ray_lightning_tpu.models import BoringModel
+from ray_lightning_tpu.parallel.strategies import LocalStrategy
+
+from test_trainer_features import FixedDataModule
+
+
+# -- (r1-a) agent RPC retry before declaring death ---------------------------
+
+class _FlakyClient:
+    """AgentClient stand-in: fails transiently N times, then answers."""
+
+    def __init__(self, failures, answer=None, exc=ConnectionError):
+        self.failures = failures
+        self.answer = answer
+        self.exc = exc
+        self.calls = 0
+
+    def poll(self, pid):
+        self.calls += 1
+        if self.calls <= self.failures:
+            raise self.exc("transient")
+        return self.answer
+
+
+def _handle(client):
+    from ray_lightning_tpu.cluster.agent import _RemoteProcHandle
+
+    h = _RemoteProcHandle.__new__(_RemoteProcHandle)
+    h._client = client
+    h.pid = 123
+    h.returncode = None
+    return h
+
+
+def test_poll_survives_transient_rpc_failure():
+    """Two dropped RPCs then a healthy answer: the child must still read
+    as ALIVE (None), not dead — a spurious -1 triggers a full elastic
+    respawn upstream."""
+    h = _handle(_FlakyClient(failures=2, answer=None))
+    assert h.poll() is None
+    assert h.returncode is None
+
+
+def test_poll_declares_death_after_retry_budget():
+    client = _FlakyClient(failures=99)
+    h = _handle(client)
+    assert h.poll() == -1
+    assert client.calls == 3  # the full retry budget was spent
+
+
+def test_poll_trusts_structured_agent_error():
+    """A structured AgentError reply (unknown pid) is deterministic — no
+    retries, immediate death verdict."""
+    from ray_lightning_tpu.cluster.agent import AgentError
+
+    client = _FlakyClient(failures=99, exc=AgentError)
+    h = _handle(client)
+    assert h.poll() == -1
+    assert client.calls == 1
+
+
+# -- (r1-b) segment release per fit ------------------------------------------
+
+def test_objectref_release_reclaims_segment(tmp_path):
+    from ray_lightning_tpu.cluster.backend import LocalBackend
+
+    be = LocalBackend(min_segment_bytes=0)  # force segment spill
+    try:
+        ref = be.put({"blob": b"x" * 4096})
+        path = ref._segment_path
+        assert path is not None and os.path.exists(path)
+        ref.release()
+        assert not os.path.exists(path)
+        ref.release()  # idempotent
+    finally:
+        be.shutdown()
+
+
+def test_repeated_fits_do_not_accumulate_segments(tmp_path, monkeypatch):
+    """The PBT pattern: many fits on one strategy/backend must not leak
+    tmpfs segments (task payloads are released per fit)."""
+    from ray_lightning_tpu.cluster.backend import LocalBackend
+    from ray_lightning_tpu.parallel.strategies import RayStrategy
+
+    x = np.random.default_rng(0).standard_normal((16, 32)).astype(np.float32)
+    # A caller-OWNED backend spans trainers (the PBT pattern): strategy
+    # teardown must not shut it down, so leaked segments would pile up.
+    be = LocalBackend(min_segment_bytes=0)
+    try:
+        live = []
+        for _ in range(2):
+            trainer = Trainer(
+                strategy=RayStrategy(num_workers=1, backend=be),
+                max_epochs=1, default_root_dir=str(tmp_path),
+                enable_checkpointing=False,
+            )
+            trainer.fit(BoringModel(), FixedDataModule(x, batch_size=8))
+            live.append(
+                sum(1 for p in be._store._paths if os.path.exists(p))
+            )
+        assert live[1] <= live[0]
+        assert live[1] == 0  # every task payload was released
+    finally:
+        be.shutdown()
+
+
+# -- (r1-c) crc32c software fallback -----------------------------------------
+
+def test_crc32c_python_fallback_vector():
+    from ray_lightning_tpu.native import _crc32c_py
+
+    # RFC 3720 test vector for CRC32C (Castagnoli).
+    assert _crc32c_py(b"123456789") == 0xE3069283
+    # Seed chaining: crc(a+b) == crc(b, crc(a)).
+    a, b = b"hello ", b"world"
+    assert _crc32c_py(a + b) == _crc32c_py(b, _crc32c_py(a))
+
+
+def test_crc32c_entrypoint_never_raises(monkeypatch):
+    """crc32c() must work with the native library absent (pure-Python
+    deployment), and agree with the native result when present."""
+    import ray_lightning_tpu.native as native
+
+    want = native._crc32c_py(b"123456789")
+    if native.native_available():
+        assert native.crc32c(b"123456789") == want
+    monkeypatch.setattr(native, "_load", lambda: None)
+    assert native.crc32c(b"123456789") == want
+
+
+# -- (r2-a) partial accumulation window flushes at epoch end -----------------
+
+def test_accum_flush_unit():
+    """_build_accum_flush applies exactly one inner update from the mean
+    of the accumulated micro-grads and resets the window."""
+    from ray_lightning_tpu.core.loop import _build_accum_flush
+    from ray_lightning_tpu.core.module import TrainState
+
+    inner = optax.sgd(0.5)
+    tx = optax.MultiSteps(inner, every_k_schedule=3)
+    params = {"w": np.ones(4, np.float32)}
+    state = TrainState.create(params, tx)
+    g1 = {"w": np.full(4, 2.0, np.float32)}
+    g2 = {"w": np.full(4, 4.0, np.float32)}
+    for g in (g1, g2):  # two micro-grads of a 3-window
+        updates, new_opt = tx.update(g, state.opt_state, state.params)
+        state = TrainState(
+            optax.apply_updates(state.params, updates), new_opt, state.step
+        )
+    assert int(state.opt_state.mini_step) == 2
+    np.testing.assert_allclose(state.params["w"], 1.0)  # not applied yet
+
+    flush = _build_accum_flush(inner, mesh=None, state_shardings=None)
+    state = flush(state)
+    # mean(2, 4) = 3; sgd(0.5) => 1 - 1.5
+    np.testing.assert_allclose(np.asarray(state.params["w"]), -0.5,
+                               rtol=1e-6)
+    assert int(state.opt_state.mini_step) == 0
+    assert int(state.opt_state.gradient_step) == 1
+
+
+def test_accum_partial_window_flushes_in_fit(tmp_path):
+    """3 micro-batches with accumulate=2: the trailing odd batch still
+    reaches the params (global_step = 2 optimizer updates, not 1)."""
+    x = np.random.default_rng(0).standard_normal((24, 32)).astype(np.float32)
+    trainer = Trainer(
+        strategy=LocalStrategy(), max_epochs=1, accumulate_grad_batches=2,
+        default_root_dir=str(tmp_path), enable_checkpointing=False,
+    )
+    trainer.fit(BoringModel(), FixedDataModule(x, batch_size=8))
+    assert trainer.global_step == 2
+
+
+def test_accum_flush_keeps_counter_synced_across_epochs(tmp_path):
+    """After an epoch-end flush resets MultiSteps' window, the next
+    epoch's optimizer-step counting must follow the window position, not
+    micro_step % accum.  6 batches/epoch at accum=4, 2 epochs:
+    epoch 0 -> update@4 + flush(2) = 2; epoch 1 -> update@(2+2... window
+    of 4 spanning the boundary reset) = updates at micro 10 and flush(2)
+    = 2 more; total 4."""
+    x = np.random.default_rng(0).standard_normal((48, 32)).astype(np.float32)
+    trainer = Trainer(
+        strategy=LocalStrategy(), max_epochs=2, accumulate_grad_batches=4,
+        default_root_dir=str(tmp_path), enable_checkpointing=False,
+    )
+    trainer.fit(BoringModel(), FixedDataModule(x, batch_size=8))
+    assert trainer.micro_step == 12
+    assert trainer.global_step == 4
+
+
+def test_max_steps_exact_after_flush(tmp_path):
+    """max_steps counts REAL optimizer updates even when a flush happened
+    in an earlier epoch (the desync would stop one update early)."""
+    x = np.random.default_rng(0).standard_normal((48, 32)).astype(np.float32)
+    trainer = Trainer(
+        strategy=LocalStrategy(), max_epochs=10, accumulate_grad_batches=4,
+        max_steps=3, default_root_dir=str(tmp_path),
+        enable_checkpointing=False,
+    )
+    trainer.fit(BoringModel(), FixedDataModule(x, batch_size=8))
+    assert trainer.global_step == 3
+
+
+def test_legacy_checkpoint_resume_micro_convention(tmp_path):
+    """Pre-convention checkpoints stored the MICRO count in
+    'global_step'; resume must map it to optimizer steps, not multiply
+    it up."""
+    from ray_lightning_tpu.core.loop import FitConfig, run_fit
+    from ray_lightning_tpu.utils.state_stream import (
+        state_stream_to_file, to_state_stream,
+    )
+
+    # Forge a legacy payload: fit once to get a real state, then strip
+    # the micro_step key and store micro count under global_step.
+    x = np.random.default_rng(0).standard_normal((32, 32)).astype(np.float32)
+    cfg = FitConfig(max_epochs=1, accumulate_grad_batches=2, seed=0,
+                    default_root_dir=str(tmp_path))
+    module = BoringModel()
+    run_fit(module, FixedDataModule(x, batch_size=8), cfg, callbacks=[])
+    state = module.trainer.state
+    legacy = {
+        "state": jax.device_get(state),
+        "epoch": 0,
+        "global_step": 6,  # legacy = MICRO batches (3 optimizer steps)
+        "callback_metrics": {},
+    }
+    path = str(tmp_path / "legacy.ckpt")
+    state_stream_to_file(to_state_stream(legacy), path)
+
+    cfg2 = FitConfig(max_epochs=2, accumulate_grad_batches=2, seed=0,
+                     default_root_dir=str(tmp_path),
+                     resume_from_checkpoint=path)
+    module2 = BoringModel()
+    res = run_fit(module2, FixedDataModule(x, batch_size=8), cfg2,
+                  callbacks=[])
+    # Resumed counters: global_step continued from 6//2=3, one more
+    # epoch of 4 micro-batches = 2 more updates.
+    assert res["global_step"] == 3 + 2
+    assert res["micro_step"] == 6 + 4
+
+
+# -- (r2-b) lr/global_step optimizer-step convention -------------------------
+
+def test_global_step_counts_optimizer_steps(tmp_path):
+    """4 micro-batches at accumulate=2 => global_step == 2 (Lightning's
+    optimizer-step convention, not the micro-batch count)."""
+    x = np.random.default_rng(0).standard_normal((32, 32)).astype(np.float32)
+    trainer = Trainer(
+        strategy=LocalStrategy(), max_epochs=1, accumulate_grad_batches=2,
+        default_root_dir=str(tmp_path), enable_checkpointing=False,
+    )
+    trainer.fit(BoringModel(), FixedDataModule(x, batch_size=8))
+    assert trainer.global_step == 2
+
+
+def test_logged_lr_is_last_applied(tmp_path):
+    """The logged lr belongs to the optimizer step just TAKEN: after k
+    updates the last one used schedule(k-1), not schedule(k)."""
+    from test_trainer_features import ScheduledBoring
+
+    x = np.random.default_rng(0).standard_normal((24, 32)).astype(np.float32)
+    trainer = Trainer(
+        strategy=LocalStrategy(), max_epochs=1,
+        default_root_dir=str(tmp_path), enable_checkpointing=False,
+        log_every_n_steps=1,
+    )
+    trainer.fit(ScheduledBoring(), FixedDataModule(x, batch_size=8))
+    assert trainer.global_step == 3
+    schedule = optax.linear_schedule(0.1, 0.0, 100)
+    assert trainer.callback_metrics["lr"] == pytest.approx(
+        float(schedule(2))
+    )
+
+
+# -- (r2-c) dual-convention MFU fields in bench ------------------------------
+
+def test_bench_reports_both_mfu_conventions():
+    import bench
+
+    cfg_flops_full = bench.model_flops_per_token(
+        bench.GPTConfig.tiny(), attn="full")
+    cfg_flops_causal = bench.model_flops_per_token(
+        bench.GPTConfig.tiny(), attn="causal")
+    assert cfg_flops_causal < cfg_flops_full
+    # Attention term is exactly halved; everything else is identical.
+    cfg = bench.GPTConfig.tiny()
+    attn_full = 3.0 * 4 * cfg.n_layer * cfg.seq_len * cfg.d_model
+    assert cfg_flops_full - cfg_flops_causal == pytest.approx(attn_full / 2)
