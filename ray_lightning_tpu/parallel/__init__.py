@@ -1,4 +1,11 @@
-from .mesh import MeshSpec, build_mesh, bootstrap_distributed, compute_host_ranks
+from .mesh import (
+    MeshSpec,
+    bootstrap_distributed,
+    build_mesh,
+    compute_host_ranks,
+    partition_host_chips,
+)
+from .pipeline import pipeline_apply, pipelined_scan
 from .sharding import (
     batch_sharding,
     make_global_batch,
@@ -12,6 +19,9 @@ __all__ = [
     "build_mesh",
     "bootstrap_distributed",
     "compute_host_ranks",
+    "partition_host_chips",
+    "pipeline_apply",
+    "pipelined_scan",
     "batch_sharding",
     "make_global_batch",
     "replicated",

@@ -178,5 +178,19 @@ def load_sharded(dirpath: str) -> Dict[str, Any]:
                     leaves[i] = block.copy()
                 filled[i] += int(np.prod(block_shape)) if block_shape else 1
 
+    # Coverage check: every element of every leaf must have been written
+    # by some shard — an uncovered region would be np.empty garbage
+    # silently resumed into the params.
+    for i, leaf in enumerate(leaves):
+        if leaf is None:
+            continue
+        expect = int(np.prod(leaf.shape)) if leaf.shape else 1
+        if expect and filled[i] < expect:
+            raise ValueError(
+                f"sharded checkpoint {dirpath}: leaf {i} covered "
+                f"{filled[i]}/{expect} elements — shard entries are "
+                f"incomplete or corrupt"
+            )
+
     tree = jax.tree_util.tree_unflatten(treedef, leaves)
     return {"state": tree, **extra}
