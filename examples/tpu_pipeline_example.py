@@ -1,0 +1,107 @@
+"""Pipeline-parallel GPT trunk: GPipe over a ``pipe`` mesh axis.
+
+Net-new capability (no reference analogue — the reference has no pipeline
+concept): the GPT block stack's stacked ``(L, ...)`` parameter layout
+doubles as the stage assignment — sharding that axis over ``pipe`` gives
+each device a contiguous run of layers, and
+:func:`ray_lightning_tpu.parallel.pipeline_apply` streams microbatches
+through the stages with ``lax.ppermute`` handoffs.
+
+The example builds a tiny GPT, runs its trunk both plain (one scan over
+all layers) and pipelined (4 stages × 8 microbatches), checks they agree,
+and takes one gradient step through the pipeline — demonstrating that the
+reversed pipeline schedule falls out of ``jax.grad`` with no extra code.
+
+Run (CPU mesh):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/tpu_pipeline_example.py --smoke-test
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import numpy as np
+
+
+def main(smoke_test: bool = False, n_stages: int = 4,
+         num_microbatches: int = 8):
+    # Self-provision a virtual device mesh when the host has too few
+    # devices (CI runs with no XLA_FLAGS) — must happen before the first
+    # jax import, which is why jax is imported inside main.
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n_stages}"
+        ).strip()
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from ray_lightning_tpu.models.gpt import (
+        GPT, GPTConfig, make_block_stage,
+    )
+    from ray_lightning_tpu.parallel import pipeline_apply
+
+    cfg = GPTConfig(vocab_size=256, n_layer=n_stages * 2, n_head=4,
+                    d_model=64, seq_len=64, warmup_steps=1)
+    model = GPT(cfg, attn_impl="xla")
+    params = model.init_params(jax.random.PRNGKey(0))
+    batch = num_microbatches * (1 if smoke_test else 2)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (batch, cfg.seq_len), 0, cfg.vocab_size
+    )
+    x0 = (params["wte"][tokens] + params["wpe"][: cfg.seq_len]).astype(
+        jnp.float32
+    )
+
+    block_stage = make_block_stage(cfg)
+
+    devices = jax.devices()
+    if len(devices) < n_stages:
+        raise SystemExit(
+            f"need {n_stages} devices for {n_stages} stages; run with "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=8"
+        )
+    mesh = Mesh(np.asarray(devices[:n_stages]), ("pipe",))
+
+    plain = block_stage(params["blocks"], x0)
+    piped = pipeline_apply(
+        block_stage, params["blocks"], x0, mesh,
+        num_microbatches=num_microbatches,
+    )
+    err = float(jnp.abs(piped - plain).max())
+    assert err < 1e-4, f"pipeline/plain mismatch: {err}"
+
+    def loss(blocks):
+        out = pipeline_apply(
+            block_stage, blocks, x0, mesh,
+            num_microbatches=num_microbatches,
+        )
+        return (out.astype(jnp.float32) ** 2).mean()
+
+    grads = jax.jit(jax.grad(loss))(params["blocks"])
+    gnorm = float(
+        jnp.sqrt(sum(
+            (g.astype(jnp.float32) ** 2).sum()
+            for g in jax.tree_util.tree_leaves(grads)
+        ))
+    )
+    assert np.isfinite(gnorm)
+    print(
+        f"pipeline({n_stages} stages x {num_microbatches} microbatches): "
+        f"fwd matches plain scan (max err {err:.2e}), grad norm {gnorm:.4f}"
+    )
+
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser()
+    p.add_argument("--smoke-test", action="store_true")
+    p.add_argument("--num-stages", type=int, default=4)
+    p.add_argument("--num-microbatches", type=int, default=8)
+    a = p.parse_args()
+    main(a.smoke_test, a.num_stages, a.num_microbatches)

@@ -69,7 +69,9 @@ class FitConfig:
     # ``restart_every_n_epochs`` the loop writes a topology-independent
     # checkpoint here so the strategy can respawn dead workers and resume.
     restart_dir: Optional[str] = None
-    restart_every_n_epochs: int = 1
+    # None = unset: the strategy's elastic default applies.  An explicit
+    # Trainer(restart_every_n_epochs=...) always wins over the strategy.
+    restart_every_n_epochs: Optional[int] = None
 
     def __post_init__(self):
         if self.fast_dev_run:
@@ -591,7 +593,7 @@ def run_fit(
         # of replicating the world every restart_every_n_epochs.
         if (
             config.restart_dir
-            and (epoch + 1) % config.restart_every_n_epochs == 0
+            and (epoch + 1) % (config.restart_every_n_epochs or 1) == 0
         ):
             from ray_lightning_tpu.utils import sharded_ckpt
 

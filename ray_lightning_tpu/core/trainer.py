@@ -88,6 +88,8 @@ class Trainer:
         enable_checkpointing: bool = True,
         fast_dev_run: bool = False,
         resume_from_checkpoint: Optional[str] = None,
+        restart_dir: Optional[str] = None,
+        restart_every_n_epochs: Optional[int] = None,
     ):
         # Imported here, not at module top: strategies imports the loop,
         # which lives beside this module (cycle otherwise).
@@ -120,6 +122,12 @@ class Trainer:
             default_root_dir=default_root_dir,
             resume_from_checkpoint=resume_from_checkpoint,
             fast_dev_run=fast_dev_run,
+            # Elastic-restart checkpoint location.  When None, strategies
+            # with max_restarts > 0 manage a scratch dir themselves; a
+            # caller-provided dir is written to (per-host sharded, see
+            # utils/sharded_ckpt.py) and PRESERVED after the fit.
+            restart_dir=restart_dir,
+            restart_every_n_epochs=restart_every_n_epochs,
         )
 
         # Post-run artifacts (populated like reference post_dispatch).

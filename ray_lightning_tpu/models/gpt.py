@@ -36,7 +36,7 @@ from ray_lightning_tpu.core.data import TpuDataModule, NumpyLoader
 from ray_lightning_tpu.core.module import TpuModule
 from ray_lightning_tpu.ops import causal_attention
 
-__all__ = ["GPTConfig", "GPT", "SyntheticLMDataModule"]
+__all__ = ["GPTConfig", "GPT", "SyntheticLMDataModule", "make_block_stage"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -408,6 +408,43 @@ class GPT(TpuModule):
                         weight_decay=cfg.weight_decay),
         )
         return tx
+
+
+def make_block_stage(cfg: GPTConfig, compute_dtype=jnp.float32):
+    """Stage function for :func:`..parallel.pipeline.pipeline_apply`:
+    ``(blocks_shard, x) -> x`` running a contiguous run of DENSE GPT
+    blocks (any leading layer count — the pipeline shards the stacked
+    layer axis).  The single source of the block math for the pipeline
+    tests/example/dryrun; the training path keeps its own scan in
+    :meth:`GPT.forward_hidden` (remat + MoE + sharding constraints).
+    """
+    if cfg.n_experts > 0:
+        raise ValueError("make_block_stage covers dense blocks only")
+
+    def stage(blocks, x):
+        b, t = x.shape[0], x.shape[1]
+        c = compute_dtype
+
+        def body(x, p):
+            h = _layer_norm(x, p["ln1_g"], p["ln1_b"])
+            qkv = h @ p["qkv_w"].astype(c) + p["qkv_b"].astype(c)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            att = causal_attention(
+                *(z.reshape(b, t, cfg.n_head, cfg.head_dim)
+                  for z in (q, k, v)), impl="xla",
+            ).reshape(b, t, cfg.d_model)
+            x = x + att @ p["proj_w"].astype(c) + p["proj_b"].astype(c)
+            h = _layer_norm(x, p["ln2_g"], p["ln2_b"])
+            h = jax.nn.gelu(h @ p["mlp_in_w"].astype(c)
+                            + p["mlp_in_b"].astype(c))
+            return x + h @ p["mlp_out_w"].astype(c) + (
+                p["mlp_out_b"].astype(c)
+            ), None
+
+        x, _ = jax.lax.scan(body, x, blocks)
+        return x
+
+    return stage
 
 
 class SyntheticLMDataModule(TpuDataModule):

@@ -128,12 +128,23 @@ def pipeline_apply(
     from jax import shard_map
 
     n_stages = mesh.shape[pipe_axis]
-    m = num_microbatches or n_stages
+    if num_microbatches is not None and num_microbatches < 1:
+        raise ValueError(f"num_microbatches must be >= 1, got "
+                         f"{num_microbatches}")
+    m = num_microbatches if num_microbatches is not None else n_stages
     b = x.shape[0]
     if b % m:
         raise ValueError(
             f"batch {b} not divisible into {m} microbatches"
         )
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+            stacked_params)[0]:
+        if leaf.shape[0] % n_stages:
+            raise ValueError(
+                f"layer axis of {jax.tree_util.keystr(path)} has "
+                f"{leaf.shape[0]} layers, not divisible into "
+                f"{n_stages} pipeline stages"
+            )
     x_micro = x.reshape(m, b // m, *x.shape[1:])
 
     # Layer axis (leading) sharded over pipe; everything else replicated.

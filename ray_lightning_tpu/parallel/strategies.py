@@ -373,7 +373,13 @@ class TpuStrategy:
             config = dataclasses.replace(
                 config,
                 restart_dir=restart_dir,
-                restart_every_n_epochs=self.restart_every_n_epochs,
+                # The trainer's explicit cadence wins; the strategy's
+                # only fills the unset default.
+                restart_every_n_epochs=(
+                    config.restart_every_n_epochs
+                    if config.restart_every_n_epochs is not None
+                    else self.restart_every_n_epochs
+                ),
             )
         attempt = 0
         try:

@@ -151,14 +151,22 @@ def load_sharded(dirpath: str) -> Dict[str, Any]:
         )
 
     leaves: List[Optional[np.ndarray]] = []
-    filled: List[int] = []
-    for path in shard_files:
+    covered: List[Optional[np.ndarray]] = []
+    for rank, path in enumerate(shard_files):
         with open(path, "rb") as f:
             payload = msgpack.unpackb(f.read(), raw=False)
+        # Guard against rank mixups / stale copies: the file must agree
+        # with its own name about who wrote it for which world size.
+        if payload.get("rank") != rank or payload.get("world") != world:
+            raise ValueError(
+                f"sharded checkpoint {dirpath}: {os.path.basename(path)} "
+                f"claims rank={payload.get('rank')} world="
+                f"{payload.get('world')} — rank mixup or stale copy"
+            )
         records = payload["leaves"]
         if not leaves:
             leaves = [None] * len(records)
-            filled = [0] * len(records)
+            covered = [None] * len(records)
         for i, rec in enumerate(records):
             if rec["s"] is None:
                 continue
@@ -166,6 +174,7 @@ def load_sharded(dirpath: str) -> Dict[str, Any]:
             dtype = _dtype_of(rec["d"])
             if leaves[i] is None:
                 leaves[i] = np.empty(shape, dtype)
+                covered[i] = np.zeros(shape, bool)
             for entry in rec["e"]:
                 idx = tuple(slice(a, b) for a, b in entry["i"])
                 block_shape = tuple(b - a for a, b in entry["i"])
@@ -174,21 +183,23 @@ def load_sharded(dirpath: str) -> Dict[str, Any]:
                 ).reshape(block_shape)
                 if idx:
                     leaves[i][idx] = block
+                    covered[i][idx] = True
                 else:  # 0-d leaf
                     leaves[i] = block.copy()
-                filled[i] += int(np.prod(block_shape)) if block_shape else 1
+                    covered[i] = np.ones((), bool)
 
-    # Coverage check: every element of every leaf must have been written
-    # by some shard — an uncovered region would be np.empty garbage
-    # silently resumed into the params.
-    for i, leaf in enumerate(leaves):
-        if leaf is None:
+    # Coverage check: every REGION of every leaf must have been written
+    # by some shard (a per-region mask, not an element count — duplicate
+    # writes of one region must not mask a hole elsewhere, which would be
+    # np.empty garbage silently resumed into the params).
+    for i, mask in enumerate(covered):
+        if mask is None or leaves[i] is None or leaves[i].size == 0:
             continue
-        expect = int(np.prod(leaf.shape)) if leaf.shape else 1
-        if expect and filled[i] < expect:
+        if not bool(np.all(mask)):
+            missing = int(mask.size - np.count_nonzero(mask))
             raise ValueError(
-                f"sharded checkpoint {dirpath}: leaf {i} covered "
-                f"{filled[i]}/{expect} elements — shard entries are "
+                f"sharded checkpoint {dirpath}: leaf {i} has {missing}/"
+                f"{mask.size} uncovered elements — shard entries are "
                 f"incomplete or corrupt"
             )
 

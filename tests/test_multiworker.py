@@ -79,3 +79,36 @@ def test_two_worker_predict_row_order(tmp_path):
     # reproduces the tiling exactly.
     expected = np.tile([0, 1, 1, 0], len(preds) // 4)
     assert (preds == expected).mean() > 0.9
+
+
+def test_zero3_restart_checkpoint_sharded_per_host(tmp_path):
+    """VERDICT r3 item #3 'Done' criterion: a ZeRO-3 multiworker restart
+    checkpoint never materializes the full state on one host — each of
+    the 2 processes writes only its addressable shards, and the set
+    reassembles to the full shapes."""
+    from ray_lightning_tpu.utils.sharded_ckpt import (
+        is_sharded_ckpt, load_sharded,
+    )
+
+    rs = tmp_path / "restarts"
+    trainer = get_trainer(
+        RayShardedStrategy(num_workers=2, zero_stage=3),
+        max_epochs=1, tmp_path=tmp_path, restart_dir=str(rs),
+    )
+    trainer.fit(
+        BoringModel(in_dim=256, out_dim=128),
+        BoringDataModule(length=64, batch_size=32, in_dim=256),
+    )
+    tags = [p for p in rs.iterdir() if p.name.endswith(".ckpt")]
+    assert len(tags) == 1 and is_sharded_ckpt(str(tags[0]))
+    shards = sorted(tags[0].glob("shard-*"))
+    assert len(shards) == 2  # one file per process, not one gathered blob
+    sizes = [s.stat().st_size for s in shards]
+    # ZeRO-3: each host holds ~half the (w, m, v) state; neither file
+    # may contain the whole thing.
+    assert max(sizes) < 0.75 * sum(sizes), sizes
+    payload = load_sharded(str(tags[0]))
+    state = payload["state"]
+    assert np.asarray(
+        jax.tree_util.tree_leaves(state.params)[0]
+    ).shape in ((256, 128), (128,))

@@ -103,8 +103,9 @@ def test_pipeline_gpt_blocks():
     """The flagship model's stacked block tree pipelines as-is: run the
     GPT-tiny transformer trunk (dense blocks, XLA attention) through a
     4-stage pipeline and match the plain scan forward."""
-    from ray_lightning_tpu.models.gpt import GPT, GPTConfig, _layer_norm
-    from ray_lightning_tpu.ops import causal_attention
+    from ray_lightning_tpu.models.gpt import (
+        GPT, GPTConfig, make_block_stage,
+    )
 
     cfg = GPTConfig(vocab_size=128, n_layer=4, n_head=4, d_model=64,
                     seq_len=32, warmup_steps=1)
@@ -113,24 +114,7 @@ def test_pipeline_gpt_blocks():
     tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, 128)
     x0 = (params["wte"][tokens] + params["wpe"][:32]).astype(jnp.float32)
 
-    def block_stage(blocks, x):
-        b, t = x.shape[0], x.shape[1]
-
-        def body(x, p):
-            h = _layer_norm(x, p["ln1_g"], p["ln1_b"])
-            qkv = h @ p["qkv_w"] + p["qkv_b"]
-            q, k, v = jnp.split(qkv, 3, axis=-1)
-            att = causal_attention(
-                *(z.reshape(b, t, cfg.n_head, cfg.head_dim)
-                  for z in (q, k, v)), impl="xla",
-            ).reshape(b, t, cfg.d_model)
-            x = x + att @ p["proj_w"] + p["proj_b"]
-            h = _layer_norm(x, p["ln2_g"], p["ln2_b"])
-            h = jax.nn.gelu(h @ p["mlp_in_w"] + p["mlp_in_b"])
-            return x + h @ p["mlp_out_w"] + p["mlp_out_b"], None
-
-        x, _ = jax.lax.scan(body, x, blocks)
-        return x
+    block_stage = make_block_stage(cfg)
 
     ref = block_stage(params["blocks"], x0)
     mesh = Mesh(np.asarray(jax.devices()[:4]), ("pipe",))
