@@ -194,9 +194,10 @@ def main() -> None:
         )
         batch_size = 16
     else:
-        # CPU fallback so the harness always produces a line.
+        # CPU fallback so the harness always produces a line (batch must
+        # split over however many virtual devices the host exposes).
         cfg = GPTConfig.tiny()
-        batch_size = 4
+        batch_size = max(4, 2 * jax.local_device_count())
 
     def make_module():
         m = GPT(cfg, attn_impl="auto", remat=on_tpu)

@@ -103,6 +103,9 @@ class Trainer:
             strategy = next(
                 (p for p in plugins if isinstance(p, TpuStrategy)), None
             )
+        if (restart_every_n_epochs is not None
+                and restart_every_n_epochs < 1):
+            raise ValueError("restart_every_n_epochs must be >= 1")
         self.strategy = strategy or LocalStrategy()
         self.callbacks: List[Callback] = list(callbacks or [])
         if enable_checkpointing and not any(

@@ -84,6 +84,10 @@ def ring_causal_attention(
     :func:`zigzag_indices`); masking is driven purely by global positions,
     so the fold logic is layout-agnostic.
     """
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(
+            f"layout={layout!r}: expected 'contiguous' or 'zigzag'"
+        )
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     b, s_loc, h, d = q.shape
@@ -185,6 +189,10 @@ def ring_attention_sharded(
 
     from ray_lightning_tpu.parallel import sharding as shardlib
 
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(
+            f"layout={layout!r}: expected 'contiguous' or 'zigzag'"
+        )
     if data_axis == "auto":
         batch_axes = shardlib.data_axes(mesh) or None
     elif data_axis in mesh.axis_names:

@@ -364,23 +364,20 @@ class TpuStrategy:
         """
         assert self._backend is not None, "setup() must run first"
         elastic = self.max_restarts > 0 and kind == "fit"
+        if elastic and config.restart_every_n_epochs is None:
+            # The strategy's cadence fills the unset default wherever the
+            # checkpoints land (caller-provided restart_dir included); an
+            # explicit Trainer cadence always wins.
+            config = dataclasses.replace(
+                config, restart_every_n_epochs=self.restart_every_n_epochs
+            )
         restart_dir = None
         if elastic and config.restart_dir is None:
             restart_dir = os.path.join(
                 config.default_root_dir,
                 f".rlt-restart-{uuid.uuid4().hex[:8]}",
             )
-            config = dataclasses.replace(
-                config,
-                restart_dir=restart_dir,
-                # The trainer's explicit cadence wins; the strategy's
-                # only fills the unset default.
-                restart_every_n_epochs=(
-                    config.restart_every_n_epochs
-                    if config.restart_every_n_epochs is not None
-                    else self.restart_every_n_epochs
-                ),
-            )
+            config = dataclasses.replace(config, restart_dir=restart_dir)
         attempt = 0
         try:
             while True:
