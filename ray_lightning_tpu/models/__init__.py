@@ -1,9 +1,13 @@
 from .boring import BoringModel, BoringDataModule, XORModel, XORDataModule
+from .generate import decode_step, generate, init_kv_cache
 from .gpt import GPT, GPTConfig, SyntheticLMDataModule
 from .mnist import MNISTClassifier, MNISTDataModule
 from .resnet import ResNet, CIFARDataModule
 
 __all__ = [
+    "decode_step",
+    "generate",
+    "init_kv_cache",
     "BoringModel",
     "BoringDataModule",
     "XORModel",

@@ -1,0 +1,166 @@
+"""Autoregressive decoding for the GPT family: KV cache + sampling.
+
+The reference's inference story ends at ``predict_step`` (batch argmax);
+a usable LM needs a decode loop.  TPU-first shape discipline throughout:
+
+* **Static shapes**: the KV cache is allocated once at ``total_len`` and
+  written with ``lax.dynamic_update_slice`` — no growing arrays, so the
+  whole generation is ONE ``lax.scan`` under ``jit`` (no per-token
+  retrace, no host round-trips).
+* **Stacked layers**: the cache carries a leading ``n_layer`` axis, and
+  the per-token block pass is a ``lax.scan`` over (block params, cache
+  layer) pairs — same compile-once-per-depth property as the training
+  trunk.
+* **Prompt prefill runs through the same decode step** (teacher-forced
+  token feed), which keeps the code single-path.  Decode keeps the
+  softmax·V product in f32, so it matches the training forward exactly
+  in f32; under bf16 kernels the two paths can differ at near-tie
+  logits (decode is the higher-precision one).  A fused full-sequence
+  prefill is the obvious optimization when prompt throughput matters.
+
+Dense blocks only (MoE decode needs single-token routing — refused
+loudly rather than silently mis-batched).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_lightning_tpu.models.gpt import (
+    GPT, GPTConfig, _layer_norm, _mlp_residual,
+)
+from ray_lightning_tpu.ops.attention import _NEG_INF
+
+__all__ = ["init_kv_cache", "decode_step", "generate"]
+
+
+def init_kv_cache(
+    cfg: GPTConfig, batch: int, total_len: int, dtype=jnp.float32
+) -> Dict[str, jax.Array]:
+    """(L, B, total_len, H, Dh) zero-filled key/value buffers."""
+    shape = (cfg.n_layer, batch, total_len, cfg.n_head, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def decode_step(
+    cfg: GPTConfig,
+    params: Dict[str, Any],
+    cache: Dict[str, jax.Array],
+    tokens: jax.Array,
+    pos: jax.Array,
+    compute_dtype=jnp.float32,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One token per sequence: ``tokens (B,) at position pos`` →
+    ``(logits (B, V) f32, updated cache)``."""
+    c = compute_dtype
+    B = tokens.shape[0]
+    x = (params["wte"][tokens] + params["wpe"][pos]).astype(c)  # (B, d)
+    total_len = cache["k"].shape[2]
+    # Causal visibility for this token: cache slots [0, pos].
+    visible = jnp.arange(total_len) <= pos  # (S,)
+
+    def block(carry, layer):
+        x, = carry
+        p, k_l, v_l = layer
+        h = _layer_norm(x, p["ln1_g"], p["ln1_b"])
+        qkv = h @ p["qkv_w"].astype(c) + p["qkv_b"].astype(c)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(z):
+            return z.reshape(B, cfg.n_head, cfg.head_dim)
+
+        # Write this token's k/v into the cache slot.
+        k_l = jax.lax.dynamic_update_slice(
+            k_l, heads(k)[:, None].astype(k_l.dtype), (0, pos, 0, 0)
+        )
+        v_l = jax.lax.dynamic_update_slice(
+            v_l, heads(v)[:, None].astype(v_l.dtype), (0, pos, 0, 0)
+        )
+        scale = cfg.head_dim ** -0.5
+        scores = jnp.einsum(
+            "bhd,bshd->bhs", heads(q).astype(jnp.float32),
+            k_l.astype(jnp.float32),
+        ) * scale
+        scores = jnp.where(visible[None, None, :], scores, _NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        att = jnp.einsum(
+            "bhs,bshd->bhd", probs, v_l.astype(jnp.float32)
+        ).reshape(B, cfg.d_model).astype(c)
+        x = x + att @ p["proj_w"].astype(c) + p["proj_b"].astype(c)
+        x = _mlp_residual(x, p, c)
+        return (x,), (k_l, v_l)
+
+    (x,), (k_new, v_new) = jax.lax.scan(
+        block, (x,), (params["blocks"], cache["k"], cache["v"])
+    )
+    x = _layer_norm(x, params["ln_f_g"], params["ln_f_b"])
+    logits = jnp.einsum(
+        "bd,vd->bv", x, params["wte"].astype(c),
+        preferred_element_type=jnp.float32,
+    )
+    return logits, {"k": k_new, "v": v_new}
+
+
+def generate(
+    module: GPT,
+    params: Dict[str, Any],
+    prompt: jax.Array,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Greedy (``temperature=0``) or temperature sampling.
+
+    Args:
+        prompt: ``(B, T0)`` int32, ``T0 >= 1``.
+    Returns:
+        ``(B, T0 + max_new_tokens)`` int32 — prompt followed by the
+        generated continuation.
+    """
+    cfg = module.config
+    if cfg.n_experts > 0:
+        raise NotImplementedError(
+            "generate() covers dense GPT blocks; MoE decode needs "
+            "single-token routing"
+        )
+    B, t0 = prompt.shape
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    total = t0 + max_new_tokens
+    if total > cfg.seq_len:
+        raise ValueError(
+            f"prompt ({t0}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"the positional table ({cfg.seq_len})"
+        )
+    c = module._compute_dtype()
+    # Accept host pytrees (e.g. ``trainer.params``) as well as device
+    # arrays: numpy leaves cannot be gather-indexed by traced tokens.
+    params = jax.tree.map(jnp.asarray, params)
+    prompt = jnp.asarray(prompt)
+    cache = init_kv_cache(cfg, B, total, dtype=c)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    def step(carry, t):
+        cache, cur, rng = carry
+        logits, cache = decode_step(
+            cfg, params, cache, cur, t, compute_dtype=c
+        )
+        rng, sub = jax.random.split(rng)
+        if temperature > 0.0:
+            sampled = jax.random.categorical(sub, logits / temperature)
+        else:
+            sampled = jnp.argmax(logits, axis=-1)
+        # Teacher-force the prompt region; sample past it.
+        forced = prompt[:, jnp.minimum(t + 1, t0 - 1)]
+        nxt = jnp.where(t + 1 < t0, forced, sampled).astype(jnp.int32)
+        return (cache, nxt, rng), nxt
+
+    (_, _, _), out = jax.lax.scan(
+        step, (cache, prompt[:, 0], rng), jnp.arange(total - 1)
+    )
+    # out[t] is the token at position t+1.
+    return jnp.concatenate([prompt[:, :1], out.T], axis=1)

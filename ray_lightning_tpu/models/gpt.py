@@ -86,6 +86,16 @@ def _layer_norm(x: jax.Array, g: jax.Array, b: jax.Array) -> jax.Array:
     return (y * g + b).astype(x.dtype)
 
 
+def _mlp_residual(x: jax.Array, p: Dict[str, Any], c) -> jax.Array:
+    """LN2 + GELU MLP + residual — the dense second half of a GPT block.
+    Shape-agnostic over leading dims; shared by the training scan, the
+    pipeline stage, and single-token decode so the block math has one
+    source."""
+    h = _layer_norm(x, p["ln2_g"], p["ln2_b"])
+    h = jax.nn.gelu(h @ p["mlp_in_w"].astype(c) + p["mlp_in_b"].astype(c))
+    return x + h @ p["mlp_out_w"].astype(c) + p["mlp_out_b"].astype(c)
+
+
 class GPT(TpuModule):
     """Decoder-only LM.  Batch contract: ``{"tokens": int32 (B, T+1)}``
     — inputs are ``tokens[:, :-1]``, targets ``tokens[:, 1:]``."""
@@ -322,10 +332,10 @@ class GPT(TpuModule):
             att = self._attention(heads(q), heads(k), heads(v))
             att = att.reshape(B, T, cfg.d_model)
             x = x + att @ p["proj_w"].astype(c) + p["proj_b"].astype(c)
-            h = _layer_norm(x, p["ln2_g"], p["ln2_b"])
             if cfg.n_experts > 0:
                 from ray_lightning_tpu.ops.moe import moe_mlp
 
+                h = _layer_norm(x, p["ln2_g"], p["ln2_b"])
                 y, layer_aux = moe_mlp(
                     h, p["gate_w"], p["moe_in_w"], p["moe_in_b"],
                     p["moe_out_w"], p["moe_out_b"],
@@ -336,9 +346,7 @@ class GPT(TpuModule):
                 x = x + y
                 aux = aux + layer_aux
             else:
-                h = jax.nn.gelu(h @ p["mlp_in_w"].astype(c)
-                                + p["mlp_in_b"].astype(c))
-                x = x + h @ p["mlp_out_w"].astype(c) + p["mlp_out_b"].astype(c)
+                x = _mlp_residual(x, p, c)
             return (self._constrain_residual(x), aux), None
 
         if self.remat:
@@ -443,12 +451,7 @@ def make_block_stage(cfg: GPTConfig, compute_dtype=jnp.float32):
                   for z in (q, k, v)), impl="xla",
             ).reshape(b, t, cfg.d_model)
             x = x + att @ p["proj_w"].astype(c) + p["proj_b"].astype(c)
-            h = _layer_norm(x, p["ln2_g"], p["ln2_b"])
-            h = jax.nn.gelu(h @ p["mlp_in_w"].astype(c)
-                            + p["mlp_in_b"].astype(c))
-            return x + h @ p["mlp_out_w"].astype(c) + (
-                p["mlp_out_b"].astype(c)
-            ), None
+            return _mlp_residual(x, p, c), None
 
         x, _ = jax.lax.scan(body, x, blocks)
         return x
