@@ -1,5 +1,5 @@
 from .boring import BoringModel, BoringDataModule, XORModel, XORDataModule
-from .generate import decode_step, generate, init_kv_cache
+from .generate import decode_step, generate, init_kv_cache, prefill
 from .gpt import GPT, GPTConfig, SyntheticLMDataModule
 from .mnist import MNISTClassifier, MNISTDataModule
 from .resnet import ResNet, CIFARDataModule
@@ -8,6 +8,7 @@ __all__ = [
     "decode_step",
     "generate",
     "init_kv_cache",
+    "prefill",
     "BoringModel",
     "BoringDataModule",
     "XORModel",

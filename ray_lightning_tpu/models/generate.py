@@ -11,12 +11,14 @@ a usable LM needs a decode loop.  TPU-first shape discipline throughout:
   the per-token block pass is a ``lax.scan`` over (block params, cache
   layer) pairs — same compile-once-per-depth property as the training
   trunk.
-* **Prompt prefill runs through the same decode step** (teacher-forced
-  token feed), which keeps the code single-path.  Decode keeps the
-  softmax·V product in f32, so it matches the training forward exactly
-  in f32; under bf16 kernels the two paths can differ at near-tie
-  logits (decode is the higher-precision one).  A fused full-sequence
-  prefill is the obvious optimization when prompt throughput matters.
+* **Fused prefill**: the prompt runs through ONE full-sequence causal
+  pass (:func:`prefill`) that writes every prompt slot of the cache in
+  a single MXU-friendly batch — the decode scan then covers only the
+  new tokens.  Both paths keep the softmax·V product in f32, so they
+  match the training forward exactly in f32; under bf16 kernels they
+  can differ at near-tie logits (inference is the higher-precision one).
+* **Sampling**: greedy, temperature, top-k and nucleus (top-p) — all
+  shape-static so the whole generation stays inside one jit.
 
 Dense blocks only (MoE decode needs single-token routing — refused
 loudly rather than silently mis-batched).
@@ -34,7 +36,7 @@ from ray_lightning_tpu.models.gpt import (
 )
 from ray_lightning_tpu.ops.attention import _NEG_INF
 
-__all__ = ["init_kv_cache", "decode_step", "generate"]
+__all__ = ["init_kv_cache", "prefill", "decode_step", "generate"]
 
 
 def init_kv_cache(
@@ -43,6 +45,96 @@ def init_kv_cache(
     """(L, B, total_len, H, Dh) zero-filled key/value buffers."""
     shape = (cfg.n_layer, batch, total_len, cfg.n_head, cfg.head_dim)
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _block_pass(
+    cfg: GPTConfig,
+    p: Dict[str, Any],
+    x: jax.Array,
+    k_l: jax.Array,
+    v_l: jax.Array,
+    off,
+    c,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One GPT block over ``x (B, T, d)`` against a KV cache layer.
+
+    Writes this chunk's k/v into cache slots ``[off, off + T)`` and
+    attends each query ``t`` over cache slots ``<= off + t`` (unwritten
+    slots are masked, so their zero-fill never contributes).  The SAME
+    code path serves full-prompt prefill (``T = T0, off = 0``) and
+    single-token decode (``T = 1, off = pos``) — block math has one
+    source, and numerics (f32 scores/softmax/PV) are identical by
+    construction.
+    """
+    B, T = x.shape[0], x.shape[1]
+    h = _layer_norm(x, p["ln1_g"], p["ln1_b"])
+    qkv = h @ p["qkv_w"].astype(c) + p["qkv_b"].astype(c)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(z):
+        return z.reshape(B, T, cfg.n_head, cfg.head_dim)
+
+    k_l = jax.lax.dynamic_update_slice(
+        k_l, heads(k).astype(k_l.dtype), (0, off, 0, 0)
+    )
+    v_l = jax.lax.dynamic_update_slice(
+        v_l, heads(v).astype(v_l.dtype), (0, off, 0, 0)
+    )
+    S = k_l.shape[1]
+    scale = cfg.head_dim ** -0.5
+    scores = jnp.einsum(
+        "bqhd,bshd->bhqs", heads(q).astype(jnp.float32),
+        k_l.astype(jnp.float32),
+    ) * scale
+    visible = jnp.arange(S)[None, :] <= (off + jnp.arange(T))[:, None]
+    scores = jnp.where(visible[None, None], scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    att = jnp.einsum(
+        "bhqs,bshd->bqhd", probs, v_l.astype(jnp.float32)
+    ).reshape(B, T, cfg.d_model).astype(c)
+    x = x + att @ p["proj_w"].astype(c) + p["proj_b"].astype(c)
+    return _mlp_residual(x, p, c), k_l, v_l
+
+
+def _trunk_pass(cfg, params, cache, x, off, c):
+    """Scan :func:`_block_pass` over the stacked layers; return the
+    final LN'd last-position hidden and the updated cache."""
+
+    def block(carry, layer):
+        x, = carry
+        p, k_l, v_l = layer
+        x, k_l, v_l = _block_pass(cfg, p, x, k_l, v_l, off, c)
+        return (x,), (k_l, v_l)
+
+    (x,), (k_new, v_new) = jax.lax.scan(
+        block, (x,), (params["blocks"], cache["k"], cache["v"])
+    )
+    x = _layer_norm(x[:, -1], params["ln_f_g"], params["ln_f_b"])
+    logits = jnp.einsum(
+        "bd,vd->bv", x, params["wte"].astype(c),
+        preferred_element_type=jnp.float32,
+    )
+    return logits, {"k": k_new, "v": v_new}
+
+
+def prefill(
+    cfg: GPTConfig,
+    params: Dict[str, Any],
+    cache: Dict[str, jax.Array],
+    tokens: jax.Array,
+    compute_dtype=jnp.float32,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Full-sequence prompt pass: ``tokens (B, T0)`` → ``(last-position
+    logits (B, V) f32, cache with slots [0, T0) filled)``.
+
+    One causal-attention batch over the whole prompt instead of ``T0``
+    sequential single-token steps — the matmuls stay large for the MXU
+    and the cache is written once per layer.
+    """
+    c = compute_dtype
+    T = tokens.shape[1]
+    x = (params["wte"][tokens] + params["wpe"][:T]).astype(c)
+    return _trunk_pass(cfg, params, cache, x, 0, c)
 
 
 def decode_step(
@@ -56,52 +148,42 @@ def decode_step(
     """One token per sequence: ``tokens (B,) at position pos`` →
     ``(logits (B, V) f32, updated cache)``."""
     c = compute_dtype
-    B = tokens.shape[0]
-    x = (params["wte"][tokens] + params["wpe"][pos]).astype(c)  # (B, d)
-    total_len = cache["k"].shape[2]
-    # Causal visibility for this token: cache slots [0, pos].
-    visible = jnp.arange(total_len) <= pos  # (S,)
+    x = (params["wte"][tokens] + params["wpe"][pos]).astype(c)[:, None]
+    return _trunk_pass(cfg, params, cache, x, pos, c)
 
-    def block(carry, layer):
-        x, = carry
-        p, k_l, v_l = layer
-        h = _layer_norm(x, p["ln1_g"], p["ln1_b"])
-        qkv = h @ p["qkv_w"].astype(c) + p["qkv_b"].astype(c)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
 
-        def heads(z):
-            return z.reshape(B, cfg.n_head, cfg.head_dim)
+def _sample(
+    logits: jax.Array,
+    rng: jax.Array,
+    temperature: float,
+    top_k: Optional[int],
+    top_p: Optional[float],
+) -> jax.Array:
+    """One sampling decision per row of ``logits (B, V)`` → ``(B,)``.
 
-        # Write this token's k/v into the cache slot.
-        k_l = jax.lax.dynamic_update_slice(
-            k_l, heads(k)[:, None].astype(k_l.dtype), (0, pos, 0, 0)
-        )
-        v_l = jax.lax.dynamic_update_slice(
-            v_l, heads(v)[:, None].astype(v_l.dtype), (0, pos, 0, 0)
-        )
-        scale = cfg.head_dim ** -0.5
-        scores = jnp.einsum(
-            "bhd,bshd->bhs", heads(q).astype(jnp.float32),
-            k_l.astype(jnp.float32),
-        ) * scale
-        scores = jnp.where(visible[None, None, :], scores, _NEG_INF)
-        probs = jax.nn.softmax(scores, axis=-1)
-        att = jnp.einsum(
-            "bhs,bshd->bhd", probs, v_l.astype(jnp.float32)
-        ).reshape(B, cfg.d_model).astype(c)
-        x = x + att @ p["proj_w"].astype(c) + p["proj_b"].astype(c)
-        x = _mlp_residual(x, p, c)
-        return (x,), (k_l, v_l)
-
-    (x,), (k_new, v_new) = jax.lax.scan(
-        block, (x,), (params["blocks"], cache["k"], cache["v"])
-    )
-    x = _layer_norm(x, params["ln_f_g"], params["ln_f_b"])
-    logits = jnp.einsum(
-        "bd,vd->bv", x, params["wte"].astype(c),
-        preferred_element_type=jnp.float32,
-    )
-    return logits, {"k": k_new, "v": v_new}
+    All filtering is shape-static (mask to ``_NEG_INF``, never shrink the
+    vocab axis) so the caller's scan stays a single compiled program.
+    """
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jax.lax.top_k(
+            logits, min(top_k, logits.shape[-1])
+        )[0][..., -1:]
+        logits = jnp.where(logits < kth, _NEG_INF, logits)
+    if top_p is not None:
+        sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep tokens whose EXCLUSIVE cumulative mass is < top_p: the
+        # nucleus always includes the top token and stops once the kept
+        # mass first reaches top_p.
+        keep = (cum - probs) < top_p
+        num_keep = keep.sum(axis=-1, keepdims=True)
+        thresh = jnp.take_along_axis(sorted_desc, num_keep - 1, axis=-1)
+        logits = jnp.where(logits < thresh, _NEG_INF, logits)
+    return jax.random.categorical(rng, logits)
 
 
 def generate(
@@ -110,12 +192,20 @@ def generate(
     prompt: jax.Array,
     max_new_tokens: int,
     temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     rng: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Greedy (``temperature=0``) or temperature sampling.
+    """Greedy (``temperature=0``), temperature, top-k and/or top-p
+    (nucleus) sampling.  Prompt slots fill via one fused :func:`prefill`
+    pass; the decode scan covers only the new tokens.
 
     Args:
         prompt: ``(B, T0)`` int32, ``T0 >= 1``.
+        top_k: keep only the k highest-probability tokens (``>= 1``).
+        top_p: keep the smallest set of tokens whose probability mass
+            reaches ``top_p`` (``0 < top_p <= 1``).  Composes with
+            ``top_k`` (k-filter first, as in the usual HF semantics).
     Returns:
         ``(B, T0 + max_new_tokens)`` int32 — prompt followed by the
         generated continuation.
@@ -129,20 +219,36 @@ def generate(
     B, t0 = prompt.shape
     if max_new_tokens < 0:
         raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if (top_k is not None or top_p is not None) and temperature <= 0.0:
+        raise ValueError(
+            "top_k/top_p require temperature > 0 (temperature=0 is "
+            "greedy decoding, which would silently ignore them)"
+        )
     total = t0 + max_new_tokens
     if total > cfg.seq_len:
         raise ValueError(
             f"prompt ({t0}) + max_new_tokens ({max_new_tokens}) exceeds "
             f"the positional table ({cfg.seq_len})"
         )
-    c = module._compute_dtype()
     # Accept host pytrees (e.g. ``trainer.params``) as well as device
     # arrays: numpy leaves cannot be gather-indexed by traced tokens.
     params = jax.tree.map(jnp.asarray, params)
-    prompt = jnp.asarray(prompt)
+    prompt = jnp.asarray(prompt).astype(jnp.int32)
+    if max_new_tokens == 0:
+        return prompt
+    c = module._compute_dtype()
     cache = init_kv_cache(cfg, B, total, dtype=c)
     if rng is None:
         rng = jax.random.PRNGKey(0)
+
+    logits, cache = prefill(cfg, params, cache, prompt, compute_dtype=c)
+    rng, sub = jax.random.split(rng)
+    first = _sample(logits, sub, temperature, top_k, top_p)
+    first = first.astype(jnp.int32)
 
     def step(carry, t):
         cache, cur, rng = carry
@@ -150,17 +256,11 @@ def generate(
             cfg, params, cache, cur, t, compute_dtype=c
         )
         rng, sub = jax.random.split(rng)
-        if temperature > 0.0:
-            sampled = jax.random.categorical(sub, logits / temperature)
-        else:
-            sampled = jnp.argmax(logits, axis=-1)
-        # Teacher-force the prompt region; sample past it.
-        forced = prompt[:, jnp.minimum(t + 1, t0 - 1)]
-        nxt = jnp.where(t + 1 < t0, forced, sampled).astype(jnp.int32)
-        return (cache, nxt, rng), nxt
+        nxt = _sample(logits, sub, temperature, top_k, top_p)
+        return (cache, nxt.astype(jnp.int32), rng), nxt.astype(jnp.int32)
 
-    (_, _, _), out = jax.lax.scan(
-        step, (cache, prompt[:, 0], rng), jnp.arange(total - 1)
+    # Positions t0 .. total-2 emit tokens t0+1 .. total-1.
+    (_, _, _), rest = jax.lax.scan(
+        step, (cache, first, rng), jnp.arange(t0, total - 1)
     )
-    # out[t] is the token at position t+1.
-    return jnp.concatenate([prompt[:, :1], out.T], axis=1)
+    return jnp.concatenate([prompt, first[:, None], rest.T], axis=1)

@@ -12,7 +12,7 @@ import numpy as np
 import pytest
 
 from ray_lightning_tpu.models.generate import (
-    decode_step, generate, init_kv_cache,
+    _sample, decode_step, generate, init_kv_cache, prefill,
 )
 from ray_lightning_tpu.models.gpt import GPT, GPTConfig
 
@@ -44,6 +44,60 @@ def test_decode_logits_match_full_forward(model):
             np.asarray(step_logits), np.asarray(full[:, t]),
             rtol=1e-4, atol=1e-4,
         )
+
+
+def test_prefill_matches_sequential_decode(model):
+    """One fused prefill pass == feeding the prompt token-by-token:
+    identical last-position logits AND identical cache contents."""
+    m, params = model
+    cfg = m.config
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 6), 0,
+                                cfg.vocab_size)
+    fused_logits, fused_cache = prefill(
+        cfg, params, init_kv_cache(cfg, 2, 10), tokens
+    )
+    seq_cache = init_kv_cache(cfg, 2, 10)
+    for t in range(6):
+        seq_logits, seq_cache = decode_step(
+            cfg, params, seq_cache, tokens[:, t], jnp.int32(t)
+        )
+    np.testing.assert_allclose(np.asarray(fused_logits),
+                               np.asarray(seq_logits), rtol=1e-4, atol=1e-4)
+    for k in ("k", "v"):
+        np.testing.assert_allclose(
+            np.asarray(fused_cache[k]), np.asarray(seq_cache[k]),
+            rtol=1e-5, atol=1e-5,
+        )
+
+
+def test_topk_one_equals_greedy(model):
+    """top_k=1 sampling at any temperature is exactly greedy decoding."""
+    m, params = model
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (2, 3), 0,
+                                m.config.vocab_size)
+    greedy = generate(m, params, prompt, 5)
+    topk1 = generate(m, params, prompt, 5, temperature=1.3, top_k=1,
+                     rng=jax.random.PRNGKey(11))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(topk1))
+
+
+def test_top_p_nucleus_masks_tail():
+    """top-p keeps the smallest prefix of sorted probs reaching the mass
+    and never samples outside it; always keeps the argmax token."""
+    logits = jnp.log(jnp.asarray([[0.5, 0.3, 0.15, 0.05]]))
+    # nucleus at 0.6: exclusive-cumsum {0, .5, .8, .95} < 0.6 keeps the
+    # top two tokens.
+    draws = [
+        int(_sample(logits, jax.random.PRNGKey(i), 1.0, None, 0.6)[0])
+        for i in range(50)
+    ]
+    assert set(draws) <= {0, 1} and 0 in draws
+    # tiny top_p still keeps exactly the argmax
+    draws = [
+        int(_sample(logits, jax.random.PRNGKey(i), 1.0, None, 1e-6)[0])
+        for i in range(10)
+    ]
+    assert set(draws) == {0}
 
 
 def test_greedy_generation_matches_argmax_rollout(model):
@@ -97,6 +151,18 @@ def test_generate_refuses_overlong_and_moe(model):
         generate(m, params, prompt, 10)
     with pytest.raises(ValueError, match=">= 0"):
         generate(m, params, prompt, -1)
+    small = jnp.zeros((1, 2), jnp.int32)
+    with pytest.raises(ValueError, match="top_k"):
+        generate(m, params, small, 2, temperature=1.0, top_k=0)
+    with pytest.raises(ValueError, match="top_p"):
+        generate(m, params, small, 2, temperature=1.0, top_p=1.5)
+    with pytest.raises(ValueError, match="temperature > 0"):
+        generate(m, params, small, 2, top_k=5)
+    # Oversized top_k clamps to the vocab (HF behavior) instead of
+    # erroring from inside lax.top_k.
+    out = generate(m, params, small, 2, temperature=1.0,
+                   top_k=m.config.vocab_size + 7)
+    assert out.shape == (1, 4)
     moe = GPT(GPTConfig.tiny_moe())
     with pytest.raises(NotImplementedError, match="MoE"):
         generate(moe, moe.init_params(jax.random.PRNGKey(0)),
