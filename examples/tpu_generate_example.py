@@ -1,0 +1,69 @@
+"""Train-then-generate example: the full LM lifecycle in one script.
+
+The reference's inference story ends at ``predict_step``; this example
+shows the net-new TPU-native decode path — train a tiny GPT with
+:class:`RayStrategy`, pull the weights back to the driver, and run
+KV-cache autoregressive generation (greedy and nucleus sampling) from
+the trained parameters.
+
+Run (CPU mesh):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/tpu_generate_example.py --smoke-test
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+import numpy as np
+
+from ray_lightning_tpu import RayStrategy, Trainer
+from ray_lightning_tpu.models import (
+    GPT, GPTConfig, SyntheticLMDataModule, generate,
+)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-workers", type=int, default=1)
+    parser.add_argument("--max-epochs", type=int, default=2)
+    parser.add_argument("--max-new-tokens", type=int, default=16)
+    parser.add_argument("--smoke-test", action="store_true")
+    args = parser.parse_args()
+
+    if args.smoke_test:
+        args.max_epochs = 1
+        args.max_new_tokens = 8
+
+    cfg = GPTConfig(vocab_size=256, n_layer=2, n_head=4, d_model=64,
+                    seq_len=64, warmup_steps=4)
+    module = GPT(cfg, attn_impl="xla")
+    world = args.num_workers * len(jax.devices())
+    batch = max(16, world)
+    dm = SyntheticLMDataModule(cfg, batch_size=batch,
+                               num_batches=2 if args.smoke_test else 8)
+
+    trainer = Trainer(
+        strategy=RayStrategy(num_workers=args.num_workers),
+        max_epochs=args.max_epochs,
+        default_root_dir="rlt_logs/generate_example",
+    )
+    trainer.fit(module, dm)
+    print(f"train_loss = {trainer.callback_metrics['train_loss']:.4f}")
+
+    # trainer.params is a host pytree — generate() accepts it directly.
+    prompt = np.asarray([[1, 2, 3, 4], [9, 8, 7, 6]], np.int32)
+    greedy = generate(module, trainer.params, prompt,
+                      max_new_tokens=args.max_new_tokens)
+    sampled = generate(module, trainer.params, prompt,
+                       max_new_tokens=args.max_new_tokens,
+                       temperature=0.8, top_p=0.95,
+                       rng=jax.random.PRNGKey(0))
+    print("greedy :", np.asarray(greedy)[0].tolist())
+    print("sampled:", np.asarray(sampled)[0].tolist())
+    assert greedy.shape == (2, 4 + args.max_new_tokens)
+    print("OK")
+
+
+main()

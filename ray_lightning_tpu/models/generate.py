@@ -206,6 +206,9 @@ def generate(
         top_p: keep the smallest set of tokens whose probability mass
             reaches ``top_p`` (``0 < top_p <= 1``).  Composes with
             ``top_k`` (k-filter first, as in the usual HF semantics).
+        rng: sampling key.  Defaults to ``PRNGKey(0)`` — deterministic,
+            so repeated calls return the SAME sample; pass a fresh key
+            per call for diverse samples.
     Returns:
         ``(B, T0 + max_new_tokens)`` int32 — prompt followed by the
         generated continuation.
@@ -217,6 +220,8 @@ def generate(
             "single-token routing"
         )
     B, t0 = prompt.shape
+    if t0 < 1:
+        raise ValueError("prompt must contain at least one token")
     if max_new_tokens < 0:
         raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
     if top_k is not None and top_k < 1:

@@ -158,6 +158,8 @@ def test_generate_refuses_overlong_and_moe(model):
         generate(m, params, small, 2, temperature=1.0, top_p=1.5)
     with pytest.raises(ValueError, match="temperature > 0"):
         generate(m, params, small, 2, top_k=5)
+    with pytest.raises(ValueError, match="at least one token"):
+        generate(m, params, jnp.zeros((1, 0), jnp.int32), 2)
     # Oversized top_k clamps to the vocab (HF behavior) instead of
     # erroring from inside lax.top_k.
     out = generate(m, params, small, 2, temperature=1.0,
