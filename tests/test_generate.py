@@ -144,6 +144,37 @@ def test_generate_accepts_host_param_pytree(model):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+def test_generate_under_tp_mesh(model):
+    """The decode loop is GSPMD-cleanly shardable: jitted over a
+    (data, tensor) mesh with the module's Megatron param specs and a
+    batch-sharded prompt, generation runs and matches the unsharded
+    tokens (serving story for TP-sharded models)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ray_lightning_tpu.parallel.sharding import (
+        params_shardings_for_module,
+    )
+
+    m, params = model
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (4, 5), 0,
+                                m.config.vocab_size)
+    ref = generate(m, params, prompt, 6)
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4),
+                ("data", "tensor"))
+    sharded_params = jax.device_put(
+        params, params_shardings_for_module(m, params, mesh)
+    )
+    sharded_prompt = jax.device_put(
+        prompt, NamedSharding(mesh, P("data", None))
+    )
+    with mesh:
+        out = jax.jit(
+            lambda p, pr: generate(m, p, pr, max_new_tokens=6)
+        )(sharded_params, sharded_prompt)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
 def test_generate_refuses_overlong_and_moe(model):
     m, params = model
     prompt = jnp.zeros((1, 30), jnp.int32)
