@@ -19,6 +19,7 @@ from __future__ import annotations
 import queue as _pyqueue
 import socket
 import threading
+import uuid
 from typing import Any, Optional
 
 from . import rpc
@@ -37,8 +38,11 @@ class QueueHandle:
         self.port = port
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        self._client_id = uuid.uuid4().hex
+        self._seq = 0
 
-    # -- pickling: drop the live socket -------------------------------------
+    # -- pickling: drop the live socket; each unpickled copy is a fresh
+    # producer with its own dedup identity --------------------------------
     def __getstate__(self):
         return {"host": self.host, "port": self.port}
 
@@ -47,6 +51,8 @@ class QueueHandle:
         self.port = state["port"]
         self._sock = None
         self._lock = threading.Lock()
+        self._client_id = uuid.uuid4().hex
+        self._seq = 0
 
     def _connect(self) -> socket.socket:
         if self._sock is None:
@@ -56,16 +62,43 @@ class QueueHandle:
         return self._sock
 
     def put(self, item: Any) -> None:
-        """Ship ``item`` to the driver (reference ``session.py:61-63``)."""
-        payload = rpc.dumps(item)
+        """Ship ``item`` to the driver (reference ``session.py:61-63``).
+
+        Synchronous like ``ray.util.queue.Queue.put`` (an actor call): the
+        server acks only after the item is in the driver's queue, so once
+        ``put`` returns the item is visible to any subsequent drain.
+        Fire-and-forget would race :func:`util.process_results`'s final
+        drain — a worker future can resolve before its last in-flight
+        frame lands, silently dropping late metrics/thunks.
+
+        Exactly-once enqueue: every frame carries ``(client_id, seq)``;
+        the reconnect retry resends the *same* seq, and the server drops
+        replays it has already enqueued.  Without this, an ack lost after
+        the server committed the item would make the retry a duplicate —
+        fatal for thunk items (a ``tune.report``/checkpoint lambda would
+        execute twice driver-side).
+        """
         with self._lock:
+            # Burn the seq up front: if both attempts fail after the server
+            # already committed this frame (ack lost, then reconnect
+            # refused), the number must never be reused for a different
+            # item — the server would dedup-drop it while acking success.
+            self._seq += 1
+            payload = rpc.dumps((self._client_id, self._seq, item))
             try:
-                rpc.send_frame(self._connect(), payload)
+                self._put_once(payload)
             except (OSError, ConnectionError):
                 # One reconnect attempt — the driver may have restarted the
                 # accept loop between epochs.
                 self.close()
-                rpc.send_frame(self._connect(), payload)
+                self._put_once(payload)
+
+    def _put_once(self, payload: bytes) -> None:
+        sock = self._connect()
+        rpc.send_frame(sock, payload)
+        ack = sock.recv(1)
+        if ack != b"\x01":
+            raise ConnectionError("queue server closed before ack")
 
     def close(self) -> None:
         if self._sock is not None:
@@ -87,6 +120,12 @@ class DriverQueue:
         self._port = self._server.getsockname()[1]
         self._advertise_host = advertise_host or host
         self._closed = threading.Event()
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        # Per-producer high-water marks for replay dedup (one entry per
+        # worker process — bounded by world size).
+        self._seen: dict = {}
+        self._seen_lock = threading.Lock()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="rlt-queue-accept", daemon=True
         )
@@ -103,6 +142,8 @@ class DriverQueue:
                 conn, _ = self._server.accept()
             except OSError:
                 return  # listener closed
+            with self._conns_lock:
+                self._conns.add(conn)
             t = threading.Thread(
                 target=self._reader_loop, args=(conn,), daemon=True
             )
@@ -112,11 +153,27 @@ class DriverQueue:
         try:
             while not self._closed.is_set():
                 frame = rpc.recv_frame(conn)
-                self._items.put(rpc.loads(frame))
+                if self._closed.is_set():
+                    # Shutdown raced the recv: drop the frame unacked so
+                    # the producer's put raises instead of getting a
+                    # false-success ack into a queue nobody will drain.
+                    break
+                cid, seq, item = rpc.loads(frame)
+                with self._seen_lock:
+                    fresh = seq > self._seen.get(cid, 0)
+                    if fresh:
+                        self._seen[cid] = seq
+                if fresh:
+                    self._items.put(item)
+                # Ack whether fresh or a replay (a replay means the ack —
+                # not the item — was lost on the previous attempt).
+                conn.sendall(b"\x01")
         except (ConnectionError, OSError):
             pass
         finally:
             conn.close()
+            with self._conns_lock:
+                self._conns.discard(conn)
 
     # -- driver consumption (reference util.py:47-52) -----------------------
     def empty(self) -> bool:
@@ -134,3 +191,19 @@ class DriverQueue:
             self._server.close()
         except OSError:
             pass
+        # Close live reader connections too: a worker's next (acked) put
+        # must fail fast instead of feeding a queue nobody will drain.
+        # shutdown(SHUT_RDWR) first — close() alone does not wake a reader
+        # thread blocked in recv on the same file description, which could
+        # otherwise ack an item into the dead queue.
+        with self._conns_lock:
+            conns, self._conns = list(self._conns), set()
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass

@@ -136,6 +136,52 @@ class TestDriverQueue:
         assert q.get(timeout=10) == "x"
         q.shutdown()
 
+    def test_put_is_synchronous(self):
+        """Once put() returns the item must be visible to a drain — no
+        in-flight window (the process_results final-drain race)."""
+        q = DriverQueue()
+        h = q.handle
+        for i in range(50):
+            h.put(i)
+            assert not q.empty(), f"put({i}) returned before item landed"
+            assert q.get_nowait() == i
+        q.shutdown()
+
+    def test_replayed_frames_dedup(self):
+        """A retry that resends an already-enqueued seq (lost ack) must
+        not produce a duplicate item."""
+        from ray_lightning_tpu.cluster import rpc as _rpc
+
+        q = DriverQueue()
+        h = q.handle
+        h.put("first")
+        assert q.get(timeout=10) == "first"
+        # Forge the retry: resend seq=1 on a fresh connection, as the
+        # reconnect path does when the ack (not the item) was lost.
+        import socket as _s
+
+        with _s.create_connection((h.host, h.port), timeout=10) as sock:
+            replay = _rpc.dumps((h._client_id, 1, "first"))
+            _rpc.send_frame(sock, replay)
+            assert sock.recv(1) == b"\x01"  # replay is acked...
+            fresh = _rpc.dumps((h._client_id, 2, "second"))
+            _rpc.send_frame(sock, fresh)
+            assert sock.recv(1) == b"\x01"
+        assert q.get(timeout=10) == "second"  # ...but never re-enqueued
+        assert q.empty()
+        q.shutdown()
+
+    def test_put_after_shutdown_fails_fast(self):
+        """shutdown() must wake reader threads and refuse late puts —
+        not ack items into a queue nobody will drain."""
+        q = DriverQueue()
+        h = q.handle
+        h.put("warm")  # opens the persistent connection
+        q.shutdown()
+        time.sleep(0.1)
+        with pytest.raises((ConnectionError, OSError)):
+            h.put("late")
+
 
 class TestProcessResults:
     def test_pump_drains_queue_and_returns_results(self):
