@@ -95,9 +95,17 @@ class QueueHandle:
 
     def _put_once(self, payload: bytes) -> None:
         sock = self._connect()
-        rpc.send_frame(sock, payload)
-        ack = sock.recv(1)
+        try:
+            rpc.send_frame(sock, payload)
+            ack = sock.recv(1)
+        except Exception:
+            # The frame may be half-sent or its ack still in flight; the
+            # connection's ack stream can no longer be trusted (a later
+            # put would read THIS frame's late ack as its own).  Drop it.
+            self.close()
+            raise
         if ack != b"\x01":
+            self.close()
             raise ConnectionError("queue server closed before ack")
 
     def close(self) -> None:
@@ -158,7 +166,13 @@ class DriverQueue:
                     # the producer's put raises instead of getting a
                     # false-success ack into a queue nobody will drain.
                     break
-                cid, seq, item = rpc.loads(frame)
+                try:
+                    cid, seq, item = rpc.loads(frame)
+                except Exception:
+                    # Garbage / old-protocol frame (the queue binds
+                    # non-loopback in multi-host backends): drop the
+                    # connection, never the reader thread.
+                    break
                 with self._seen_lock:
                     fresh = seq > self._seen.get(cid, 0)
                     if fresh:

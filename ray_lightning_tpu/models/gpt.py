@@ -57,6 +57,11 @@ class GPTConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 1e-2
+    # AdamW first-moment storage dtype.  bf16 momentum halves that
+    # state's HBM read+write in the (bandwidth-bound) optimizer update
+    # with no measurable loss-curve effect at LM scale; the variance and
+    # params stay f32.  Set to "float32" for bit-conservative runs.
+    mu_dtype: str = "bfloat16"
 
     @classmethod
     def tiny(cls) -> "GPTConfig":
@@ -421,7 +426,8 @@ class GPT(TpuModule):
         tx = optax.chain(
             optax.clip_by_global_norm(1.0),
             optax.adamw(schedule, b1=0.9, b2=0.95,
-                        weight_decay=cfg.weight_decay),
+                        weight_decay=cfg.weight_decay,
+                        mu_dtype=jnp.dtype(cfg.mu_dtype)),
         )
         return tx
 

@@ -132,6 +132,41 @@ def test_state_shardings_follow_tp_specs():
         assert "fsdp" in str(s.spec)
 
 
+def test_adamw_momentum_stored_bf16():
+    """The default optimizer keeps the first moment in bf16 (HBM-bound
+    update reads/writes half the bytes for that state) while the second
+    moment stays f32; mu_dtype='float32' opts out."""
+    from dataclasses import replace
+
+    import optax
+
+    model = GPT(tiny())
+    params = model.init_params(jax.random.PRNGKey(0))
+    state = model.configure_optimizers().init(params)
+    adam = next(
+        s for s in jax.tree_util.tree_leaves(
+            state, is_leaf=lambda x: isinstance(x, optax.ScaleByAdamState)
+        ) if isinstance(s, optax.ScaleByAdamState)
+    )
+    assert all(
+        leaf.dtype == jnp.bfloat16 for leaf in jax.tree.leaves(adam.mu)
+    )
+    assert all(
+        leaf.dtype == jnp.float32 for leaf in jax.tree.leaves(adam.nu)
+    )
+
+    f32_model = GPT(replace(tiny(), mu_dtype="float32"))
+    f32_state = f32_model.configure_optimizers().init(params)
+    adam32 = next(
+        s for s in jax.tree_util.tree_leaves(
+            f32_state, is_leaf=lambda x: isinstance(x, optax.ScaleByAdamState)
+        ) if isinstance(s, optax.ScaleByAdamState)
+    )
+    assert all(
+        leaf.dtype == jnp.float32 for leaf in jax.tree.leaves(adam32.mu)
+    )
+
+
 def test_gpt_remat_matches_no_remat():
     """jax.checkpoint is numerically inert: remat only trades FLOPs for
     activation memory."""
