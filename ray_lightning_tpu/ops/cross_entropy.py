@@ -87,8 +87,14 @@ def _chunk_logits(x, wte_chunk, offset, vocab_size, compute_dtype):
     return jnp.where(valid, logits, _NEG_INF)
 
 
-_CE_BLOCK_T = 1024
-_CE_BLOCK_V = 1024
+# Tile sizes chosen for the ~16 MB/core VMEM budget with double-buffered
+# input blocks: at the d=1536 cap the worst kernel (dw, vocab-major
+# accumulator) holds ~10 MB.  Token counts that don't divide _CE_BLOCK_T
+# are zero-padded (a padded row's cotangent is zero, so it contributes
+# nothing backward); larger models fall back to the GSPMD-safe scan.
+_CE_BLOCK_T = 512
+_CE_BLOCK_V = 512
+_CE_MAX_D = 1536
 _LANE = 128
 
 
@@ -141,6 +147,48 @@ def _ce_fwd_kernel(x_ref, w_ref, t_ref, loss_ref, lse_ref, m_sc, s_sc, g_sc,
         loss_ref[...] = jnp.broadcast_to(lse - g_new, loss_ref.shape)
 
 
+def _flatten_pad(x, targets, compute_dtype, extras=()):
+    """Flatten (..., d) tokens and zero-pad to a _CE_BLOCK_T multiple.
+
+    Padded rows produce garbage forward values (their target of 0 DOES
+    match vocab position 0) — inertness comes from the caller slicing
+    outputs back to ``n`` rows, and, in the backward, from the cotangent
+    ``g`` being zero-padded here so padded rows contribute nothing to
+    dx/dwte.  Returns (x2, t2, n_valid, n_pad, padded_extras).
+    """
+    d = x.shape[-1]
+    x2 = x.reshape(-1, d).astype(compute_dtype)
+    t1 = targets.reshape(-1)
+    n = x2.shape[0]
+    n_pad = -(-n // _CE_BLOCK_T) * _CE_BLOCK_T
+    if n_pad != n:
+        x2 = jnp.concatenate(
+            [x2, jnp.zeros((n_pad - n, d), x2.dtype)], axis=0
+        )
+        t1 = jnp.concatenate(
+            [t1, jnp.zeros((n_pad - n,), t1.dtype)], axis=0
+        )
+    t2 = jnp.broadcast_to(t1[:, None], (n_pad, _LANE))
+    out = []
+    for extra in extras:
+        e1 = extra.reshape(-1).astype(jnp.float32)
+        if n_pad != n:
+            e1 = jnp.concatenate([e1, jnp.zeros((n_pad - n,), e1.dtype)])
+        out.append(jnp.broadcast_to(e1[:, None], (n_pad, _LANE)))
+    return x2, t2, n, n_pad, tuple(out)
+
+
+def _pad_vocab(wte, compute_dtype):
+    V, d = wte.shape
+    vpad = -(-V // _CE_BLOCK_V) * _CE_BLOCK_V
+    wp = wte.astype(compute_dtype)
+    if vpad != V:
+        wp = jnp.concatenate(
+            [wp, jnp.zeros((vpad - V, d), wp.dtype)], axis=0
+        )
+    return wp, vpad
+
+
 def _ce_fwd_pallas(x, wte, targets, compute_dtype):
     """Kernel-path forward over flattened tokens.  Returns (loss, lse),
     both f32 with ``targets``'s shape."""
@@ -149,19 +197,11 @@ def _ce_fwd_pallas(x, wte, targets, compute_dtype):
 
     shape = targets.shape
     d = x.shape[-1]
-    x2 = x.reshape(-1, d).astype(compute_dtype)
-    t1 = targets.reshape(-1)
-    n = x2.shape[0]
     V = wte.shape[0]
     bt = _CE_BLOCK_T
     bv = _CE_BLOCK_V
-    vpad = -(-V // bv) * bv
-    wp = wte.astype(compute_dtype)
-    if vpad != V:
-        wp = jnp.concatenate(
-            [wp, jnp.zeros((vpad - V, d), wp.dtype)], axis=0
-        )
-    t2 = jnp.broadcast_to(t1[:, None], (n, _LANE))
+    x2, t2, n, n_pad, _ = _flatten_pad(x, targets, compute_dtype)
+    wp, vpad = _pad_vocab(wte, compute_dtype)
     num_vb = vpad // bv
     kernel = partial(
         _ce_fwd_kernel, vocab_size=V, block_v=bv, num_vb=num_vb,
@@ -169,10 +209,10 @@ def _ce_fwd_pallas(x, wte, targets, compute_dtype):
     loss, lse = pl.pallas_call(
         kernel,
         out_shape=(
-            jax.ShapeDtypeStruct((n, _LANE), jnp.float32),
-            jax.ShapeDtypeStruct((n, _LANE), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, _LANE), jnp.float32),
+            jax.ShapeDtypeStruct((n_pad, _LANE), jnp.float32),
         ),
-        grid=(n // bt, num_vb),
+        grid=(n_pad // bt, num_vb),
         in_specs=[
             pl.BlockSpec((bt, d), lambda t, v: (t, 0)),
             pl.BlockSpec((bv, d), lambda t, v: (v, 0)),
@@ -189,17 +229,145 @@ def _ce_fwd_pallas(x, wte, targets, compute_dtype):
         ],
         interpret=jax.default_backend() != "tpu",
     )(x2, wp, t2)
-    return loss[:, 0].reshape(shape), lse[:, 0].reshape(shape)
+    return loss[:n, 0].reshape(shape), lse[:n, 0].reshape(shape)
 
 
-def _pallas_fwd_ok(x, wte, targets) -> bool:
-    """The kernel path needs lane-aligned flattened tokens; oddly-shaped
-    inputs (or explicit opt-out) use the scan path.  Both paths share the
-    scan backward, so the choice is invisible to callers."""
-    n = 1
-    for s in targets.shape:
-        n *= s
-    return n % _CE_BLOCK_T == 0 and x.shape[-1] % 128 == 0
+def _pallas_fwd_ok(x, wte, targets, compute_dtype) -> bool:
+    """The kernel path needs a lane-aligned, VMEM-sized feature dim;
+    other shapes use the scan path (ragged token counts are fine — they
+    are zero-padded).  The d cap is in compute-dtype BYTES: the VMEM
+    budget was sized for bf16 tiles, so f32 compute halves the allowed
+    feature dim rather than overflowing VMEM at lowering time."""
+    d = x.shape[-1]
+    max_d = _CE_MAX_D * 2 // jnp.dtype(compute_dtype).itemsize
+    return d % 128 == 0 and d <= max_d
+
+
+def _ce_logits_tile(x_ref, w_ref, vi, block_v, vocab_size):
+    """Shared tile recompute: (Tb, d) x (Vb, d)^T -> masked f32 logits."""
+    logits = jax.lax.dot_general(
+        x_ref[...], w_ref[...], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    tb, vb = logits.shape
+    vpos = vi * block_v + jax.lax.broadcasted_iota(jnp.int32, (tb, vb), 1)
+    return jnp.where(vpos < vocab_size, logits, _NEG_INF), vpos
+
+
+def _ce_dlogits(logits, vpos, t_ref, lse_ref, g_ref):
+    p = jnp.exp(logits - lse_ref[:, :1])
+    onehot = (vpos == t_ref[:, :1]).astype(jnp.float32)
+    return (p - onehot) * g_ref[:, :1]
+
+
+def _ce_bwd_dx_kernel(x_ref, w_ref, t_ref, lse_ref, g_ref, dx_ref, acc_sc,
+                      *, vocab_size, block_v, num_vb):
+    """dx tile: token-major grid, vocab innermost; dx accumulates in VMEM
+    across the vocab sweep.  The (Tb, Vb) dlogits tile never reaches HBM
+    (the scan backward round-trips every chunk's logits AND dlogits)."""
+    from jax.experimental import pallas as pl
+
+    vi = pl.program_id(1)
+
+    @pl.when(vi == 0)
+    def _init():
+        acc_sc[...] = jnp.zeros(acc_sc.shape, jnp.float32)
+
+    logits, vpos = _ce_logits_tile(x_ref, w_ref, vi, block_v, vocab_size)
+    dlog = _ce_dlogits(logits, vpos, t_ref, lse_ref, g_ref)
+    acc_sc[...] += jax.lax.dot_general(
+        dlog.astype(x_ref.dtype), w_ref[...], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(vi == num_vb - 1)
+    def _emit():
+        dx_ref[...] = acc_sc[...]
+
+
+def _ce_bwd_dw_kernel(x_ref, w_ref, t_ref, lse_ref, g_ref, dw_ref, acc_sc,
+                      *, vocab_size, block_v, num_tb):
+    """dwte tile: vocab-major grid, tokens innermost; the (Vb, d) row
+    gradient accumulates in VMEM across the token sweep."""
+    from jax.experimental import pallas as pl
+
+    vi = pl.program_id(0)
+    ti = pl.program_id(1)
+
+    @pl.when(ti == 0)
+    def _init():
+        acc_sc[...] = jnp.zeros(acc_sc.shape, jnp.float32)
+
+    logits, vpos = _ce_logits_tile(x_ref, w_ref, vi, block_v, vocab_size)
+    dlog = _ce_dlogits(logits, vpos, t_ref, lse_ref, g_ref)
+    acc_sc[...] += jax.lax.dot_general(
+        dlog.astype(x_ref.dtype), x_ref[...], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(ti == num_tb - 1)
+    def _emit():
+        dw_ref[...] = acc_sc[...]
+
+
+def _ce_bwd_pallas(x, wte, targets, lse, g, compute_dtype):
+    """Kernel-path backward: (dx, dwte) with zero HBM logits traffic.
+
+    Two passes re-deriving the dlogits tile in VMEM: token-major for dx
+    (contract over vocab), vocab-major for dwte (contract over tokens).
+    One extra logits matmul vs the scan backward — MXU FLOPs traded for
+    the HBM round-trips of every (N, Vc) chunk intermediate, the right
+    side of the bargain on a bandwidth-bound step.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    d = x.shape[-1]
+    V = wte.shape[0]
+    bt = _CE_BLOCK_T
+    bv = _CE_BLOCK_V
+    x2, t2, n, n_pad, (g2, lse2) = _flatten_pad(
+        x, targets, compute_dtype, extras=(g, lse)
+    )
+    wp, vpad = _pad_vocab(wte, compute_dtype)
+    num_vb = vpad // bv
+    num_tb = n_pad // bt
+    interp = jax.default_backend() != "tpu"
+
+    dx = pl.pallas_call(
+        partial(_ce_bwd_dx_kernel, vocab_size=V, block_v=bv, num_vb=num_vb),
+        out_shape=jax.ShapeDtypeStruct((n_pad, d), jnp.float32),
+        grid=(num_tb, num_vb),
+        in_specs=[
+            pl.BlockSpec((bt, d), lambda t, v: (t, 0)),
+            pl.BlockSpec((bv, d), lambda t, v: (v, 0)),
+            pl.BlockSpec((bt, _LANE), lambda t, v: (t, 0)),
+            pl.BlockSpec((bt, _LANE), lambda t, v: (t, 0)),
+            pl.BlockSpec((bt, _LANE), lambda t, v: (t, 0)),
+        ],
+        out_specs=pl.BlockSpec((bt, d), lambda t, v: (t, 0)),
+        scratch_shapes=[pltpu.VMEM((bt, d), jnp.float32)],
+        interpret=interp,
+    )(x2, wp, t2, lse2, g2)
+
+    dw = pl.pallas_call(
+        partial(_ce_bwd_dw_kernel, vocab_size=V, block_v=bv, num_tb=num_tb),
+        out_shape=jax.ShapeDtypeStruct((vpad, d), jnp.float32),
+        grid=(num_vb, num_tb),
+        in_specs=[
+            pl.BlockSpec((bt, d), lambda v, t: (t, 0)),
+            pl.BlockSpec((bv, d), lambda v, t: (v, 0)),
+            pl.BlockSpec((bt, _LANE), lambda v, t: (t, 0)),
+            pl.BlockSpec((bt, _LANE), lambda v, t: (t, 0)),
+            pl.BlockSpec((bt, _LANE), lambda v, t: (t, 0)),
+        ],
+        out_specs=pl.BlockSpec((bv, d), lambda v, t: (v, 0)),
+        scratch_shapes=[pltpu.VMEM((bv, d), jnp.float32)],
+        interpret=interp,
+    )(x2, wp, t2, lse2, g2)
+
+    dx = dx[:n].reshape(x.shape)
+    return dx, dw[:V]
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -270,6 +438,15 @@ def _match_vma(val: jax.Array, ref: jax.Array) -> jax.Array:
 def _fused_ce_bwd(num_chunks, compute_dtype, use_pallas, res, g):
     x, wte, targets, lse = res
     V, d = wte.shape
+    if use_pallas:
+        dx, dwte = _ce_bwd_pallas(
+            x, wte, targets, lse, g.astype(jnp.float32), compute_dtype
+        )
+        return (
+            _match_vma(dx.astype(x.dtype), x),
+            _match_vma(dwte.astype(wte.dtype), wte),
+            np.zeros(targets.shape, jax.dtypes.float0),
+        )
     wte_chunks, Vc = _chunk_wte(wte, num_chunks)
     g32 = g.astype(jnp.float32)
 
@@ -328,18 +505,20 @@ def fused_lm_head_cross_entropy(
         targets: int labels, shape ``x.shape[:-1]``.
         num_chunks: vocab chunks to scan over (default: ~8192-wide chunks).
         compute_dtype: matmul input dtype (f32 accumulation regardless).
-        use_pallas: run the FORWARD through the Pallas tile kernel (zero
-            HBM logits traffic).  Callers that know they are on one chip
-            (no GSPMD-sharded operands — a ``pallas_call`` is opaque to
-            the partitioner) opt in; default off.  The backward is the
-            chunk-recompute scan either way.
+        use_pallas: run forward AND backward through the Pallas tile
+            kernels (zero HBM logits traffic in both directions).
+            Callers that know they are on one chip (no GSPMD-sharded
+            operands — a ``pallas_call`` is opaque to the partitioner)
+            opt in; default off falls back to the GSPMD-safe scan.
 
     Returns:
         float32 per-token losses, shape ``targets.shape``.
     """
     if num_chunks is None:
         num_chunks = _pick_num_chunks(wte.shape[0])
-    pallas = bool(use_pallas) and _pallas_fwd_ok(x, wte, targets)
+    pallas = bool(use_pallas) and _pallas_fwd_ok(
+        x, wte, targets, compute_dtype
+    )
     return _fused_ce(
         x, wte, targets, num_chunks, jnp.dtype(compute_dtype), pallas
     )
