@@ -243,6 +243,58 @@ def _pallas_fwd_ok(x, wte, targets, compute_dtype) -> bool:
     return d % 128 == 0 and d <= max_d
 
 
+_KERNELS_AVAILABLE: dict = {}
+
+# Exception shapes that mean "this kernel will never compile here" (cache
+# the fallback) vs transient runtime failures (fall back this call only,
+# retry next time — e.g. RESOURCE_EXHAUSTED while the device is full).
+_COMPILE_ERROR_MARKERS = ("mosaic", "vmem", "lower", "invalid_argument")
+
+
+def _kernel_path_available(d: int, compute_dtype) -> bool:
+    """Per-(d, dtype) Mosaic probe: compile+run the fwd and both bwd
+    kernels at the caller's feature dim and compute dtype (tile VMEM
+    footprint depends on exactly these), falling back to the scan path
+    if the backend rejects them.  A training step must never die on a
+    kernel-compile error when a numerically identical fallback exists;
+    the probe turns "crash mid-fit on this TPU generation" into a
+    warning + slow path.  (Under the interpreter — CPU tests — the
+    kernels always work.)"""
+    if jax.default_backend() != "tpu":
+        return True
+    key = (d, jnp.dtype(compute_dtype).name)
+    cached = _KERNELS_AVAILABLE.get(key)
+    if cached is not None:
+        return cached
+    try:
+        x = jnp.ones((_CE_BLOCK_T, d), jnp.float32) * 0.01
+        w = jnp.ones((_CE_BLOCK_V, d), jnp.float32) * 0.01
+        t = jnp.zeros((_CE_BLOCK_T,), jnp.int32)
+
+        def probe_loss(x, w):
+            return _fused_ce(
+                x, w, t, 1, jnp.dtype(compute_dtype), True
+            ).mean()
+
+        jax.block_until_ready(jax.grad(probe_loss, argnums=(0, 1))(x, w))
+        _KERNELS_AVAILABLE[key] = True
+        return True
+    except Exception as e:
+        import warnings
+
+        msg = f"{type(e).__name__}: {e}"
+        permanent = isinstance(
+            e, (NotImplementedError, TypeError, ValueError)
+        ) or any(m in msg.lower() for m in _COMPILE_ERROR_MARKERS)
+        if permanent:
+            _KERNELS_AVAILABLE[key] = False
+        warnings.warn(
+            f"Pallas CE kernels unavailable for d={d} ({msg}); using the "
+            f"scan path{'' if permanent else ' for this call'}."
+        )
+        return False
+
+
 def _ce_logits_tile(x_ref, w_ref, vi, block_v, vocab_size):
     """Shared tile recompute: (Tb, d) x (Vb, d)^T -> masked f32 logits."""
     logits = jax.lax.dot_general(
@@ -516,8 +568,10 @@ def fused_lm_head_cross_entropy(
     """
     if num_chunks is None:
         num_chunks = _pick_num_chunks(wte.shape[0])
-    pallas = bool(use_pallas) and _pallas_fwd_ok(
-        x, wte, targets, compute_dtype
+    pallas = (
+        bool(use_pallas)
+        and _pallas_fwd_ok(x, wte, targets, compute_dtype)
+        and _kernel_path_available(x.shape[-1], compute_dtype)
     )
     return _fused_ce(
         x, wte, targets, num_chunks, jnp.dtype(compute_dtype), pallas

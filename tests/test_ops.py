@@ -262,6 +262,20 @@ class TestFusedCEPallas:
             err = float(jnp.abs(a - b).max())
             assert err < 1e-5, f"{name} max err {err}"
 
+    def test_kernel_probe_failure_falls_back(self, monkeypatch):
+        """If the one-time Mosaic probe marked the kernels unavailable,
+        use_pallas=True must silently take the scan path."""
+        import ray_lightning_tpu.ops.cross_entropy as ce
+
+        monkeypatch.setattr(ce, "_kernel_path_available",
+                            lambda d, dt: False)
+        x, wte, t = self._inputs()
+        fused = ce.fused_lm_head_cross_entropy(
+            x, wte, t, compute_dtype=jnp.float32, use_pallas=True)
+        naive = ce.naive_lm_head_cross_entropy(
+            x, wte, t, compute_dtype=jnp.float32)
+        assert float(jnp.abs(fused - naive).max()) < 1e-5
+
     def test_misaligned_d_falls_back_to_scan(self):
         """d=64 is not lane-aligned: use_pallas must silently take the
         scan path and still match."""
