@@ -35,6 +35,7 @@ MFU is reported in BOTH conventions (VERDICT r3 weak #5c):
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import jax
@@ -185,8 +186,21 @@ def _bench_fit(module: GPT, cfg: GPTConfig, batch_size: int):
     return _median_spread(tps)
 
 
+def _detect_backend() -> str:
+    """Resolve the backend, degrading to CPU if the TPU runtime is
+    unreachable (tunnel/service outage) — the harness must always get a
+    JSON line; a missing-bench round is indistinguishable from a broken
+    build."""
+    try:
+        return jax.default_backend()
+    except RuntimeError as e:
+        sys.stderr.write(f"TPU backend unavailable ({e}); CPU fallback\n")
+        jax.config.update("jax_platforms", "cpu")
+        return jax.default_backend()
+
+
 def main() -> None:
-    on_tpu = jax.default_backend() == "tpu"
+    on_tpu = _detect_backend() == "tpu"
     if on_tpu:
         cfg = GPTConfig(
             vocab_size=50304, n_layer=12, n_head=12, d_model=768,

@@ -51,7 +51,9 @@ def _work_imbalance(n: int, layout: str) -> float:
 
 
 def main() -> None:
-    on_tpu = jax.default_backend() == "tpu"
+    from bench import _detect_backend
+
+    on_tpu = _detect_backend() == "tpu"
     S, B, H, D = 4096, 4, 12, 64
     result = {
         "metric": "long_context_seq4096",
