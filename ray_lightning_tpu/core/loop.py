@@ -399,6 +399,7 @@ def run_fit(
 
     ctx = LoopContext(config, global_rank, world_size, mesh, queue, tx)
     ctx.step_mode = mode
+    ctx.zero_stage = zero_stage
     module.trainer = ctx
     module.precision = config.precision
 
@@ -752,6 +753,7 @@ def run_eval(
     stage = "validate" if kind == "validation" else "test"
     ctx = LoopContext(config, global_rank, world_size, mesh, queue)
     ctx.step_mode = mode
+    ctx.zero_stage = zero_stage
     module.trainer = ctx
     module.setup(stage)
     datamodule.set_shard(global_rank, world_size)

@@ -382,24 +382,55 @@ class GPT(TpuModule):
     def _loss(self, params, tokens):
         from ray_lightning_tpu.ops.cross_entropy import (
             fused_lm_head_cross_entropy,
+            fused_lm_head_cross_entropy_sharded,
         )
 
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
         x, aux = self.forward_hidden(params, inputs)
         # Fused tied-LM-head CE: the (B, T, V) logits tensor (3.3 GB f32
         # for GPT-2-small at B=16) is never materialized — the head
-        # matmul, logsumexp and label gather run per vocab chunk.  On an
-        # unsharded (single-chip) step the forward further drops to the
-        # Pallas tile kernel; under a multi-device mesh the GSPMD-safe
-        # scan path is kept (pallas_call is opaque to the partitioner).
-        mesh = getattr(getattr(self, "trainer", None), "mesh", None)
+        # matmul, logsumexp and label gather run per vocab chunk.
+        # Kernel dispatch by topology:
+        #  * single chip — Pallas tile kernels directly;
+        #  * GSPMD mesh with batch-only sharding and a replicated head
+        #    (pure DP / ZeRO-1/2) — the same kernels per device inside a
+        #    shard_map island (one dwte psum in the backward);
+        #  * anything else (TP head, ZeRO-3 params, SP, shard_map step
+        #    mode) — the GSPMD-safe vocab-chunk scan.
+        trainer = getattr(self, "trainer", None)
+        mesh = getattr(trainer, "mesh", None)
         single = mesh is None or getattr(mesh, "size", 1) == 1
-        loss = fused_lm_head_cross_entropy(
-            x, params["wte"], targets,
-            compute_dtype=self._compute_dtype(),
-            use_pallas=single and jax.default_backend() == "tpu",
-        ).mean()
+        on_tpu = jax.default_backend() == "tpu"
+        c = self._compute_dtype()
+        if (not single and on_tpu
+                and self._batch_only_mesh(trainer, x.shape[0])):
+            loss = fused_lm_head_cross_entropy_sharded(
+                x, params["wte"], targets, mesh, compute_dtype=c,
+            ).mean()
+        else:
+            loss = fused_lm_head_cross_entropy(
+                x, params["wte"], targets, compute_dtype=c,
+                use_pallas=single and on_tpu,
+            ).mean()
         return loss, aux
+
+    @staticmethod
+    def _batch_only_mesh(trainer, batch_dim: int) -> bool:
+        """True when the mesh shards only the batch and the head stays
+        replicated: batch-only axes, GSPMD step mode, params unsharded
+        (zero_stage < 3), batch divisible over the shards (the island
+        cannot pad uneven shards the way plain GSPMD does).
+        Conservative: unknown attrs veto."""
+        mesh = getattr(trainer, "mesh", None)
+        if mesh is None:
+            return False
+        if not set(mesh.axis_names) <= {"data", "fsdp"}:
+            return False
+        if getattr(trainer, "step_mode", None) != "gspmd":
+            return False
+        if batch_dim % getattr(mesh, "size", 1):
+            return False
+        return getattr(trainer, "zero_stage", 3) < 3
 
     def training_step(self, params, batch, rng):
         loss, aux = self._loss(params, batch["tokens"])

@@ -46,7 +46,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["fused_lm_head_cross_entropy", "naive_lm_head_cross_entropy"]
+__all__ = [
+    "fused_lm_head_cross_entropy",
+    "fused_lm_head_cross_entropy_sharded",
+    "naive_lm_head_cross_entropy",
+]
 
 _NEG_INF = -1e30  # finite stand-in for -inf: keeps exp/max well-defined
 
@@ -99,7 +103,7 @@ _LANE = 128
 
 
 def _ce_fwd_kernel(x_ref, w_ref, t_ref, loss_ref, lse_ref, m_sc, s_sc, g_sc,
-                   *, vocab_size, block_v, num_vb):
+                   *, vocab_size, block_v, num_vb, vma=()):
     """Forward CE tile: one (token-block × vocab-block) step.
 
     Grid is (token blocks, vocab blocks) with vocab innermost: the online
@@ -112,19 +116,26 @@ def _ce_fwd_kernel(x_ref, w_ref, t_ref, loss_ref, lse_ref, m_sc, s_sc, g_sc,
 
     vi = pl.program_id(1)
 
+    def _c(val):  # promote kernel constants under the interpreter
+        return jax.lax.pvary(val, tuple(vma)) if vma else val
+
     @pl.when(vi == 0)
     def _init():
-        m_sc[...] = jnp.full(m_sc.shape, _NEG_INF, jnp.float32)
-        s_sc[...] = jnp.zeros(s_sc.shape, jnp.float32)
-        g_sc[...] = jnp.zeros(g_sc.shape, jnp.float32)
+        m_sc[...] = _c(jnp.full(m_sc.shape, _NEG_INF, jnp.float32))
+        s_sc[...] = _c(jnp.zeros(s_sc.shape, jnp.float32))
+        g_sc[...] = _c(jnp.zeros(g_sc.shape, jnp.float32))
 
     logits = jax.lax.dot_general(
         x_ref[...], w_ref[...], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )                                                  # (Tb, Vb) f32
     tb, vb = logits.shape
-    vpos = vi * block_v + jax.lax.broadcasted_iota(jnp.int32, (tb, vb), 1)
-    logits = jnp.where(vpos < vocab_size, logits, _NEG_INF)
+    vpos = _c(vi * block_v
+              + jax.lax.broadcasted_iota(jnp.int32, (tb, vb), 1))
+    logits = jnp.where(
+        vpos < _c(jnp.int32(vocab_size)), logits,
+        _c(jnp.float32(_NEG_INF))
+    )
     m_old = m_sc[:, :1]
     m_new = jnp.maximum(m_old, jnp.max(logits, axis=1, keepdims=True))
     corr = jnp.exp(m_old - m_new)
@@ -134,7 +145,8 @@ def _ce_fwd_kernel(x_ref, w_ref, t_ref, loss_ref, lse_ref, m_sc, s_sc, g_sc,
     # Gold logit: exactly one (or zero) hit per row in this vocab block.
     hit = vpos == t_ref[:, :1]
     g_new = g_sc[:, :1] + jnp.sum(
-        jnp.where(hit, logits, 0.0), axis=1, keepdims=True
+        jnp.where(hit, logits, _c(jnp.float32(0.0))), axis=1,
+        keepdims=True
     )
     m_sc[...] = jnp.broadcast_to(m_new, m_sc.shape)
     s_sc[...] = jnp.broadcast_to(s_new, s_sc.shape)
@@ -189,6 +201,22 @@ def _pad_vocab(wte, compute_dtype):
     return wp, vpad
 
 
+def _vma_of(val) -> frozenset:
+    """Manual mesh axes ``val`` varies over (empty outside shard_map)."""
+    try:
+        return frozenset(jax.typeof(val).vma)
+    except (AttributeError, TypeError):
+        return frozenset()
+
+
+def _out_struct(shape, dtype, vma):
+    """ShapeDtypeStruct carrying the varying-manual-axes type when inside
+    a shard_map region (pallas_call requires explicit out vma there)."""
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _ce_fwd_pallas(x, wte, targets, compute_dtype):
     """Kernel-path forward over flattened tokens.  Returns (loss, lse),
     both f32 with ``targets``'s shape."""
@@ -202,15 +230,24 @@ def _ce_fwd_pallas(x, wte, targets, compute_dtype):
     bv = _CE_BLOCK_V
     x2, t2, n, n_pad, _ = _flatten_pad(x, targets, compute_dtype)
     wp, vpad = _pad_vocab(wte, compute_dtype)
+    # Inside shard_map every pallas operand/output must carry one
+    # consistent vma type: promote the (replicated) head to the token
+    # operands' axes; outputs vary the same way.
+    vma = _vma_of(x2) | _vma_of(t2) | _vma_of(wp)
+    if vma:
+        x2, t2, wp = (jax.lax.pvary(v, tuple(vma - _vma_of(v)))
+                      for v in (x2, t2, wp))
     num_vb = vpad // bv
+    interp = jax.default_backend() != "tpu"
     kernel = partial(
         _ce_fwd_kernel, vocab_size=V, block_v=bv, num_vb=num_vb,
+        vma=tuple(sorted(vma)) if interp else (),
     )
     loss, lse = pl.pallas_call(
         kernel,
         out_shape=(
-            jax.ShapeDtypeStruct((n_pad, _LANE), jnp.float32),
-            jax.ShapeDtypeStruct((n_pad, _LANE), jnp.float32),
+            _out_struct((n_pad, _LANE), jnp.float32, vma),
+            _out_struct((n_pad, _LANE), jnp.float32, vma),
         ),
         grid=(n_pad // bt, num_vb),
         in_specs=[
@@ -295,15 +332,25 @@ def _kernel_path_available(d: int, compute_dtype) -> bool:
         return False
 
 
-def _ce_logits_tile(x_ref, w_ref, vi, block_v, vocab_size):
-    """Shared tile recompute: (Tb, d) x (Vb, d)^T -> masked f32 logits."""
+def _ce_logits_tile(x_ref, w_ref, vi, block_v, vocab_size, vma=()):
+    """Shared tile recompute: (Tb, d) x (Vb, d)^T -> masked f32 logits.
+
+    ``vma`` is non-empty only under the Pallas INTERPRETER inside a
+    shard_map region, where the kernel body is evaluated as jax ops and
+    fresh constants (iota) must be promoted to the refs' varying type.
+    Compiled Mosaic never sees it."""
+    def _c(val):
+        return jax.lax.pvary(val, tuple(vma)) if vma else val
+
     logits = jax.lax.dot_general(
         x_ref[...], w_ref[...], (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
     tb, vb = logits.shape
-    vpos = vi * block_v + jax.lax.broadcasted_iota(jnp.int32, (tb, vb), 1)
-    return jnp.where(vpos < vocab_size, logits, _NEG_INF), vpos
+    vpos = _c(vi * block_v
+              + jax.lax.broadcasted_iota(jnp.int32, (tb, vb), 1))
+    valid = vpos < _c(jnp.int32(vocab_size))
+    return jnp.where(valid, logits, _c(jnp.float32(_NEG_INF))), vpos
 
 
 def _ce_dlogits(logits, vpos, t_ref, lse_ref, g_ref):
@@ -313,7 +360,7 @@ def _ce_dlogits(logits, vpos, t_ref, lse_ref, g_ref):
 
 
 def _ce_bwd_dx_kernel(x_ref, w_ref, t_ref, lse_ref, g_ref, dx_ref, acc_sc,
-                      *, vocab_size, block_v, num_vb):
+                      *, vocab_size, block_v, num_vb, vma=()):
     """dx tile: token-major grid, vocab innermost; dx accumulates in VMEM
     across the vocab sweep.  The (Tb, Vb) dlogits tile never reaches HBM
     (the scan backward round-trips every chunk's logits AND dlogits)."""
@@ -323,9 +370,12 @@ def _ce_bwd_dx_kernel(x_ref, w_ref, t_ref, lse_ref, g_ref, dx_ref, acc_sc,
 
     @pl.when(vi == 0)
     def _init():
-        acc_sc[...] = jnp.zeros(acc_sc.shape, jnp.float32)
+        zeros = jnp.zeros(acc_sc.shape, jnp.float32)
+        acc_sc[...] = jax.lax.pvary(zeros, tuple(vma)) if vma else zeros
 
-    logits, vpos = _ce_logits_tile(x_ref, w_ref, vi, block_v, vocab_size)
+    logits, vpos = _ce_logits_tile(
+        x_ref, w_ref, vi, block_v, vocab_size, vma
+    )
     dlog = _ce_dlogits(logits, vpos, t_ref, lse_ref, g_ref)
     acc_sc[...] += jax.lax.dot_general(
         dlog.astype(x_ref.dtype), w_ref[...], (((1,), (0,)), ((), ())),
@@ -338,7 +388,7 @@ def _ce_bwd_dx_kernel(x_ref, w_ref, t_ref, lse_ref, g_ref, dx_ref, acc_sc,
 
 
 def _ce_bwd_dw_kernel(x_ref, w_ref, t_ref, lse_ref, g_ref, dw_ref, acc_sc,
-                      *, vocab_size, block_v, num_tb):
+                      *, vocab_size, block_v, num_tb, vma=()):
     """dwte tile: vocab-major grid, tokens innermost; the (Vb, d) row
     gradient accumulates in VMEM across the token sweep."""
     from jax.experimental import pallas as pl
@@ -348,9 +398,12 @@ def _ce_bwd_dw_kernel(x_ref, w_ref, t_ref, lse_ref, g_ref, dw_ref, acc_sc,
 
     @pl.when(ti == 0)
     def _init():
-        acc_sc[...] = jnp.zeros(acc_sc.shape, jnp.float32)
+        zeros = jnp.zeros(acc_sc.shape, jnp.float32)
+        acc_sc[...] = jax.lax.pvary(zeros, tuple(vma)) if vma else zeros
 
-    logits, vpos = _ce_logits_tile(x_ref, w_ref, vi, block_v, vocab_size)
+    logits, vpos = _ce_logits_tile(
+        x_ref, w_ref, vi, block_v, vocab_size, vma
+    )
     dlog = _ce_dlogits(logits, vpos, t_ref, lse_ref, g_ref)
     acc_sc[...] += jax.lax.dot_general(
         dlog.astype(x_ref.dtype), x_ref[...], (((0,), (0,)), ((), ())),
@@ -382,13 +435,22 @@ def _ce_bwd_pallas(x, wte, targets, lse, g, compute_dtype):
         x, targets, compute_dtype, extras=(g, lse)
     )
     wp, vpad = _pad_vocab(wte, compute_dtype)
+    vma = (_vma_of(x2) | _vma_of(t2) | _vma_of(wp) | _vma_of(g2)
+           | _vma_of(lse2))
+    if vma:
+        x2, t2, wp, g2, lse2 = (
+            jax.lax.pvary(v, tuple(vma - _vma_of(v)))
+            for v in (x2, t2, wp, g2, lse2)
+        )
     num_vb = vpad // bv
     num_tb = n_pad // bt
     interp = jax.default_backend() != "tpu"
+    kvma = tuple(sorted(vma)) if interp else ()
 
     dx = pl.pallas_call(
-        partial(_ce_bwd_dx_kernel, vocab_size=V, block_v=bv, num_vb=num_vb),
-        out_shape=jax.ShapeDtypeStruct((n_pad, d), jnp.float32),
+        partial(_ce_bwd_dx_kernel, vocab_size=V, block_v=bv, num_vb=num_vb,
+                vma=kvma),
+        out_shape=_out_struct((n_pad, d), jnp.float32, vma),
         grid=(num_tb, num_vb),
         in_specs=[
             pl.BlockSpec((bt, d), lambda t, v: (t, 0)),
@@ -403,8 +465,9 @@ def _ce_bwd_pallas(x, wte, targets, lse, g, compute_dtype):
     )(x2, wp, t2, lse2, g2)
 
     dw = pl.pallas_call(
-        partial(_ce_bwd_dw_kernel, vocab_size=V, block_v=bv, num_tb=num_tb),
-        out_shape=jax.ShapeDtypeStruct((vpad, d), jnp.float32),
+        partial(_ce_bwd_dw_kernel, vocab_size=V, block_v=bv, num_tb=num_tb,
+                vma=kvma),
+        out_shape=_out_struct((vpad, d), jnp.float32, vma),
         grid=(num_vb, num_tb),
         in_specs=[
             pl.BlockSpec((bt, d), lambda v, t: (t, 0)),
@@ -489,15 +552,24 @@ def _match_vma(val: jax.Array, ref: jax.Array) -> jax.Array:
 
 def _fused_ce_bwd(num_chunks, compute_dtype, use_pallas, res, g):
     x, wte, targets, lse = res
+    dx, dwte = _ce_bwd_core(
+        x, wte, targets, lse, g, num_chunks, compute_dtype, use_pallas
+    )
+    return (
+        _match_vma(dx.astype(x.dtype), x),
+        _match_vma(dwte.astype(wte.dtype), wte),
+        np.zeros(targets.shape, jax.dtypes.float0),
+    )
+
+
+def _ce_bwd_core(x, wte, targets, lse, g, num_chunks, compute_dtype,
+                 use_pallas):
+    """(dx, dwte) in f32, no vma handling — shared by the GSPMD custom
+    vjp and the shard_map island."""
     V, d = wte.shape
     if use_pallas:
-        dx, dwte = _ce_bwd_pallas(
+        return _ce_bwd_pallas(
             x, wte, targets, lse, g.astype(jnp.float32), compute_dtype
-        )
-        return (
-            _match_vma(dx.astype(x.dtype), x),
-            _match_vma(dwte.astype(wte.dtype), wte),
-            np.zeros(targets.shape, jax.dtypes.float0),
         )
     wte_chunks, Vc = _chunk_wte(wte, num_chunks)
     g32 = g.astype(jnp.float32)
@@ -529,12 +601,7 @@ def _fused_ce_bwd(num_chunks, compute_dtype, use_pallas, res, g):
         (jnp.arange(num_chunks), wte_chunks),
     )
     dwte = dw_chunks.reshape(num_chunks * Vc, d)[:V]
-    dtargets = np.zeros(targets.shape, jax.dtypes.float0)
-    return (
-        _match_vma(dx.astype(x.dtype), x),
-        _match_vma(dwte.astype(wte.dtype), wte),
-        dtargets,
-    )
+    return dx, dwte
 
 
 _fused_ce.defvjp(_fused_ce_vjp_fwd, _fused_ce_bwd)
@@ -575,6 +642,123 @@ def fused_lm_head_cross_entropy(
     )
     return _fused_ce(
         x, wte, targets, num_chunks, jnp.dtype(compute_dtype), pallas
+    )
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _fused_ce_shmap(x, wte, targets, mesh, batch_axes, num_chunks,
+                    compute_dtype, use_pallas):
+    loss, _ = _fused_ce_shmap_fwd(
+        x, wte, targets, mesh, batch_axes, num_chunks, compute_dtype,
+        use_pallas,
+    )
+    return loss
+
+
+def _fused_ce_shmap_fwd(x, wte, targets, mesh, batch_axes, num_chunks,
+                        compute_dtype, use_pallas):
+    from jax.sharding import PartitionSpec as P
+
+    Pb = P(batch_axes)
+
+    def local(xl, w, tl):
+        if use_pallas:
+            return _ce_fwd_pallas(xl, w, tl, compute_dtype)
+        loss, (_, _, _, lse) = _fused_ce_fwd(
+            xl, w, tl, num_chunks, compute_dtype
+        )
+        return loss, lse
+
+    loss, lse = jax.shard_map(
+        local, mesh=mesh, in_specs=(Pb, P(), Pb), out_specs=(Pb, Pb),
+        check_vma=False,
+    )(x, wte, targets)
+    return loss, (x, wte, targets, lse)
+
+
+def _fused_ce_shmap_bwd(mesh, batch_axes, num_chunks, compute_dtype,
+                        use_pallas, res, g):
+    from jax.sharding import PartitionSpec as P
+
+    x, wte, targets, lse = res
+    Pb = P(batch_axes)
+    axes = tuple(a for spec in batch_axes
+                 for a in (spec if isinstance(spec, tuple) else (spec,)))
+
+    def local(xl, w, tl, lsel, gl):
+        dxl, dwp = _ce_bwd_core(
+            xl, w, tl, lsel, gl, num_chunks, compute_dtype, use_pallas
+        )
+        # check_vma=False shard_map does NOT insert the replicated-input
+        # cotangent psum — do it explicitly (each device holds the
+        # partial dwte of its batch shard).
+        return dxl, jax.lax.psum(dwp, axes)
+
+    dx, dwte = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(Pb, P(), Pb, Pb, Pb), out_specs=(Pb, P()),
+        check_vma=False,
+    )(x, wte, targets, lse, g.astype(jnp.float32))
+    return (
+        dx.astype(x.dtype),
+        dwte.astype(wte.dtype),
+        np.zeros(targets.shape, jax.dtypes.float0),
+    )
+
+
+_fused_ce_shmap.defvjp(_fused_ce_shmap_fwd, _fused_ce_shmap_bwd)
+
+
+def fused_lm_head_cross_entropy_sharded(
+    x: jax.Array,
+    wte: jax.Array,
+    targets: jax.Array,
+    mesh,
+    *,
+    batch_axes: Optional[Tuple[str, ...]] = None,
+    num_chunks: Optional[int] = None,
+    compute_dtype: jnp.dtype = jnp.bfloat16,
+    use_pallas: Optional[bool] = None,
+) -> jax.Array:
+    """Multi-chip fused CE: a shard_map island running the Pallas kernels
+    per device (jit → shard_map → pallas, the canonical distributed-kernel
+    pattern).
+
+    Requirements: ``x``/``targets`` batch-sharded on dim 0 over
+    ``batch_axes`` and ``wte`` fully replicated (pure DP / ZeRO-1/2 —
+    NOT tensor-sharded heads or ZeRO-3). Each device runs the kernel on
+    its local tokens against the full vocab; the only collective is one
+    psum of the dwte partials in the backward — identical math to the
+    GSPMD scan path, minus every chunk intermediate's HBM round-trip.
+
+    Falls back to the scan inside the island when the kernel gate
+    (shape/probe) rejects, so callers can use it unconditionally for
+    replicated-head meshes.
+    """
+    if batch_axes is None:
+        batch_axes = tuple(
+            a for a in mesh.axis_names if a in ("data", "fsdp")
+        )
+    if not batch_axes:
+        raise ValueError(
+            f"no batch axes among mesh axes {mesh.axis_names}"
+        )
+    n_shards = 1
+    for a in batch_axes:
+        n_shards *= mesh.shape[a]
+    if x.shape[0] % n_shards:
+        raise ValueError(
+            f"batch dim {x.shape[0]} not divisible by "
+            f"{batch_axes}={n_shards}"
+        )
+    if num_chunks is None:
+        num_chunks = _pick_num_chunks(wte.shape[0])
+    pallas = use_pallas is not False and _pallas_fwd_ok(
+        x, wte, targets, compute_dtype
+    ) and _kernel_path_available(x.shape[-1], compute_dtype)
+    return _fused_ce_shmap(
+        x, wte, targets, mesh, tuple(batch_axes), num_chunks,
+        jnp.dtype(compute_dtype), pallas,
     )
 
 

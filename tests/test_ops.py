@@ -288,6 +288,78 @@ class TestFusedCEPallas:
             x, wte, t, compute_dtype=jnp.float32)
         assert float(jnp.abs(fused - naive).max()) < 1e-5
 
+    # jit > shard_map island > pallas: the multi-chip replicated-head
+    # path (one dwte psum is the only collective).
+    @pytest.mark.parametrize("pallas", [True, False])
+    def test_sharded_island_parity(self, pallas):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ray_lightning_tpu.ops.cross_entropy import (
+            fused_lm_head_cross_entropy_sharded,
+            naive_lm_head_cross_entropy)
+
+        x, wte, t = self._inputs(B=8, T=64)
+        mesh = Mesh(
+            mesh_utils.create_device_mesh((2, 2, 2)),
+            ("data", "fsdp", "tensor"),
+        )
+        xs = jax.device_put(x, NamedSharding(mesh, P(("data", "fsdp"))))
+        ts = jax.device_put(t, NamedSharding(mesh, P(("data", "fsdp"))))
+        ws = jax.device_put(wte, NamedSharding(mesh, P()))
+
+        def loss_s(x, w):
+            return fused_lm_head_cross_entropy_sharded(
+                x, w, ts, mesh, compute_dtype=jnp.float32,
+                use_pallas=pallas).mean()
+
+        def loss_n(x, w):
+            return naive_lm_head_cross_entropy(
+                x, w, t, compute_dtype=jnp.float32).mean()
+
+        lv, gv = jax.jit(jax.value_and_grad(loss_s, argnums=(0, 1)))(
+            xs, ws)
+        ln, gn = jax.value_and_grad(loss_n, argnums=(0, 1))(x, wte)
+        assert abs(float(lv) - float(ln)) < 1e-5
+        for a, b, name in zip(gv, gn, ("dx", "dwte")):
+            err = float(jnp.abs(a - b).max())
+            assert err < 1e-5, f"{name} max err {err}"
+
+    def test_sharded_rejects_indivisible_batch(self):
+        from ray_lightning_tpu.ops.cross_entropy import (
+            fused_lm_head_cross_entropy_sharded)
+        import numpy as np
+
+        x, wte, t = self._inputs(B=3, T=64)
+        mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+        with pytest.raises(ValueError, match="not divisible"):
+            fused_lm_head_cross_entropy_sharded(
+                x, wte, t, mesh, compute_dtype=jnp.float32)
+
+    def test_batch_only_mesh_gate(self):
+        """GPT engages the shard_map island only for batch-only GSPMD
+        meshes with unsharded params."""
+        import numpy as np
+
+        from ray_lightning_tpu.models.gpt import GPT
+
+        class Ctx:
+            mesh = Mesh(np.array(jax.devices()[:4]), ("data",))
+            step_mode = "gspmd"
+            zero_stage = 1
+
+        assert GPT._batch_only_mesh(Ctx, batch_dim=8)
+        # Indivisible batch: the island can't pad uneven shards -> veto.
+        assert not GPT._batch_only_mesh(Ctx, batch_dim=6)
+        for attr, bad in (("step_mode", "shard_map"), ("zero_stage", 3)):
+            ctx = type("C", (Ctx,), {attr: bad})
+            assert not GPT._batch_only_mesh(ctx, batch_dim=8)
+        tp = type("C", (Ctx,), {"mesh": Mesh(
+            np.array(jax.devices()[:4]).reshape(2, 2),
+            ("data", "tensor"))})
+        assert not GPT._batch_only_mesh(tp, batch_dim=8)
+        assert not GPT._batch_only_mesh(
+            type("C", (), {"mesh": None}), batch_dim=8)
+
 
 @pytest.mark.parametrize("mesh_shape,axes", [
     ((8,), ("sp",)),
