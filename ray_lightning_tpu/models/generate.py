@@ -195,6 +195,7 @@ def generate(
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
     rng: Optional[jax.Array] = None,
+    eos_token_id: Optional[int] = None,
 ) -> jax.Array:
     """Greedy (``temperature=0``), temperature, top-k and/or top-p
     (nucleus) sampling.  Prompt slots fill via one fused :func:`prefill`
@@ -209,6 +210,11 @@ def generate(
         rng: sampling key.  Defaults to ``PRNGKey(0)`` — deterministic,
             so repeated calls return the SAME sample; pass a fresh key
             per call for diverse samples.
+        eos_token_id: once a sequence samples this token every later
+            position repeats it (the sequence is *finished*).  Shapes
+            stay static under jit — the scan still runs ``max_new_tokens``
+            steps — but finished rows stop changing, the standard
+            XLA-friendly stopping semantics.
     Returns:
         ``(B, T0 + max_new_tokens)`` int32 — prompt followed by the
         generated continuation.
@@ -233,6 +239,13 @@ def generate(
             "top_k/top_p require temperature > 0 (temperature=0 is "
             "greedy decoding, which would silently ignore them)"
         )
+    if (eos_token_id is not None
+            and not 0 <= eos_token_id < cfg.vocab_size):
+        raise ValueError(
+            f"eos_token_id {eos_token_id} outside vocab "
+            f"[0, {cfg.vocab_size}) — stopping would silently never "
+            f"trigger"
+        )
     total = t0 + max_new_tokens
     if total > cfg.seq_len:
         raise ValueError(
@@ -254,18 +267,27 @@ def generate(
     rng, sub = jax.random.split(rng)
     first = _sample(logits, sub, temperature, top_k, top_p)
     first = first.astype(jnp.int32)
+    done0 = (
+        first == eos_token_id if eos_token_id is not None
+        else jnp.zeros((B,), bool)
+    )
 
     def step(carry, t):
-        cache, cur, rng = carry
+        cache, cur, rng, done = carry
         logits, cache = decode_step(
             cfg, params, cache, cur, t, compute_dtype=c
         )
         rng, sub = jax.random.split(rng)
         nxt = _sample(logits, sub, temperature, top_k, top_p)
-        return (cache, nxt.astype(jnp.int32), rng), nxt.astype(jnp.int32)
+        nxt = nxt.astype(jnp.int32)
+        if eos_token_id is not None:
+            # Finished rows keep emitting eos; the row freezes.
+            nxt = jnp.where(done, jnp.int32(eos_token_id), nxt)
+            done = done | (nxt == eos_token_id)
+        return (cache, nxt, rng, done), nxt
 
     # Positions t0 .. total-2 emit tokens t0+1 .. total-1.
-    (_, _, _), rest = jax.lax.scan(
-        step, (cache, first, rng), jnp.arange(t0, total - 1)
+    (_, _, _, _), rest = jax.lax.scan(
+        step, (cache, first, rng, done0), jnp.arange(t0, total - 1)
     )
     return jnp.concatenate([prompt, first[:, None], rest.T], axis=1)

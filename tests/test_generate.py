@@ -122,6 +122,30 @@ def test_greedy_generation_matches_argmax_rollout(model):
     np.testing.assert_array_equal(np.asarray(out), cur)
 
 
+def test_eos_freezes_finished_sequences(model):
+    """Once a row samples eos, every later position repeats eos; rows
+    that never sample it are unaffected (match the no-eos output)."""
+    m, params = model
+    cfg = m.config
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (2, 3), 0,
+                                cfg.vocab_size)
+    base = generate(m, params, prompt, 8)
+    # Pick the token row 0 greedily emits first as the "eos" id: row 0
+    # must freeze right there; use an id row 1 never emits to leave it
+    # untouched.
+    eos = int(base[0, 3])
+    out = generate(m, params, prompt, 8, eos_token_id=eos)
+    got = np.asarray(out)
+    assert (got[0, 3:] == eos).all(), "finished row did not freeze"
+    if eos not in np.asarray(base)[1, 3:]:
+        np.testing.assert_array_equal(got[1], np.asarray(base)[1])
+    # jit parity (the scan carry gained a done mask).
+    jout = jax.jit(
+        lambda p, pr: generate(m, p, pr, 8, eos_token_id=eos)
+    )(params, prompt)
+    np.testing.assert_array_equal(np.asarray(jout), got)
+
+
 def test_sampled_generation_reproducible(model):
     m, params = model
     prompt = jnp.zeros((1, 2), jnp.int32)
