@@ -136,9 +136,15 @@ def test_eos_freezes_finished_sequences(model):
     eos = int(base[0, 3])
     out = generate(m, params, prompt, 8, eos_token_id=eos)
     got = np.asarray(out)
+    ref = np.asarray(base)
     assert (got[0, 3:] == eos).all(), "finished row did not freeze"
-    if eos not in np.asarray(base)[1, 3:]:
-        np.testing.assert_array_equal(got[1], np.asarray(base)[1])
+    # Unconditional per-row property: identical to the no-eos rollout up
+    # to and including each row's first eos, frozen at eos after it.
+    for r in range(got.shape[0]):
+        hits = np.where(ref[r, 3:] == eos)[0]
+        cut = 3 + (hits[0] + 1 if hits.size else ref.shape[1])
+        np.testing.assert_array_equal(got[r, :cut], ref[r, :cut])
+        assert (got[r, cut:] == eos).all()
     # jit parity (the scan carry gained a done mask).
     jout = jax.jit(
         lambda p, pr: generate(m, p, pr, 8, eos_token_id=eos)

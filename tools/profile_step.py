@@ -1,0 +1,141 @@
+"""Profile one GPT train step on the current backend and rank op costs.
+
+Usage: ``python tools/profile_step.py [--config gpt2_small|tiny] [--steps 6]``
+
+Captures a ``jax.profiler.trace`` around chained jitted steps (chained
+inside the trace so per-dispatch tunnel overhead — ~4 ms on the remote
+platform — amortizes; see docs/PERFORMANCE.md "Profiling recipe"),
+parses the trace's ``trace.json.gz``, and prints the top XLA ops by
+total self-duration plus a coarse bucket breakdown (matmul / attention
+kernels / CE kernels / layernorm-elementwise / optimizer / copies).
+
+This is the measurement half of the perf loop; bench.py is the score.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _bucket(name: str) -> str:
+    n = name.lower()
+    if "flash" in n or "attention" in n:
+        return "attention-kernel"
+    if "ce_fwd" in n or "ce_bwd" in n or "cross_entropy" in n:
+        return "ce-kernel"
+    if "dot" in n or "conv" in n or "einsum" in n:
+        return "matmul"
+    if "dynamic-update-slice" in n or "dynamic_update" in n:
+        return "residual-save"
+    if "copy" in n or "transpose" in n or "bitcast" in n:
+        return "layout"
+    if "reduce" in n or "add" in n or "multiply" in n or "fused" in n:
+        return "elementwise/fused"
+    return "other"
+
+
+def collect(trace_dir: str):
+    """Aggregate ph=='X' event durations by name from the newest trace."""
+    paths = sorted(
+        glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
+                  recursive=True),
+        key=os.path.getmtime,
+    )
+    if not paths:
+        raise FileNotFoundError(f"no trace.json.gz under {trace_dir}")
+    with gzip.open(paths[-1], "rt") as f:
+        events = json.load(f).get("traceEvents", [])
+    durs: dict = collections.defaultdict(float)
+    for e in events:
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        name = e.get("name", "?")
+        # Keep device-lane XLA ops; drop host-side python/runtime events
+        # (they dominate CPU traces and double-count wall time).
+        if (".py" in name or name.startswith("$")
+                or "ThunkExecutor" in name or "np.asarray" in name):
+            continue
+        durs[name] += e["dur"]
+    return durs
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="gpt2_small",
+                    choices=["gpt2_small", "tiny"])
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--batch-size", type=int, default=0)
+    ap.add_argument("--top", type=int, default=25)
+    args = ap.parse_args()
+
+    from bench import _detect_backend
+    from ray_lightning_tpu.core.module import TrainState
+    from ray_lightning_tpu.models.gpt import GPT, GPTConfig
+    from ray_lightning_tpu.parallel.step_fns import build_train_step
+
+    on_tpu = _detect_backend() == "tpu"
+    if args.config == "gpt2_small":
+        cfg = GPTConfig(vocab_size=50304, n_layer=12, n_head=12,
+                        d_model=768, seq_len=1024, warmup_steps=10)
+        batch = args.batch_size or 16
+    else:
+        cfg = GPTConfig.tiny()
+        batch = args.batch_size or 8
+    module = GPT(cfg, attn_impl="auto", remat=on_tpu)
+    module.precision = "bf16"
+
+    params = module.init_params(jax.random.PRNGKey(0))
+    tx = module.configure_optimizers()
+    state = TrainState.create(params, tx)
+    step = build_train_step(module, tx, mesh=None)
+    tokens = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(batch, cfg.seq_len + 1)), jnp.int32)
+    rng = jax.random.PRNGKey(0)
+    batch_d = {"tokens": tokens}
+
+    # Warm up (compile) outside the trace.
+    for _ in range(2):
+        state, logs = step(state, batch_d, rng)
+    float(jax.device_get(logs["loss"]))
+
+    trace_dir = tempfile.mkdtemp(prefix="rlt_profile_")
+    t0 = time.perf_counter()
+    with jax.profiler.trace(trace_dir):
+        for _ in range(args.steps):
+            state, logs = step(state, batch_d, rng)
+        loss = float(jax.device_get(logs["loss"]))
+    wall = time.perf_counter() - t0
+    print(f"# {args.steps} steps in {wall*1e3:.1f} ms "
+          f"({wall/args.steps*1e3:.1f} ms/step), loss={loss:.4f}, "
+          f"backend={jax.default_backend()}", file=sys.stderr)
+
+    durs = collect(trace_dir)
+    total = sum(durs.values())
+    buckets: dict = collections.defaultdict(float)
+    for name, d in durs.items():
+        buckets[_bucket(name)] += d
+    print("== buckets (% of op time) ==")
+    for b, d in sorted(buckets.items(), key=lambda kv: -kv[1]):
+        print(f"{100*d/total:6.2f}%  {d/1e3/args.steps:8.2f} ms/step  {b}")
+    print(f"== top {args.top} ops ==")
+    for name, d in sorted(durs.items(), key=lambda kv: -kv[1])[:args.top]:
+        print(f"{100*d/total:6.2f}%  {d/1e3/args.steps:8.2f} ms/step  "
+              f"{name[:90]}")
+
+
+if __name__ == "__main__":
+    main()
