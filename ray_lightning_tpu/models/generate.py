@@ -20,8 +20,9 @@ a usable LM needs a decode loop.  TPU-first shape discipline throughout:
 * **Sampling**: greedy, temperature, top-k and nucleus (top-p) — all
   shape-static so the whole generation stays inside one jit.
 
-Dense blocks only (MoE decode needs single-token routing — refused
-loudly rather than silently mis-batched).
+MoE models decode through the same routed-MLP math as training
+(``groups=1``); see :func:`generate` for the capacity-competition
+caveat.
 """
 
 from __future__ import annotations
@@ -32,7 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from ray_lightning_tpu.models.gpt import (
-    GPT, GPTConfig, _layer_norm, _mlp_residual,
+    GPT, GPTConfig, _layer_norm, _mlp_residual, _moe_residual,
 )
 from ray_lightning_tpu.ops.attention import _NEG_INF
 
@@ -93,6 +94,14 @@ def _block_pass(
         "bhqs,bshd->bqhd", probs, v_l.astype(jnp.float32)
     ).reshape(B, T, cfg.d_model).astype(c)
     x = x + att @ p["proj_w"].astype(c) + p["proj_b"].astype(c)
+    if cfg.n_experts > 0:
+        # Same routed-MLP math as training (groups=1 — inference is
+        # chip-local).  Capacity competition is per ROUTED SET: the full
+        # forward routes all B*T prompt tokens together, decode routes
+        # the B current tokens — identical decisions whenever capacity
+        # doesn't saturate (see generate() docstring).
+        x, _ = _moe_residual(x, p, cfg, groups=1)
+        return x, k_l, v_l
     return _mlp_residual(x, p, c), k_l, v_l
 
 
@@ -215,16 +224,19 @@ def generate(
             stay static under jit — the scan still runs ``max_new_tokens``
             steps — but finished rows stop changing, the standard
             XLA-friendly stopping semantics.
+
+    MoE models decode with the same routed-MLP math as training
+    (``groups=1``).  Caveat: expert-capacity competition happens per
+    routed set — training/prefill routes a whole ``(B, T)`` batch while
+    decode routes the ``B`` current tokens — so token drops can differ
+    when capacity saturates; with headroom
+    (``capacity_factor >= n_experts`` guarantees zero drops) decode
+    matches the full forward exactly (tested).
     Returns:
         ``(B, T0 + max_new_tokens)`` int32 — prompt followed by the
         generated continuation.
     """
     cfg = module.config
-    if cfg.n_experts > 0:
-        raise NotImplementedError(
-            "generate() covers dense GPT blocks; MoE decode needs "
-            "single-token routing"
-        )
     B, t0 = prompt.shape
     if t0 < 1:
         raise ValueError("prompt must contain at least one token")

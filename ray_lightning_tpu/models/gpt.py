@@ -101,6 +101,23 @@ def _mlp_residual(x: jax.Array, p: Dict[str, Any], c) -> jax.Array:
     return x + h @ p["mlp_out_w"].astype(c) + p["mlp_out_b"].astype(c)
 
 
+def _moe_residual(x, p, cfg, groups: int):
+    """LN2 + routed expert MLP + residual — the MoE second half of a GPT
+    block.  Single source for the training scan and single-token decode
+    (≙ the `_mlp_residual` discipline).  Returns ``(x, aux_loss)``."""
+    from ray_lightning_tpu.ops.moe import moe_mlp
+
+    h = _layer_norm(x, p["ln2_g"], p["ln2_b"])
+    y, aux = moe_mlp(
+        h, p["gate_w"], p["moe_in_w"], p["moe_in_b"],
+        p["moe_out_w"], p["moe_out_b"],
+        top_k=cfg.moe_top_k,
+        capacity_factor=cfg.moe_capacity_factor,
+        groups=groups,
+    )
+    return x + y, aux
+
+
 class GPT(TpuModule):
     """Decoder-only LM.  Batch contract: ``{"tokens": int32 (B, T+1)}``
     — inputs are ``tokens[:, :-1]``, targets ``tokens[:, 1:]``."""
@@ -287,6 +304,17 @@ class GPT(TpuModule):
 
         batch = shardlib.data_axes(mesh)
         seq = self.seq_axis if self.seq_axis in mesh.axis_names else None
+        # Batches that don't divide the batch axes (e.g. a 2-row
+        # inference call on a module still carrying its 8-way training
+        # mesh) cannot take the constraint — skip it rather than fail;
+        # the anchor is a perf hint, not a correctness requirement.
+        n_shards = 1
+        for a in (batch if batch else ()):
+            n_shards *= mesh.shape[a]
+        if x.shape[0] % n_shards:
+            return x
+        if seq is not None and x.shape[1] % mesh.shape[seq]:
+            return x
         spec = P(batch if batch else None, seq, None)
         return jax.lax.with_sharding_constraint(
             x, NamedSharding(mesh, spec)
@@ -338,17 +366,9 @@ class GPT(TpuModule):
             att = att.reshape(B, T, cfg.d_model)
             x = x + att @ p["proj_w"].astype(c) + p["proj_b"].astype(c)
             if cfg.n_experts > 0:
-                from ray_lightning_tpu.ops.moe import moe_mlp
-
-                h = _layer_norm(x, p["ln2_g"], p["ln2_b"])
-                y, layer_aux = moe_mlp(
-                    h, p["gate_w"], p["moe_in_w"], p["moe_in_b"],
-                    p["moe_out_w"], p["moe_out_b"],
-                    top_k=cfg.moe_top_k,
-                    capacity_factor=cfg.moe_capacity_factor,
-                    groups=self._moe_groups(),
+                x, layer_aux = _moe_residual(
+                    x, p, cfg, groups=self._moe_groups()
                 )
-                x = x + y
                 aux = aux + layer_aux
             else:
                 x = _mlp_residual(x, p, c)

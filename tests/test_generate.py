@@ -205,7 +205,7 @@ def test_generate_under_tp_mesh(model):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
-def test_generate_refuses_overlong_and_moe(model):
+def test_generate_validates_args(model):
     m, params = model
     prompt = jnp.zeros((1, 30), jnp.int32)
     with pytest.raises(ValueError, match="exceeds"):
@@ -226,7 +226,30 @@ def test_generate_refuses_overlong_and_moe(model):
     out = generate(m, params, small, 2, temperature=1.0,
                    top_k=m.config.vocab_size + 7)
     assert out.shape == (1, 4)
-    moe = GPT(GPTConfig.tiny_moe())
-    with pytest.raises(NotImplementedError, match="MoE"):
-        generate(moe, moe.init_params(jax.random.PRNGKey(0)),
-                 jnp.zeros((1, 2), jnp.int32), 2)
+
+
+def test_moe_greedy_generation_matches_argmax_rollout():
+    """MoE decode == python loop of full MoE forwards.
+
+    capacity_factor = n_experts guarantees zero capacity drops, which
+    makes per-step routing identical to whole-batch routing (the caveat
+    documented on generate())."""
+    from dataclasses import replace
+
+    cfg = GPTConfig.tiny_moe()
+    cfg = replace(cfg, moe_capacity_factor=float(cfg.n_experts))
+    m = GPT(cfg)
+    params = m.init_params(jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 4), 0,
+                                cfg.vocab_size)
+    out = jax.jit(
+        lambda p, pr: generate(m, p, pr, max_new_tokens=5)
+    )(params, prompt)
+    assert out.shape == (2, 9)
+
+    cur = np.asarray(prompt)
+    for _ in range(5):
+        logits = m.forward(params, jnp.asarray(cur))
+        nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
+        cur = np.concatenate([cur, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), cur)
