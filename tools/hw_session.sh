@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# One-shot TPU measurement session: run when the axon tunnel is up.
+# Captures, in order: device probe, headline bench, per-op profile,
+# long-context bench, CE block sweep. Each stage logs to tools/hw_logs/.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p tools/hw_logs
+stamp=$(date +%Y%m%d_%H%M%S)
+log() { echo "== $1 =="; }
+
+log "probe"
+timeout 120 python -c "import jax; print(jax.devices())" \
+  2>&1 | tail -2 | tee "tools/hw_logs/${stamp}_probe.log" || {
+    echo "TPU unreachable; aborting session"; exit 1; }
+
+log "bench.py (headline)"
+timeout 1800 python bench.py 2>&1 | tee "tools/hw_logs/${stamp}_bench.log"
+
+log "profile_step (op breakdown)"
+timeout 1800 python tools/profile_step.py --steps 6 \
+  2>&1 | tee "tools/hw_logs/${stamp}_profile.log"
+
+log "bench_long_context"
+timeout 1800 python bench_long_context.py \
+  2>&1 | tee "tools/hw_logs/${stamp}_longctx.log"
+
+log "sweep_ce_blocks"
+timeout 2400 python tools/sweep_ce_blocks.py \
+  2>&1 | tee "tools/hw_logs/${stamp}_sweep.log"
+
+log "done — logs in tools/hw_logs/${stamp}_*.log"
