@@ -13,4 +13,20 @@ __all__ = [
     "load_state_stream",
     "tree_to_bytes",
     "tree_from_bytes",
+    "ORBAX_INSTALLED",
+    "save_orbax",
+    "load_orbax",
 ]
+
+_ORBAX_NAMES = ("ORBAX_INSTALLED", "save_orbax", "load_orbax")
+
+
+def __getattr__(name):
+    # PEP 562 lazy re-export: importing orbax costs ~3s (tensorstore),
+    # so `import ray_lightning_tpu` must not pay it — only an actual
+    # use of the interop bridge does.
+    if name in _ORBAX_NAMES:
+        from . import orbax_io
+
+        return getattr(orbax_io, name)
+    raise AttributeError(name)

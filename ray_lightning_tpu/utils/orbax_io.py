@@ -1,0 +1,69 @@
+"""Orbax interop: export/import checkpoints in the JAX ecosystem format.
+
+The framework's own formats stay canonical — msgpack state streams for
+driver-bound transfer (``utils/state_stream.py``) and per-host shard
+files for elastic restarts (``utils/sharded_ckpt.py``) — but users
+migrating models into or out of the wider JAX ecosystem (flax/orbax
+trainers, serving stacks) need the standard on-disk format.  These are
+thin, dependency-gated bridges over ``orbax.checkpoint``:
+
+* :func:`save_orbax` — write any array pytree (params, TrainState
+  fields, ...) as a standard Orbax checkpoint; sharded ``jax.Array``
+  leaves are handled by Orbax natively (each host writes its shards).
+* :func:`load_orbax` — restore, optionally resharded onto a target
+  pytree of ``jax.ShapeDtypeStruct``/shardings (any mesh, any world
+  size — Orbax reads and re-lays-out).
+
+The reference has no analogue (torch pickles only, ``util.py:71-90``);
+this is ecosystem parity for the JAX world.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+try:
+    import orbax.checkpoint as _ocp
+except ImportError:  # pragma: no cover - orbax is in the base image
+    _ocp = None
+
+__all__ = ["save_orbax", "load_orbax", "ORBAX_INSTALLED"]
+
+ORBAX_INSTALLED = _ocp is not None
+
+
+def _require_orbax():
+    if _ocp is None:
+        raise ImportError(
+            "This feature requires orbax-checkpoint, which is not "
+            "installed in this environment."
+        )
+
+
+def save_orbax(path: str, tree: Any, *, overwrite: bool = False) -> str:
+    """Write ``tree`` (any array pytree) as an Orbax checkpoint at
+    ``path`` (a directory).  Returns the absolute path."""
+    _require_orbax()
+    path = os.path.abspath(path)
+    with _ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, tree, force=overwrite)
+    return path
+
+
+def load_orbax(path: str, target: Optional[Any] = None) -> Any:
+    """Restore an Orbax checkpoint.
+
+    Args:
+        path: checkpoint directory (as produced by :func:`save_orbax`
+            or any Orbax ``StandardCheckpointer``/flax trainer).
+        target: optional abstract pytree (``jax.ShapeDtypeStruct``
+            leaves, optionally carrying ``sharding``) controlling
+            restore placement — pass ``jax.eval_shape`` output with
+            ``NamedSharding`` to land shards directly on a mesh.
+            ``None`` restores host-local numpy-backed arrays.
+    """
+    _require_orbax()
+    path = os.path.abspath(path)
+    with _ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(path, target)
