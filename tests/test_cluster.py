@@ -171,6 +171,41 @@ class TestDriverQueue:
         assert q.empty()
         q.shutdown()
 
+    def test_concurrent_producers_exactly_once(self):
+        """8 threads × 50 acked puts: every item arrives exactly once
+        (per-producer seq spaces + the server's seen-dict under lock)."""
+        import threading
+
+        q = DriverQueue()
+        n_threads, n_items = 8, 50
+        errors = []
+
+        def producer(tid):
+            h = q.handle  # fresh handle -> own client_id/seq space
+            try:
+                for i in range(n_items):
+                    h.put((tid, i))
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=producer, args=(t,))
+            for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), "producer hung"
+        assert not errors, errors
+        got = []
+        while not q.empty():
+            got.append(q.get_nowait())
+        assert sorted(got) == [
+            (t, i) for t in range(n_threads) for i in range(n_items)
+        ]
+        q.shutdown()
+
     def test_put_after_shutdown_fails_fast(self):
         """shutdown() must wake reader threads and refuse late puts —
         not ack items into a queue nobody will drain."""
