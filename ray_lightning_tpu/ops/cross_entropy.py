@@ -201,6 +201,12 @@ def _pad_vocab(wte, compute_dtype):
     return wp, vpad
 
 
+def _interpret() -> bool:
+    # Mosaic compiles only for TPU; CPU test meshes run the kernels under
+    # the Pallas interpreter (same program, host execution).
+    return jax.default_backend() != "tpu"
+
+
 def _vma_of(val) -> frozenset:
     """Manual mesh axes ``val`` varies over (empty outside shard_map)."""
     try:
@@ -238,7 +244,7 @@ def _ce_fwd_pallas(x, wte, targets, compute_dtype):
         x2, t2, wp = (jax.lax.pvary(v, tuple(vma - _vma_of(v)))
                       for v in (x2, t2, wp))
     num_vb = vpad // bv
-    interp = jax.default_backend() != "tpu"
+    interp = _interpret()
     kernel = partial(
         _ce_fwd_kernel, vocab_size=V, block_v=bv, num_vb=num_vb,
         vma=tuple(sorted(vma)) if interp else (),
@@ -264,7 +270,7 @@ def _ce_fwd_pallas(x, wte, targets, compute_dtype):
             pltpu.VMEM((bt, _LANE), jnp.float32),
             pltpu.VMEM((bt, _LANE), jnp.float32),
         ],
-        interpret=jax.default_backend() != "tpu",
+        interpret=interp,
     )(x2, wp, t2)
     return loss[:n, 0].reshape(shape), lse[:n, 0].reshape(shape)
 
@@ -297,7 +303,7 @@ def _kernel_path_available(d: int, compute_dtype) -> bool:
     the probe turns "crash mid-fit on this TPU generation" into a
     warning + slow path.  (Under the interpreter — CPU tests — the
     kernels always work.)"""
-    if jax.default_backend() != "tpu":
+    if _interpret():
         return True
     key = (d, jnp.dtype(compute_dtype).name)
     cached = _KERNELS_AVAILABLE.get(key)
@@ -444,7 +450,7 @@ def _ce_bwd_pallas(x, wte, targets, lse, g, compute_dtype):
         )
     num_vb = vpad // bv
     num_tb = n_pad // bt
-    interp = jax.default_backend() != "tpu"
+    interp = _interpret()
     kvma = tuple(sorted(vma)) if interp else ()
 
     dx = pl.pallas_call(
