@@ -186,6 +186,34 @@ def _bench_fit(module: GPT, cfg: GPTConfig, batch_size: int):
     return _median_spread(tps)
 
 
+def _bench_generate(module: GPT, cfg: GPTConfig, on_tpu: bool):
+    """Greedy decode throughput (new tokens/s, whole batch) through the
+    KV-cache generation path.  Strictly best-effort: any failure returns
+    None rather than costing the headline training line."""
+    try:
+        from ray_lightning_tpu.models.generate import generate
+
+        B = 8 if on_tpu else 2
+        new = 128 if on_tpu else 8
+        t0_len = min(32, cfg.seq_len - new - 1)
+        params = module.init_params(jax.random.PRNGKey(0))
+        prompt = jnp.ones((B, t0_len), jnp.int32)
+        fn = jax.jit(
+            lambda p, pr: generate(module, p, pr, max_new_tokens=new)
+        )
+        jax.block_until_ready(fn(params, prompt))  # compile
+        tps = []
+        for _ in range(WINDOWS):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(params, prompt))
+            tps.append(B * new / (time.perf_counter() - t0))
+        med, _ = _median_spread(tps)
+        return round(med, 1)
+    except Exception as e:  # pragma: no cover - defensive
+        sys.stderr.write(f"generate bench skipped: {e}\n")
+        return None
+
+
 def _detect_backend() -> str:
     """Resolve the backend, degrading to CPU if the TPU runtime is
     unreachable (tunnel/service outage) — the harness must always get a
@@ -220,6 +248,7 @@ def main() -> None:
 
     raw_tps, raw_spread = _bench_raw_step(make_module(), cfg, batch_size)
     fit_tps, fit_spread = _bench_fit(make_module(), cfg, batch_size)
+    gen_tps = _bench_generate(make_module(), cfg, on_tpu)
 
     peak = _peak_flops_per_chip() if on_tpu else None
 
@@ -242,6 +271,7 @@ def main() -> None:
         "mfu_executed": mfu("causal"),
         "spread_pct": round(fit_spread, 2),
         "raw_spread_pct": round(raw_spread, 2),
+        "generate_tokens_per_sec": gen_tps,
         "windows": WINDOWS,
         "window_steps": WINDOW_STEPS,
         "bottleneck": "attention bwd kernel + scan residual-save HBM "
