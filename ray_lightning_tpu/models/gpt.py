@@ -83,31 +83,33 @@ class GPTConfig:
         return self.d_model // self.n_head
 
 
-def _layer_norm(x: jax.Array, g: jax.Array, b: jax.Array) -> jax.Array:
-    x32 = x.astype(jnp.float32)
-    mu = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.var(x32, axis=-1, keepdims=True)
-    y = (x32 - mu) * jax.lax.rsqrt(var + 1e-5)
-    return (y * g + b).astype(x.dtype)
+def _layer_norm(x: jax.Array, g: jax.Array, b: jax.Array,
+                use_pallas: bool = False) -> jax.Array:
+    """f32-stats LayerNorm; ``use_pallas`` opts single-chip callers into
+    the fused kernels (``ops/layer_norm.py`` — identical math)."""
+    from ray_lightning_tpu.ops.layer_norm import layer_norm
+
+    return layer_norm(x, g, b, use_pallas=use_pallas)
 
 
-def _mlp_residual(x: jax.Array, p: Dict[str, Any], c) -> jax.Array:
+def _mlp_residual(x: jax.Array, p: Dict[str, Any], c,
+                  ln_pallas: bool = False) -> jax.Array:
     """LN2 + GELU MLP + residual — the dense second half of a GPT block.
     Shape-agnostic over leading dims; shared by the training scan, the
     pipeline stage, and single-token decode so the block math has one
     source."""
-    h = _layer_norm(x, p["ln2_g"], p["ln2_b"])
+    h = _layer_norm(x, p["ln2_g"], p["ln2_b"], ln_pallas)
     h = jax.nn.gelu(h @ p["mlp_in_w"].astype(c) + p["mlp_in_b"].astype(c))
     return x + h @ p["mlp_out_w"].astype(c) + p["mlp_out_b"].astype(c)
 
 
-def _moe_residual(x, p, cfg, groups: int):
+def _moe_residual(x, p, cfg, groups: int, ln_pallas: bool = False):
     """LN2 + routed expert MLP + residual — the MoE second half of a GPT
     block.  Single source for the training scan and single-token decode
     (≙ the `_mlp_residual` discipline).  Returns ``(x, aux_loss)``."""
     from ray_lightning_tpu.ops.moe import moe_mlp
 
-    h = _layer_norm(x, p["ln2_g"], p["ln2_b"])
+    h = _layer_norm(x, p["ln2_g"], p["ln2_b"], ln_pallas)
     y, aux = moe_mlp(
         h, p["gate_w"], p["moe_in_w"], p["moe_in_b"],
         p["moe_out_w"], p["moe_out_b"],
@@ -349,13 +351,20 @@ class GPT(TpuModule):
         cfg = self.config
         c = self._compute_dtype()
         B, T = tokens.shape
+        # Fused-LN gate: same constraint as the CE kernels — pallas_call
+        # is opaque to the GSPMD partitioner, so single chip only.
+        mesh = getattr(getattr(self, "trainer", None), "mesh", None)
+        lnp = (
+            (mesh is None or getattr(mesh, "size", 1) == 1)
+            and jax.default_backend() == "tpu"
+        )
         x = self._constrain_residual(
             (params["wte"][tokens] + params["wpe"][:T]).astype(c)
         )
 
         def block(carry, p):
             x, aux = carry
-            h = _layer_norm(x, p["ln1_g"], p["ln1_b"])
+            h = _layer_norm(x, p["ln1_g"], p["ln1_b"], lnp)
             qkv = h @ p["qkv_w"].astype(c) + p["qkv_b"].astype(c)
             q, k, v = jnp.split(qkv, 3, axis=-1)
 
@@ -367,11 +376,11 @@ class GPT(TpuModule):
             x = x + att @ p["proj_w"].astype(c) + p["proj_b"].astype(c)
             if cfg.n_experts > 0:
                 x, layer_aux = _moe_residual(
-                    x, p, cfg, groups=self._moe_groups()
+                    x, p, cfg, groups=self._moe_groups(), ln_pallas=lnp
                 )
                 aux = aux + layer_aux
             else:
-                x = _mlp_residual(x, p, c)
+                x = _mlp_residual(x, p, c, lnp)
             return (self._constrain_residual(x), aux), None
 
         if self.remat:
@@ -395,7 +404,7 @@ class GPT(TpuModule):
         # Per-layer mean: the aux weight is depth-independent (balanced
         # routing ⇒ aux ≈ 1 at any n_layer).
         aux = aux / max(cfg.n_layer, 1)
-        x = _layer_norm(x, params["ln_f_g"], params["ln_f_b"])
+        x = _layer_norm(x, params["ln_f_g"], params["ln_f_b"], lnp)
         return x, aux
 
     # -- steps --------------------------------------------------------------

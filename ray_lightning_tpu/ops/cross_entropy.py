@@ -46,6 +46,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_lightning_tpu.ops.kernel_probe import _interpret
+
 __all__ = [
     "fused_lm_head_cross_entropy",
     "fused_lm_head_cross_entropy_sharded",
@@ -201,12 +203,6 @@ def _pad_vocab(wte, compute_dtype):
     return wp, vpad
 
 
-def _interpret() -> bool:
-    # Mosaic compiles only for TPU; CPU test meshes run the kernels under
-    # the Pallas interpreter (same program, host execution).
-    return jax.default_backend() != "tpu"
-
-
 def _vma_of(val) -> frozenset:
     """Manual mesh axes ``val`` varies over (empty outside shard_map)."""
     try:
@@ -286,30 +282,14 @@ def _pallas_fwd_ok(x, wte, targets, compute_dtype) -> bool:
     return d % 128 == 0 and d <= max_d
 
 
-_KERNELS_AVAILABLE: dict = {}
-
-# Exception shapes that mean "this kernel will never compile here" (cache
-# the fallback) vs transient runtime failures (fall back this call only,
-# retry next time — e.g. RESOURCE_EXHAUSTED while the device is full).
-_COMPILE_ERROR_MARKERS = ("mosaic", "vmem", "lower", "invalid_argument")
-
-
 def _kernel_path_available(d: int, compute_dtype) -> bool:
     """Per-(d, dtype) Mosaic probe: compile+run the fwd and both bwd
     kernels at the caller's feature dim and compute dtype (tile VMEM
     footprint depends on exactly these), falling back to the scan path
-    if the backend rejects them.  A training step must never die on a
-    kernel-compile error when a numerically identical fallback exists;
-    the probe turns "crash mid-fit on this TPU generation" into a
-    warning + slow path.  (Under the interpreter — CPU tests — the
-    kernels always work.)"""
-    if _interpret():
-        return True
-    key = (d, jnp.dtype(compute_dtype).name)
-    cached = _KERNELS_AVAILABLE.get(key)
-    if cached is not None:
-        return cached
-    try:
+    if the backend rejects them (see :mod:`.kernel_probe`)."""
+    from ray_lightning_tpu.ops.kernel_probe import kernel_available
+
+    def probe():
         x = jnp.ones((_CE_BLOCK_T, d), jnp.float32) * 0.01
         w = jnp.ones((_CE_BLOCK_V, d), jnp.float32) * 0.01
         t = jnp.zeros((_CE_BLOCK_T,), jnp.int32)
@@ -320,22 +300,10 @@ def _kernel_path_available(d: int, compute_dtype) -> bool:
             ).mean()
 
         jax.block_until_ready(jax.grad(probe_loss, argnums=(0, 1))(x, w))
-        _KERNELS_AVAILABLE[key] = True
-        return True
-    except Exception as e:
-        import warnings
 
-        msg = f"{type(e).__name__}: {e}"
-        permanent = isinstance(
-            e, (NotImplementedError, TypeError, ValueError)
-        ) or any(m in msg.lower() for m in _COMPILE_ERROR_MARKERS)
-        if permanent:
-            _KERNELS_AVAILABLE[key] = False
-        warnings.warn(
-            f"Pallas CE kernels unavailable for d={d} ({msg}); using the "
-            f"scan path{'' if permanent else ' for this call'}."
-        )
-        return False
+    return kernel_available(
+        ("ce", d, jnp.dtype(compute_dtype).name), probe
+    )
 
 
 def _ce_logits_tile(x_ref, w_ref, vi, block_v, vocab_size, vma=()):

@@ -1,0 +1,65 @@
+"""Shared Mosaic-availability probing for optional Pallas kernels.
+
+Every optional kernel in :mod:`ray_lightning_tpu.ops` has a numerically
+identical XLA/scan fallback; a training step must never die on a
+kernel-compile error when the fallback exists.  :func:`kernel_available`
+runs a caller-supplied probe (compile+execute the kernels at
+representative shapes) once per cache key and downgrades failures:
+
+* compile-class errors (Mosaic lowering, VMEM overflow, invalid
+  argument, and the standard Python signature errors) cache ``False`` —
+  the kernel will never work here, use the fallback permanently;
+* transient runtime errors (e.g. RESOURCE_EXHAUSTED while the device is
+  momentarily full) fall back for the current call only and re-probe
+  next time.
+
+Off-TPU (the Pallas interpreter) kernels always work: probes are
+skipped.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Hashable
+
+import jax
+
+__all__ = ["kernel_available", "_interpret"]
+
+_CACHE: dict = {}
+
+
+def _interpret() -> bool:
+    """Mosaic compiles only for TPU; every other backend (the CPU test
+    meshes) runs the kernels under the Pallas interpreter — the single
+    source for that decision across all optional kernels."""
+    return jax.default_backend() != "tpu"
+
+# Substrings that mark an exception as "will never compile here".
+_COMPILE_ERROR_MARKERS = ("mosaic", "vmem", "lower", "invalid_argument")
+
+
+def kernel_available(key: Hashable, probe: Callable[[], None]) -> bool:
+    """True when the kernels behind ``key`` work on this backend."""
+    if _interpret():
+        return True
+    cached = _CACHE.get(key)
+    if cached is not None:
+        return cached
+    try:
+        probe()
+        _CACHE[key] = True
+        return True
+    except Exception as e:
+        import warnings
+
+        msg = f"{type(e).__name__}: {e}"
+        permanent = isinstance(
+            e, (NotImplementedError, TypeError, ValueError)
+        ) or any(m in msg.lower() for m in _COMPILE_ERROR_MARKERS)
+        if permanent:
+            _CACHE[key] = False
+        warnings.warn(
+            f"Pallas kernels {key!r} unavailable ({msg}); using the "
+            f"fallback path{'' if permanent else ' for this call'}."
+        )
+        return False

@@ -361,6 +361,58 @@ class TestFusedCEPallas:
             type("C", (), {"mesh": None}), batch_dim=8)
 
 
+class TestFusedLayerNorm:
+    """Pallas LN kernels (interpret mode) vs the XLA reference math."""
+
+    def _inputs(self, n=700, d=256):  # n=700: exercises token padding
+        rng = jax.random.PRNGKey(11)
+        kx, kg, kb = jax.random.split(rng, 3)
+        x = jax.random.normal(kx, (4, n // 4, d), jnp.float32) * 3 + 1
+        g = jax.random.normal(kg, (d,), jnp.float32) * 0.5 + 1
+        b = jax.random.normal(kb, (d,), jnp.float32)
+        return x, g, b
+
+    def test_forward_and_grad_parity(self):
+        from ray_lightning_tpu.ops.layer_norm import layer_norm
+
+        x, g, b = self._inputs()
+
+        def lp(x, g, b):
+            return (layer_norm(x, g, b, use_pallas=True) ** 2).mean()
+
+        def ln(x, g, b):
+            return (layer_norm(x, g, b, use_pallas=False) ** 2).mean()
+
+        yp = layer_norm(x, g, b, use_pallas=True)
+        yn = layer_norm(x, g, b, use_pallas=False)
+        assert float(jnp.abs(yp - yn).max()) < 1e-5
+        gp = jax.grad(lp, argnums=(0, 1, 2))(x, g, b)
+        gn = jax.grad(ln, argnums=(0, 1, 2))(x, g, b)
+        for a, c, name in zip(gp, gn, ("dx", "dg", "db")):
+            err = float(jnp.abs(a - c).max())
+            assert err < 1e-5, f"{name} max err {err}"
+
+    def test_bf16_input(self):
+        from ray_lightning_tpu.ops.layer_norm import layer_norm
+
+        x, g, b = self._inputs(n=512, d=128)
+        xb = x.astype(jnp.bfloat16)
+        yp = layer_norm(xb, g, b, use_pallas=True)
+        yn = layer_norm(xb, g, b, use_pallas=False)
+        assert yp.dtype == jnp.bfloat16
+        assert float(jnp.abs(
+            yp.astype(jnp.float32) - yn.astype(jnp.float32)
+        ).max()) < 2e-2
+
+    def test_misaligned_d_falls_back(self):
+        from ray_lightning_tpu.ops.layer_norm import layer_norm
+
+        x, g, b = self._inputs(n=64, d=96)  # 96 % 128 != 0
+        yp = layer_norm(x, g, b, use_pallas=True)  # silently XLA
+        yn = layer_norm(x, g, b, use_pallas=False)
+        assert float(jnp.abs(yp - yn).max()) == 0.0
+
+
 @pytest.mark.parametrize("mesh_shape,axes", [
     ((8,), ("sp",)),
     ((2, 4), ("data", "sp")),

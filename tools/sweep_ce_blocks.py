@@ -57,7 +57,9 @@ def main() -> None:
     results = []
     for bt, bv in itertools.product(args.bt, args.bv):
         ce._CE_BLOCK_T, ce._CE_BLOCK_V = bt, bv
-        ce._KERNELS_AVAILABLE.clear()
+        from ray_lightning_tpu.ops import kernel_probe
+
+        kernel_probe._CACHE.clear()
         try:
             g = jax.jit(jax.value_and_grad(loss, argnums=(0, 1)))
             out = g(x, wte)
