@@ -180,6 +180,46 @@ def test_gpt_remat_matches_no_remat():
     )
 
 
+def test_kernel_ln_under_remat_matches_xla_ln(monkeypatch):
+    """Fused-LN custom_vjp composes with jax.checkpoint: a rematerialized
+    training step with the kernel LN forced on (interpret mode — the
+    single-TPU-chip configuration) matches the XLA-LN step."""
+    import ray_lightning_tpu.models.gpt as gptmod
+
+    cfg = tiny()
+    m = GPT(cfg, remat=True)
+    params = m.init_params(jax.random.PRNGKey(0))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (4, cfg.seq_len + 1), 0, cfg.vocab_size)
+
+    def loss(params):
+        return m.training_step(params, {"tokens": tokens}, None)[0]
+
+    l_base, g_base = jax.value_and_grad(loss)(params)
+
+    # Spy on the kernel entry so the test fails loudly if the gate ever
+    # silently falls back to XLA (which would compare XLA against XLA).
+    from ray_lightning_tpu.ops import layer_norm as lnmod
+
+    kernel_calls = []
+    real_fused = lnmod._fused_ln
+
+    def spying_fused(x, g, b):
+        kernel_calls.append(x.shape)
+        return real_fused(x, g, b)
+
+    monkeypatch.setattr(lnmod, "_fused_ln", spying_fused)
+    orig = gptmod._layer_norm
+    monkeypatch.setattr(
+        gptmod, "_layer_norm",
+        lambda x, g, b, up=False: orig(x, g, b, use_pallas=True))
+    l_k, g_k = jax.value_and_grad(loss)(params)
+    assert kernel_calls, "fused LN kernel path was never taken"
+    assert float(l_base) == pytest.approx(float(l_k), abs=1e-5)
+    for a, b_, in zip(jax.tree.leaves(g_base), jax.tree.leaves(g_k)):
+        assert float(jnp.abs(a - b_).max()) < 1e-4
+
+
 def test_gpt_shard_map_flavor_trains():
     """The Horovod-duality (shard_map) flavor must trace GPT cleanly —
     the residual sharding anchor is a gspmd-only concept and must no-op
