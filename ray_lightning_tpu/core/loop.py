@@ -74,6 +74,13 @@ class FitConfig:
     restart_every_n_epochs: Optional[int] = None
 
     def __post_init__(self):
+        # Lightning habit: limit_*_batches=None means "no limit" — accept
+        # it as a synonym for the -1 sentinel instead of crashing at the
+        # `>= 0` comparison deep in the loop.
+        if self.limit_train_batches is None:
+            self.limit_train_batches = -1
+        if self.limit_val_batches is None:
+            self.limit_val_batches = -1
         if self.fast_dev_run:
             self.max_epochs = 1
             self.limit_train_batches = 1
