@@ -16,12 +16,6 @@ from __future__ import annotations
 
 import argparse
 
-import jax
-
-from ray_lightning_tpu import Trainer
-from ray_lightning_tpu.models.gpt import GPT, GPTConfig, SyntheticLMDataModule
-from ray_lightning_tpu.parallel.strategies import LocalStrategy
-
 
 def train(
     num_epochs: int = 2,
@@ -30,6 +24,29 @@ def train(
     expert_shards: int = 2,
     smoke_test: bool = False,
 ):
+    if expert_shards < 1:
+        raise ValueError(f"expert_shards must be >= 1, got {expert_shards}")
+    # Self-provision a virtual device mesh when the host has too few
+    # devices (CI runs with no XLA_FLAGS) — must happen before the first
+    # jax import (≙ tpu_pipeline_example.py).
+    import os
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{2 * expert_shards}"
+        ).strip()
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import jax
+
+    from ray_lightning_tpu import Trainer
+    from ray_lightning_tpu.models.gpt import (
+        GPT, GPTConfig, SyntheticLMDataModule,
+    )
+    from ray_lightning_tpu.parallel.strategies import LocalStrategy
+
     if smoke_test:
         cfg = GPTConfig.tiny_moe(n_experts=n_experts)
         num_epochs = 1
@@ -41,8 +58,10 @@ def train(
     model = GPT(cfg)
 
     n_dev = jax.local_device_count()
+    # The expert axis must divide BOTH the device count (mesh factoring)
+    # and the expert count (expert-stacked weights shard along it).
     expert_shards = min(expert_shards, n_experts, n_dev)
-    while n_dev % expert_shards:  # expert axis must divide the devices
+    while n_dev % expert_shards or n_experts % expert_shards:
         expert_shards -= 1
     mesh_axes = {"data": n_dev // expert_shards, "expert": expert_shards}
     trainer = Trainer(

@@ -74,13 +74,33 @@ class FitConfig:
     restart_every_n_epochs: Optional[int] = None
 
     def __post_init__(self):
-        # Lightning habit: limit_*_batches=None means "no limit" — accept
-        # it as a synonym for the -1 sentinel instead of crashing at the
-        # `>= 0` comparison deep in the loop.
+        # Lightning habits: None means "no limit/cap" for these — accept
+        # it as a synonym for the framework's -1 sentinel instead of
+        # crashing at a `>= 0` comparison deep in the loop.  A None
+        # max_epochs additionally requires a real max_steps (otherwise
+        # the fit would never terminate); Lightning's default in that
+        # case is 1000 epochs, mirrored here as the range bound.
         if self.limit_train_batches is None:
             self.limit_train_batches = -1
         if self.limit_val_batches is None:
             self.limit_val_batches = -1
+        if self.max_steps is None:
+            self.max_steps = -1
+        if self.max_epochs is None:
+            self.max_epochs = 1000
+        # Precision aliases: Lightning 2.x spellings map onto the two
+        # real TPU modes (f32 / bf16 with f32 accumulation).  Anything
+        # else — notably fp16, which TPUs don't accelerate — is rejected
+        # loudly rather than silently training in f32.
+        aliases = {"32": "f32", "32-true": "f32", "float32": "f32",
+                   "bf16-mixed": "bf16", "bf16-true": "bf16",
+                   "bfloat16": "bf16"}
+        self.precision = aliases.get(str(self.precision), self.precision)
+        if self.precision not in ("f32", "bf16"):
+            raise ValueError(
+                f"precision {self.precision!r} unsupported on TPU: use "
+                f"'f32' or 'bf16' (accepted aliases: {sorted(aliases)})"
+            )
         if self.fast_dev_run:
             self.max_epochs = 1
             self.limit_train_batches = 1
