@@ -50,13 +50,98 @@ def _work_imbalance(n: int, layout: str) -> float:
     return max(per_dev) / ideal
 
 
+def _peak_hbm_mb() -> float | None:
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        if stats and "peak_bytes_in_use" in stats:
+            return round(stats["peak_bytes_in_use"] / 2**20, 1)
+    except Exception:  # noqa: BLE001 - not all runtimes expose stats
+        pass
+    return None
+
+
+def _time_attn(impl: str, S: int, B: int, H: int, D: int, reps: int = 5):
+    """Fwd+bwd wall time for one attention impl at (B, S, H, D); returns
+    (ms, tokens_per_sec, peak_hbm_mb) or an 'oom'/error marker string."""
+    from ray_lightning_tpu.ops.attention import causal_attention
+
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (B, S, H, D), jnp.bfloat16)
+    k, v = q * 0.99, q * 1.01
+
+    def fb(q, k, v):
+        g = jax.grad(
+            lambda q, k, v: causal_attention(q, k, v, impl=impl)
+            .astype(jnp.float32).sum(), argnums=(0, 1, 2),
+        )(q, k, v)
+        return sum(x.astype(jnp.float32).sum() for x in g)
+
+    try:
+        f = jax.jit(fb)
+        float(jax.device_get(f(q, k, v)))  # compile + one run
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            s = f(q, k, v)
+        float(jax.device_get(s))
+        dt = (time.perf_counter() - t0) / reps
+        return {
+            "ms": round(dt * 1000, 2),
+            "tokens_per_sec": round(B * S / dt, 1),
+            "peak_hbm_mb": _peak_hbm_mb(),
+        }
+    except Exception as e:  # noqa: BLE001 - OOM at long seq is a finding
+        msg = str(e).lower()
+        return "oom" if ("resource_exhausted" in msg or "memory" in msg) \
+            else f"error: {str(e)[:120]}"
+
+
+def _one_in_subprocess(impl: str, S: int, B: int, H: int, D: int):
+    """Run one (impl, S) measurement in a FRESH process so
+    ``peak_bytes_in_use`` (a process-lifetime monotone max) is the peak
+    of exactly this config — in-process, every entry after the first
+    would inherit the largest earlier peak."""
+    import os
+    import subprocess
+    import sys
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--one", impl,
+             str(S), str(B), str(H), str(D)],
+            capture_output=True, text=True, timeout=1200,
+        )
+    except subprocess.TimeoutExpired:
+        # One slow config (e.g. the O(S^2) XLA arm at 32k) must not
+        # discard the measurements already collected.
+        return "error: timeout (1200s)"
+    # The child prints one json.dumps value — a dict for a timed run,
+    # but a bare JSON string ("oom", "error: ...") for a failed one.
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    return f"error: subprocess rc={proc.returncode}: {proc.stderr[-200:]}"
+
+
 def main() -> None:
+    import sys
+
+    if len(sys.argv) > 1 and sys.argv[1] == "--one":
+        impl, S, B, H, D = sys.argv[2], *map(int, sys.argv[3:7])
+        from bench import _detect_backend
+
+        _detect_backend()
+        print(json.dumps(_time_attn(impl, S, B, H, D)))
+        return
+
     from bench import _detect_backend
 
     on_tpu = _detect_backend() == "tpu"
-    S, B, H, D = 4096, 4, 12, 64
+    H, D = 12, 64
     result = {
-        "metric": "long_context_seq4096",
+        "metric": "long_context_flash_vs_xla",
+        "backend": "tpu" if on_tpu else "cpu",
         # Max-device work / ideal share (1.0 = balanced): the ring's
         # causal wall-clock multiplier per layout, 8-way ring.
         "ring_imbalance_contiguous": round(
@@ -64,32 +149,22 @@ def main() -> None:
         "ring_imbalance_zigzag": round(_work_imbalance(8, "zigzag"), 3),
     }
     if on_tpu:
-        from ray_lightning_tpu.ops.flash_attention import flash_attention
-
-        key = jax.random.PRNGKey(0)
-        q = jax.random.normal(key, (B, S, H, D), jnp.bfloat16)
-        k, v = q * 0.99, q * 1.01
-
-        def fb(q, k, v):
-            g = jax.grad(
-                lambda q, k, v: flash_attention(q, k, v)
-                .astype(jnp.float32).sum(), argnums=(0, 1, 2),
-            )(q, k, v)
-            return sum(x.astype(jnp.float32).sum() for x in g)
-
-        f = jax.jit(fb)
-        s = f(q, k, v)
-        float(jax.device_get(s))
-        t0 = time.perf_counter()
-        for _ in range(10):
-            s = f(q, k, v)
-        float(jax.device_get(s))
-        dt = (time.perf_counter() - t0) / 10
-        result.update({
-            "flash_seq4096_fwd_bwd_ms_single_chip": round(dt * 1000, 2),
-            "flash_seq4096_tokens_per_sec": round(B * S / dt, 1),
-        })
+        # The O(S·D)-memory flash kernel vs the O(S²) XLA einsum across
+        # the long-context sweep (VERDICT r4 next #7).  Batch shrinks
+        # with seq so the flash config always fits; an XLA OOM at long
+        # seq is itself the datapoint.  One subprocess per entry so each
+        # peak-HBM number is isolated.
+        sweep = {}
+        for S, B in ((4096, 4), (8192, 2), (16384, 1), (32768, 1)):
+            sweep[str(S)] = {
+                "batch": B,
+                "flash": _one_in_subprocess("flash", S, B, H, D),
+                "xla": _one_in_subprocess("xla", S, B, H, D),
+            }
+        result["seq_sweep_fwd_bwd"] = sweep
     print(json.dumps(result))
+    with open("BENCH_LONGCTX.json", "w") as f:
+        json.dump(result, f, indent=1)
 
 
 if __name__ == "__main__":

@@ -26,6 +26,23 @@ from . import rpc
 
 __all__ = ["DriverQueue", "QueueHandle"]
 
+# Hard ceiling on the 1-byte ack read.  The ack read must never block
+# forever while holding the handle lock: if the driver process is alive
+# but its reader thread is wedged, a bare ``recv`` would hang every
+# worker ``put`` with no failover.  A timeout surfaces as
+# ``socket.timeout`` (an ``OSError``) and flows into the close-and-raise
+# path, which the caller's reconnect retry handles.
+_ACK_TIMEOUT_S = 60.0
+# The frame send gets a size-scaled budget instead: checkpoint thunks
+# can be GBs, and a Python socket timeout caps sendall's TOTAL duration
+# — a fixed 60s would hard-fail any payload needing longer on a slow
+# inter-host link.  Budget assumes worst-case ~1 MiB/s sustained.
+_MIN_SEND_THROUGHPUT = 1 << 20  # bytes/s
+
+
+def _send_timeout_s(payload_bytes: int) -> float:
+    return max(_ACK_TIMEOUT_S, payload_bytes / _MIN_SEND_THROUGHPUT)
+
 
 class QueueHandle:
     """Picklable client handle to a :class:`DriverQueue`.
@@ -96,7 +113,9 @@ class QueueHandle:
     def _put_once(self, payload: bytes) -> None:
         sock = self._connect()
         try:
+            sock.settimeout(_send_timeout_s(len(payload)))
             rpc.send_frame(sock, payload)
+            sock.settimeout(_ACK_TIMEOUT_S)
             ack = sock.recv(1)
         except Exception:
             # The frame may be half-sent or its ack still in flight; the

@@ -92,10 +92,25 @@ class FitConfig:
         # real TPU modes (f32 / bf16 with f32 accumulation).  Anything
         # else — notably fp16, which TPUs don't accelerate — is rejected
         # loudly rather than silently training in f32.
+        # Lossy aliases change semantics, not just spelling: Lightning's
+        # '-true' means the WEIGHTS are cast to bf16, but this framework
+        # only implements mixed bf16 (f32 params + optimizer state, bf16
+        # compute) — coerce, but say so, since memory footprint and
+        # numerics differ from what was asked for.
+        lossy = {"bf16-true": "bf16"}
         aliases = {"32": "f32", "32-true": "f32", "float32": "f32",
-                   "bf16-mixed": "bf16", "bf16-true": "bf16",
-                   "bfloat16": "bf16"}
-        self.precision = aliases.get(str(self.precision), self.precision)
+                   "bf16-mixed": "bf16", "bfloat16": "bf16", **lossy}
+        raw = str(self.precision)
+        if raw in lossy:
+            import warnings
+
+            warnings.warn(
+                f"precision={raw!r} (bf16 weights) is not implemented on "
+                f"this framework; using mixed bf16 instead (f32 "
+                f"params/optimizer state, bf16 matmuls). Pass "
+                f"'bf16-mixed' to silence this warning."
+            )
+        self.precision = aliases.get(raw, self.precision)
         if self.precision not in ("f32", "bf16"):
             raise ValueError(
                 f"precision {self.precision!r} unsupported on TPU: use "
@@ -263,14 +278,40 @@ def _log_lr(ctx: "LoopContext", lr_schedule) -> None:
     )
 
 
-def _mean_logs(device_logs: List[Dict[str, Any]]) -> Dict[str, float]:
-    if not device_logs:
-        return {}
-    host_logs = jax.device_get(device_logs)
-    out: Dict[str, float] = {}
-    for k in host_logs[0]:
-        out[k] = float(np.mean([float(d[k]) for d in host_logs]))
-    return out
+class _RunningMeanLogs:
+    """Bounded per-epoch accumulator for device-scalar step logs.
+
+    Keeps ONE live device buffer per metric (a running sum updated
+    eagerly each step) instead of one dict of device scalars per
+    micro-batch: at 10k steps/epoch the list form is tens of thousands
+    of live tiny buffers plus a large end-of-epoch host sync.  The sum
+    is carried in f32 regardless of the logged dtype — a bf16 running
+    sum would stop absorbing per-step increments once it exceeds ~256x
+    their size (7-bit mantissa), silently biasing long-epoch means.
+    """
+
+    def __init__(self) -> None:
+        self._sum: Optional[Dict[str, Any]] = None
+        self._n = 0
+
+    def update(self, logs: Dict[str, Any]) -> None:
+        if self._sum is None:
+            self._sum = {
+                k: jnp.asarray(v).astype(jnp.float32)
+                for k, v in logs.items()
+            }
+        else:
+            self._sum = {
+                k: self._sum[k] + jnp.asarray(logs[k]).astype(jnp.float32)
+                for k in self._sum
+            }
+        self._n += 1
+
+    def result(self) -> Dict[str, float]:
+        if self._sum is None:
+            return {}
+        host = jax.device_get(self._sum)
+        return {k: float(v) / self._n for k, v in host.items()}
 
 
 def init_train_state(
@@ -377,14 +418,14 @@ def _run_validation(
     ctx: LoopContext,
     limit: int,
 ) -> Dict[str, float]:
-    device_logs = []
+    acc = _RunningMeanLogs()
     for i, batch in enumerate(loader):
         if limit >= 0 and i >= limit:
             break
-        device_logs.append(
+        acc.update(
             eval_step(ctx.state.params, _place_batch(batch, ctx.mesh))
         )
-    return _mean_logs(device_logs)
+    return acc.result()
 
 
 def run_fit(
@@ -460,6 +501,22 @@ def run_fit(
                 state_stream_from_file(config.resume_from_checkpoint)
             )
         host_state = payload["state"]
+        # Reconcile checkpoint dtypes with THIS run's state template: a
+        # dtype-policy change between runs (e.g. AdamW mu f32 → bf16,
+        # models/gpt.py ``mu_dtype``) must not leak the old dtype into
+        # the new run — it would silently recompile the step against a
+        # mixed-dtype state and diverge from a fresh run's numerics.
+        host_state = jax.tree_util.tree_map(
+            lambda tmpl, leaf: leaf.astype(tmpl.dtype)
+            if (
+                hasattr(tmpl, "dtype")
+                and hasattr(leaf, "astype")
+                and tmpl.dtype != leaf.dtype
+            )
+            else leaf,
+            state,
+            host_state,
+        )
         if mesh is None:
             state = jax.device_put(host_state)
         else:
@@ -527,7 +584,7 @@ def run_fit(
         module.on_train_epoch_start(epoch)
         _call_hooks(callbacks, "on_train_epoch_start", ctx, module)
 
-        epoch_logs: List[Dict[str, Any]] = []
+        epoch_mean = _RunningMeanLogs()
         # Cap the source BEFORE prefetching so the producer thread never
         # device-places batches past the limit/max_steps boundary.  The
         # +1 keeps one sentinel batch flowing so the in-loop checks (which
@@ -566,7 +623,7 @@ def run_fit(
                 break
             rng = jax.random.fold_in(base_rng, ctx.micro_step)
             ctx.state, logs = train_step(ctx.state, gbatch, rng)
-            epoch_logs.append(logs)
+            epoch_mean.update(logs)
             ctx.micro_step += 1
             since_update += 1
             if since_update == accum:
@@ -596,7 +653,7 @@ def run_fit(
             ctx.global_step += 1
             since_update = 0  # the flush reset MultiSteps' window
 
-        train_metrics = _mean_logs(epoch_logs)
+        train_metrics = epoch_mean.result()
         ctx.log_metrics(train_metrics)
         _log_lr(ctx, lr_schedule)
         module.on_train_epoch_end(epoch, train_metrics)

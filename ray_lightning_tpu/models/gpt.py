@@ -61,6 +61,9 @@ class GPTConfig:
     # state's HBM read+write in the (bandwidth-bound) optimizer update
     # with no measurable loss-curve effect at LM scale; the variance and
     # params stay f32.  Set to "float32" for bit-conservative runs.
+    # Resume across a dtype change is safe: the fit loop casts restored
+    # optimizer-state leaves to this run's template dtypes on load
+    # (core/loop.py resume path), so f32-era checkpoints restore cleanly.
     mu_dtype: str = "bfloat16"
 
     @classmethod

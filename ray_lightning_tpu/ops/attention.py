@@ -60,6 +60,10 @@ def xla_causal_attention(
 
 
 def _flash_supported(q: jax.Array) -> bool:
+    from ray_lightning_tpu.ops.kernel_probe import kernel_family_disabled
+
+    if kernel_family_disabled("flash"):
+        return False
     try:
         platform = jax.default_backend()
     except Exception:  # noqa: BLE001

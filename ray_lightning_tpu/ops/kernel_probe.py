@@ -6,12 +6,13 @@ kernel-compile error when the fallback exists.  :func:`kernel_available`
 runs a caller-supplied probe (compile+execute the kernels at
 representative shapes) once per cache key and downgrades failures:
 
-* compile-class errors (Mosaic lowering, VMEM overflow, invalid
-  argument, and the standard Python signature errors) cache ``False`` —
-  the kernel will never work here, use the fallback permanently;
-* transient runtime errors (e.g. RESOURCE_EXHAUSTED while the device is
-  momentarily full) fall back for the current call only and re-probe
-  next time.
+* compile-class errors (``NotImplementedError``, or any message naming
+  Mosaic, VMEM, lowering, or INVALID_ARGUMENT) cache ``False`` — the
+  kernel will never work here, use the fallback permanently;
+* everything else — including bare ``ValueError``/``TypeError``, which
+  can be raised transiently at dispatch time under momentary device
+  pressure — falls back for the current call only and re-probes next
+  time.
 
 Off-TPU (the Pallas interpreter) kernels always work: probes are
 skipped.
@@ -19,13 +20,24 @@ skipped.
 
 from __future__ import annotations
 
+import os
 from typing import Callable, Hashable
 
 import jax
 
-__all__ = ["kernel_available", "_interpret"]
+__all__ = ["kernel_available", "kernel_family_disabled", "_interpret"]
 
 _CACHE: dict = {}
+
+
+def kernel_family_disabled(family: str) -> bool:
+    """A/B switch for on-hardware kernel experiments: set
+    ``RLT_DISABLE_KERNELS=ce,ln,flash`` (any subset) to force the
+    fallback path for those kernel families.  Read per call, so one
+    process can bench both arms.  ``bench.py``'s ``kernel_path`` field
+    reports the effective result."""
+    raw = os.environ.get("RLT_DISABLE_KERNELS", "")
+    return family in {s.strip() for s in raw.split(",") if s.strip()}
 
 
 def _interpret() -> bool:
@@ -34,12 +46,30 @@ def _interpret() -> bool:
     source for that decision across all optional kernels."""
     return jax.default_backend() != "tpu"
 
-# Substrings that mark an exception as "will never compile here".
-_COMPILE_ERROR_MARKERS = ("mosaic", "vmem", "lower", "invalid_argument")
+# Substrings that mark an exception as "will never compile here".  Kept
+# compiler-specific on purpose: a bare ValueError/TypeError raised at
+# dispatch time (e.g. under momentary device pressure) must stay
+# retryable, so generic words like "lower" alone do not qualify.
+_COMPILE_ERROR_MARKERS = (
+    "mosaic",
+    "vmem",
+    "invalid_argument",
+    "failed to lower",
+    "lowering rule",
+    "unsupported lowering",
+    "not implemented",
+)
 
 
 def kernel_available(key: Hashable, probe: Callable[[], None]) -> bool:
-    """True when the kernels behind ``key`` work on this backend."""
+    """True when the kernels behind ``key`` work on this backend.
+
+    Keys are ``(family, ...)`` tuples; a family disabled via
+    ``RLT_DISABLE_KERNELS`` reports unavailable regardless of backend.
+    """
+    family = key[0] if isinstance(key, tuple) and key else str(key)
+    if kernel_family_disabled(family):
+        return False
     if _interpret():
         return True
     cached = _CACHE.get(key)
@@ -53,9 +83,9 @@ def kernel_available(key: Hashable, probe: Callable[[], None]) -> bool:
         import warnings
 
         msg = f"{type(e).__name__}: {e}"
-        permanent = isinstance(
-            e, (NotImplementedError, TypeError, ValueError)
-        ) or any(m in msg.lower() for m in _COMPILE_ERROR_MARKERS)
+        permanent = isinstance(e, NotImplementedError) or any(
+            m in msg.lower() for m in _COMPILE_ERROR_MARKERS
+        )
         if permanent:
             _CACHE[key] = False
         warnings.warn(

@@ -86,10 +86,11 @@ def zero_state_shardings(
     """NamedShardings for a :class:`TrainState`-shaped pytree.
 
     * stage 0 — everything replicated (plain DDP).
-    * stage 1/2 — optimizer state sharded, params replicated (≙ FairScale
+    * stage 1 — optimizer state sharded, params replicated (≙ FairScale
       OSS; in JAX gradients are transient values inside one XLA program,
-      so the stage-2 "shard gradients too" distinction collapses into the
-      compiler's scheduling — nothing extra to annotate).
+      so FairScale's stage-2 "shard gradients too" distinction collapses
+      into the compiler's scheduling — ``RayShardedStrategy`` normalizes
+      ``zero_stage=2`` to 1 with a warning).
     * stage 3 — params sharded as well (FSDP-style; XLA all-gathers just
       before use, reduce-scatters gradients).
 

@@ -584,6 +584,16 @@ class RayShardedStrategy(TpuStrategy):
     shards parameters (FSDP-style).  Implemented purely as NamedSharding
     annotations on the train state — no wrapper classes
     (SURVEY §7: "sharding is an annotation").
+
+    ``zero_stage=2`` ("shard gradients too", FairScale SDP /
+    ``ray_ddp_sharded.py:17-34``) is accepted for compatibility but
+    **normalized to stage 1 with a warning**: under GSPMD, gradients are
+    transient values inside one jitted step — they are never materialized
+    as persistent per-rank state, and XLA already reduce-scatters them
+    where profitable — so there is nothing extra to annotate and no
+    distinct stage-2 memory behavior to select.  A benchmark labeled
+    stage 2 would measure exactly stage 1; the normalization keeps users
+    from misreporting what they ran.
     """
 
     mode = "gspmd"
@@ -592,6 +602,17 @@ class RayShardedStrategy(TpuStrategy):
         super().__init__(*args, **kwargs)
         if zero_stage not in (1, 2, 3):
             raise ValueError("zero_stage must be 1, 2 or 3")
+        if zero_stage == 2:
+            import warnings
+
+            warnings.warn(
+                "zero_stage=2 is equivalent to zero_stage=1 on this "
+                "framework (GSPMD gradients are transient inside the "
+                "jitted step; XLA reduce-scatters them automatically). "
+                "Normalizing to zero_stage=1 — pass 1 or 3 explicitly "
+                "to silence this warning."
+            )
+            zero_stage = 1
         self.zero_stage = zero_stage
 
 

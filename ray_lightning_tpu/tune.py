@@ -75,11 +75,17 @@ def _driver_write_checkpoint(
                 checkpoint=_ray_tune.Checkpoint.from_directory(tmp),
             )
         return
-    from ray_lightning_tpu.tuning.session import checkpoint_dir
+    from ray_lightning_tpu.tuning.session import (
+        checkpoint_dir, get_trial_session,
+    )
 
     path = os.path.join(checkpoint_dir(step), filename)
     with open(path, "wb") as f:
         f.write(payload)
+    # Record the exact FILE for PBT's exploit step: a later trial handed
+    # this path via restore_path can feed it straight to
+    # ``Trainer(resume_from_checkpoint=...)``.
+    get_trial_session().note_checkpoint(path)
     if metrics:
         _driver_report(metrics)
 

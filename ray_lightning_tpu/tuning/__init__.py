@@ -11,6 +11,7 @@ from .schedulers import ASHAScheduler, FIFOScheduler, PopulationBasedTraining
 from .session import (
     TrialStopRequested,
     checkpoint_dir,
+    get_checkpoint,
     get_trial_session,
     is_trial_session_enabled,
     report,
@@ -30,6 +31,7 @@ __all__ = [
     "PopulationBasedTraining",
     "TrialStopRequested",
     "checkpoint_dir",
+    "get_checkpoint",
     "get_trial_session",
     "is_trial_session_enabled",
     "report",

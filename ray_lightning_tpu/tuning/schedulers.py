@@ -95,7 +95,13 @@ class PopulationBasedTraining(FIFOScheduler):
     sequential trial execution the same search dynamic is approximated:
     when a trial underperforms the population's best at a perturbation
     interval, it is stopped, and :meth:`next_config` seeds the following
-    trial from the best trial's config with mutated hyperparameters.
+    trial from the best trial's config with mutated hyperparameters
+    (explore) — while the tuner hands that trial the best trial's latest
+    CHECKPOINT (exploit), via the trial session's ``restore_path``, so it
+    continues from the donor's weights rather than from scratch
+    (≙ reference ``_TuneCheckpointCallback``'s purpose, ``tune.py:
+    136-178``: the weights transfer is the half of PBT that makes it
+    work).  Trainables opt in with ``tuning.get_checkpoint()``.
     """
 
     def __init__(
@@ -142,6 +148,12 @@ class PopulationBasedTraining(FIFOScheduler):
         )
         cutoff = sorted(self._scores)[idx]
         return STOP if score > cutoff else CONTINUE
+
+    @property
+    def best_trial_id(self) -> Optional[str]:
+        """The exploit donor: the trial whose config (and checkpoint)
+        seeds the next trial."""
+        return self._best[1] if self._best is not None else None
 
     def next_config(self, base_config: Dict[str, Any]) -> Dict[str, Any]:
         """Exploit-and-explore: start from the best config, mutate."""

@@ -24,6 +24,7 @@ __all__ = [
     "is_trial_session_enabled",
     "report",
     "checkpoint_dir",
+    "get_checkpoint",
 ]
 
 
@@ -43,12 +44,22 @@ class TrialSession:
         trial_id: str,
         local_dir: str,
         on_report: Optional[Callable[[Dict[str, Any]], str]] = None,
+        restore_path: Optional[str] = None,
     ):
         self.trial_id = trial_id
         self.local_dir = local_dir
         self._on_report = on_report
         self.reports: list = []
         self.training_iteration = 0
+        # Checkpoint this trial should START from (PBT exploit: the donor
+        # trial's weights — reference ``tune.py:136-178``'s reason to
+        # exist).  Read by the trainable via :func:`get_checkpoint`.
+        self.restore_path = restore_path
+        # Most recent checkpoint this trial WROTE (file path when written
+        # through ``_driver_write_checkpoint``, dir when the user only
+        # called :meth:`checkpoint_dir`).  The tuner harvests it so a
+        # later exploited trial can restore from it.
+        self.last_checkpoint: Optional[str] = None
 
     def report(self, **metrics: Any) -> None:
         self.training_iteration += 1
@@ -66,39 +77,79 @@ class TrialSession:
             self.local_dir, self.trial_id, f"checkpoint_{step:06d}"
         )
         os.makedirs(path, exist_ok=True)
+        self.last_checkpoint = path
         return path
 
+    def note_checkpoint(self, path: str) -> None:
+        """Record the exact file a checkpoint writer produced (sharper
+        than the dir from :meth:`checkpoint_dir` — directly consumable by
+        ``Trainer(resume_from_checkpoint=...)``)."""
+        self.last_checkpoint = path
 
-_lock = threading.Lock()
-_session: Optional[TrialSession] = None
+
+# THREAD-local, not process-global: ``tune_run(max_concurrent_trials=N)``
+# runs each trial driver in its own thread, and everything a trial's fit
+# touches (report thunks, checkpoint writes, queue pumping) runs in that
+# same thread — so thread identity IS trial identity.  A registry of
+# active sessions backs the sequential-mode fallback: with exactly ONE
+# active session, a call from a foreign thread (a user's helper/monitor
+# thread inside the trainable) unambiguously belongs to it — the
+# behavior the old process-global provided.  Only under real trial
+# concurrency is a foreign-thread call ambiguous, and then it raises.
+_tls = threading.local()
+_registry_lock = threading.Lock()
+_active: dict = {}  # id(session) -> session
+
+
+def _current() -> Optional[TrialSession]:
+    sess = getattr(_tls, "session", None)
+    if sess is not None:
+        return sess
+    with _registry_lock:
+        if len(_active) == 1:
+            return next(iter(_active.values()))
+    return None
 
 
 def init_trial_session(*args, **kwargs) -> TrialSession:
-    global _session
-    with _lock:
-        if _session is not None:
-            raise ValueError("A trial session is already active.")
-        _session = TrialSession(*args, **kwargs)
-        return _session
+    if getattr(_tls, "session", None) is not None:
+        raise ValueError("A trial session is already active.")
+    sess = TrialSession(*args, **kwargs)
+    _tls.session = sess
+    with _registry_lock:
+        _active[id(sess)] = sess
+    return sess
 
 
 def get_trial_session() -> TrialSession:
-    if _session is None:
+    sess = _current()
+    if sess is None:
+        with _registry_lock:
+            n = len(_active)
+        if n > 1:
+            raise ValueError(
+                f"{n} trial sessions are active but this thread owns "
+                f"none of them; under max_concurrent_trials>1, "
+                f"report()/checkpoint calls must run in the trial's own "
+                f"thread (or a thread it created that sets no session)."
+            )
         raise ValueError(
             "No trial session is active; report() must run inside a "
             "tune_run trial (driver process)."
         )
-    return _session
+    return sess
 
 
 def shutdown_trial_session() -> None:
-    global _session
-    with _lock:
-        _session = None
+    sess = getattr(_tls, "session", None)
+    if sess is not None:
+        with _registry_lock:
+            _active.pop(id(sess), None)
+    _tls.session = None
 
 
 def is_trial_session_enabled() -> bool:
-    return _session is not None
+    return _current() is not None
 
 
 def report(**metrics: Any) -> None:
@@ -108,3 +159,25 @@ def report(**metrics: Any) -> None:
 
 def checkpoint_dir(step: int) -> str:
     return get_trial_session().checkpoint_dir(step)
+
+
+def get_checkpoint() -> Optional[str]:
+    """Checkpoint path this trial should resume from, or None.
+
+    ≙ Ray Tune's ``session.get_checkpoint()``: a PBT-exploited trial
+    receives the donor trial's latest checkpoint here, so the trainable
+    can pass it to ``Trainer(resume_from_checkpoint=...)`` and continue
+    from the donor's WEIGHTS, not just its config.  Returns None for
+    trials starting fresh (or outside any trial session, so trainables
+    can call it unconditionally).
+
+    The value is a state-stream FILE when the donor checkpointed through
+    the framework's callbacks (or wrote a single/conventionally-named
+    file into ``checkpoint_dir``); a donor that wrote a custom
+    multi-file layout yields its checkpoint DIRECTORY instead — such a
+    trainable restores by its own convention.
+    """
+    sess = _current()
+    if sess is None:
+        return None
+    return sess.restore_path

@@ -7,9 +7,16 @@ same way (SURVEY §3.3): each trial's trainable constructs a Trainer with a
 (possibly multi-worker) strategy; metric reports flow worker → queue →
 driver thunk → trial session → scheduler.
 
-Trials execute sequentially in the driver process — on a TPU pod the
-accelerator is a single shared resource, so trial-parallelism is
-cross-slice (multiple drivers), not in-process.
+Trials execute sequentially by default — on a TPU pod the accelerator is
+a single shared resource, so a trial usually needs the whole slice.
+``tune_run(max_concurrent_trials=N)`` opts into N concurrent trial
+drivers (one thread each, thread-local trial sessions): the mode for
+N independent slices/hosts (each trial's strategy claiming its own
+workers via its backend) or for N small ``LocalStrategy`` trials
+sharing one host.  ``tune.get_tune_resources`` remains the placement
+contract for REAL Ray Tune (PlacementGroupFactory when Ray is
+installed); the native runner's resource model is just
+``max_concurrent_trials``.
 """
 
 from __future__ import annotations
@@ -106,6 +113,7 @@ def tune_run(
     seed: int = 0,
     raise_on_trial_error: bool = False,
     verbose: bool = True,
+    max_concurrent_trials: int = 1,
 ) -> ExperimentAnalysis:
     """Run an experiment: sample configs, execute trials, schedule stops.
 
@@ -113,25 +121,87 @@ def tune_run(
     is active, so TuneReportCallback thunks arriving through the
     distributed queue report into this trial (≙ SURVEY §3.3's
     "report runs on the driver" indirection).
+
+    **Concurrency** (≙ reference trials under placement groups,
+    ``tune.py:32-56``): ``max_concurrent_trials=N`` runs up to N trial
+    DRIVERS concurrently, each in its own thread with its own
+    thread-local trial session.  Each driver's trainable builds its own
+    Trainer/strategy whose workers claim their own accelerator resources
+    — e.g. one RemoteBackend slice per trial, or N ``LocalStrategy``
+    trials sharing the host.  The default (1) is strict sequential,
+    which is the right mode when every trial needs the whole TPU slice.
+    Schedulers are shared and lock-protected; PBT exploits from whatever
+    population state exists when a trial STARTS (the same asynchronous
+    semantics real concurrent PBT has).
     """
+    import threading
+
     scheduler = scheduler or FIFOScheduler()
     configs = generate_trials(config, num_samples=num_samples, seed=seed)
     os.makedirs(local_dir, exist_ok=True)
-    trials: List[Trial] = []
-    for i, cfg in enumerate(configs):
-        if isinstance(scheduler, PopulationBasedTraining) and i > 0:
-            cfg = scheduler.next_config(cfg)
-        trial = Trial(f"trial_{i:04d}", cfg)
-        trials.append(trial)
-        if isinstance(scheduler, PopulationBasedTraining):
-            scheduler.register_trial(trial.trial_id, cfg)
+    if max_concurrent_trials < 1:
+        raise ValueError("max_concurrent_trials must be >= 1")
+    trials: List[Optional[Trial]] = [None] * len(configs)
+    # Latest checkpoint each trial wrote — the donor pool for PBT's
+    # exploit step (config mutation alone is only half of PBT; the
+    # exploited trial must also START from the donor's weights).
+    last_ckpts: Dict[str, Optional[str]] = {}
+    # One lock guards every shared structure (scheduler state, the
+    # donor-checkpoint pool, trial report lists read by the scheduler).
+    lock = threading.Lock()
+
+    def _resolve_ckpt_file(path: Optional[str]) -> Optional[str]:
+        """last_checkpoint may be a DIRECTORY (trainable used the bare
+        ``checkpoint_dir`` API rather than the checkpoint callback).
+        Resolve to something the trainable can consume: a lone file, or
+        the newest conventionally-named stream file (``checkpoint*`` /
+        ``ckpt*`` — what the framework's callbacks write and
+        ``Trainer(resume_from_checkpoint=...)`` reads).  A multi-file
+        custom layout is returned as the directory itself — a trainable
+        that wrote its own format knows its own layout, and guessing a
+        member file would feed garbage to ``resume_from_checkpoint``."""
+        if path is None or os.path.isfile(path):
+            return path
+        if os.path.isdir(path):
+            files = [
+                os.path.join(path, f) for f in os.listdir(path)
+                if os.path.isfile(os.path.join(path, f))
+            ]
+            if len(files) == 1:
+                return files[0]
+            conventional = [
+                f for f in files
+                if os.path.basename(f).startswith(("checkpoint", "ckpt"))
+            ]
+            if conventional:
+                return max(conventional, key=os.path.getmtime)
+            if files:
+                return path  # custom multi-file layout: hand over the dir
+        return None
+
+    def run_one(i: int, cfg: Dict[str, Any]) -> None:
+        with lock:
+            restore_path: Optional[str] = None
+            if isinstance(scheduler, PopulationBasedTraining) and i > 0:
+                cfg = scheduler.next_config(cfg)
+                donor = scheduler.best_trial_id
+                if donor is not None:
+                    restore_path = _resolve_ckpt_file(
+                        last_ckpts.get(donor)
+                    )
+            trial = Trial(f"trial_{i:04d}", cfg)
+            trials[i] = trial
+            if isinstance(scheduler, PopulationBasedTraining):
+                scheduler.register_trial(trial.trial_id, cfg)
 
         def on_report(record: Dict[str, Any], _trial=trial) -> str:
-            _trial.reports.append(record)
-            return scheduler.on_result(_trial.trial_id, record)
+            with lock:
+                _trial.reports.append(record)
+                return scheduler.on_result(_trial.trial_id, record)
 
         session = init_trial_session(
-            trial.trial_id, local_dir, on_report=on_report
+            trial.trial_id, local_dir, on_report=on_report,
+            restore_path=restore_path,
         )
         trial.status = "RUNNING"
         t0 = time.perf_counter()
@@ -148,8 +218,11 @@ def tune_run(
                 raise
         finally:
             trial.duration_s = time.perf_counter() - t0
+            with lock:
+                last_ckpts[trial.trial_id] = session.last_checkpoint
             shutdown_trial_session()
-        scheduler.on_trial_complete(trial.trial_id, trial.last_result)
+        with lock:
+            scheduler.on_trial_complete(trial.trial_id, trial.last_result)
         if verbose:
             last = trial.last_result.get(metric)
             print(
@@ -158,4 +231,28 @@ def tune_run(
                 f"{last if last is not None else 'n/a'} config={cfg}",
                 flush=True,
             )
-    return ExperimentAnalysis(trials, metric, mode)
+
+    if max_concurrent_trials == 1:
+        # Inline (no worker thread): user trainables keep main-thread
+        # affordances like signal handlers, and raise_on_trial_error
+        # stops at the FIRST failure exactly as before.
+        for i, cfg in enumerate(configs):
+            run_one(i, cfg)
+    else:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(
+            max_workers=max_concurrent_trials,
+            thread_name_prefix="rlt-trial",
+        ) as pool:
+            futures = [
+                pool.submit(run_one, i, cfg)
+                for i, cfg in enumerate(configs)
+            ]
+            errors = [f.exception() for f in futures]
+        first = next((e for e in errors if e is not None), None)
+        if first is not None:  # only when raise_on_trial_error
+            raise first
+    return ExperimentAnalysis(
+        [t for t in trials if t is not None], metric, mode
+    )

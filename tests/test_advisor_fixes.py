@@ -299,3 +299,151 @@ def test_bench_reports_both_mfu_conventions():
     cfg = bench.GPTConfig.tiny()
     attn_full = 3.0 * 4 * cfg.n_layer * cfg.seq_len * cfg.d_model
     assert cfg_flops_full - cfg_flops_causal == pytest.approx(attn_full / 2)
+
+
+# -- (r4-a) kernel_probe: transient vs permanent classification --------------
+
+def test_kernel_probe_bare_valueerror_is_retryable(monkeypatch):
+    """A bare ValueError (e.g. dispatch-time failure under momentary
+    device pressure) must NOT permanently disable the kernels: the next
+    call re-probes and can succeed."""
+    from ray_lightning_tpu.ops import kernel_probe
+
+    monkeypatch.setattr(kernel_probe, "_interpret", lambda: False)
+    monkeypatch.setattr(kernel_probe, "_CACHE", {})
+    calls = {"n": 0}
+
+    def probe():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise ValueError("transient dispatch failure")
+
+    with pytest.warns(UserWarning, match="for this call"):
+        assert kernel_probe.kernel_available("k", probe) is False
+    # Re-probed on the next call and recovered.
+    assert kernel_probe.kernel_available("k", probe) is True
+    assert calls["n"] == 2
+
+
+@pytest.mark.parametrize("exc", [
+    NotImplementedError("no lowering"),
+    ValueError("Mosaic failed to compile"),
+    RuntimeError("Ran out of VMEM"),
+])
+def test_kernel_probe_compiler_errors_are_permanent(monkeypatch, exc):
+    from ray_lightning_tpu.ops import kernel_probe
+
+    monkeypatch.setattr(kernel_probe, "_interpret", lambda: False)
+    monkeypatch.setattr(kernel_probe, "_CACHE", {})
+    calls = {"n": 0}
+
+    def probe():
+        calls["n"] += 1
+        raise exc
+
+    with pytest.warns(UserWarning):
+        assert kernel_probe.kernel_available("k", probe) is False
+    assert kernel_probe.kernel_available("k", probe) is False
+    assert calls["n"] == 1  # cached, never re-probed
+
+
+# -- (r4-b) queue put() ack read cannot hang forever -------------------------
+
+def test_queue_put_times_out_on_wedged_server(monkeypatch):
+    """A server that accepts + reads but never acks must fail the put in
+    bounded time (socket timeout -> close-and-raise), not hang while
+    holding the handle lock."""
+    import socket
+    import threading
+
+    from ray_lightning_tpu.cluster import queue as qmod
+
+    monkeypatch.setattr(qmod, "_ACK_TIMEOUT_S", 0.2)
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+
+    def wedged():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            # Read the frame but never send the ack byte.
+            try:
+                conn.recv(1 << 16)
+            except OSError:
+                pass
+
+    t = threading.Thread(target=wedged, daemon=True)
+    t.start()
+    try:
+        h = qmod.QueueHandle("127.0.0.1", srv.getsockname()[1])
+        with pytest.raises(OSError):
+            h.put({"metric": 1})
+        h.close()
+    finally:
+        srv.close()
+
+
+# -- (r4-c) precision='bf16-true' coerces loudly -----------------------------
+
+def test_bf16_true_warns_and_coerces():
+    from ray_lightning_tpu.core.loop import FitConfig
+
+    with pytest.warns(UserWarning, match="bf16-true"):
+        cfg = FitConfig(precision="bf16-true")
+    assert cfg.precision == "bf16"
+
+
+def test_bf16_mixed_silent():
+    import warnings
+
+    from ray_lightning_tpu.core.loop import FitConfig
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        cfg = FitConfig(precision="bf16-mixed")
+    assert cfg.precision == "bf16"
+
+
+# -- (r4-d) resume reconciles checkpoint dtypes with this run's policy -------
+
+def test_resume_casts_stale_optimizer_dtype(tmp_path):
+    """A checkpoint whose optimizer-state leaves carry a different dtype
+    (e.g. written before a mu_dtype policy change) must restore onto the
+    CURRENT run's template dtypes, not leak the old dtype into the new
+    step function."""
+    from ray_lightning_tpu.core.loop import FitConfig, run_fit
+    from ray_lightning_tpu.utils.state_stream import (
+        state_stream_to_file, to_state_stream,
+    )
+
+    x = np.random.default_rng(0).standard_normal((16, 32)).astype(np.float32)
+    cfg = FitConfig(max_epochs=1, seed=0, default_root_dir=str(tmp_path))
+    module = BoringModel()
+    run_fit(module, FixedDataModule(x, batch_size=8), cfg, callbacks=[])
+    state = jax.device_get(module.trainer.state)
+
+    # Forge a stale-dtype checkpoint: every float leaf widened to f64
+    # (stands in for any dtype-policy skew, incl. f32<->bf16 momentum).
+    stale = jax.tree_util.tree_map(
+        lambda a: a.astype(np.float64)
+        if hasattr(a, "dtype") and a.dtype == np.float32 else a,
+        state,
+    )
+    path = str(tmp_path / "stale.ckpt")
+    state_stream_to_file(
+        to_state_stream({"state": stale, "epoch": 0, "global_step": 2,
+                         "micro_step": 2, "callback_metrics": {}}), path)
+
+    cfg2 = FitConfig(max_epochs=2, seed=0, default_root_dir=str(tmp_path),
+                     resume_from_checkpoint=path)
+    module2 = BoringModel()
+    run_fit(module2, FixedDataModule(x, batch_size=8), cfg2, callbacks=[])
+    resumed = jax.device_get(module2.trainer.state)
+    leaves_t = jax.tree_util.tree_leaves(state)
+    leaves_r = jax.tree_util.tree_leaves(resumed)
+    for a, b in zip(leaves_t, leaves_r):
+        if hasattr(a, "dtype"):
+            assert a.dtype == b.dtype, (a.dtype, b.dtype)

@@ -456,3 +456,51 @@ def test_zigzag_indices_partition():
     assert sorted(idx) == list(range(16))       # a true permutation
     with pytest.raises(ValueError, match="divisible"):
         zigzag_indices(20, 8)
+
+
+class TestKernelDisableSwitch:
+    """RLT_DISABLE_KERNELS: the on-hardware A/B switch must force the
+    fallback per family and be reflected by the probes (bench.py records
+    kernel_path from exactly these)."""
+
+    def test_family_disable_forces_fallback(self, monkeypatch):
+        from ray_lightning_tpu.ops import kernel_probe
+
+        monkeypatch.setenv("RLT_DISABLE_KERNELS", "ce, ln")
+        assert kernel_probe.kernel_family_disabled("ce")
+        assert kernel_probe.kernel_family_disabled("ln")
+        assert not kernel_probe.kernel_family_disabled("flash")
+        # Even under the interpreter (CPU), a disabled family reports
+        # unavailable — no probe runs.
+        assert kernel_probe.kernel_available(
+            ("ce", 128, "float32"), lambda: None) is False
+        assert kernel_probe.kernel_available(
+            ("flash", 128), lambda: None) is True  # interpret: no probe
+
+    def test_flash_disable_switch(self, monkeypatch):
+        import jax.numpy as jnp
+
+        from ray_lightning_tpu.ops.attention import _flash_supported
+
+        q = jnp.zeros((1, 256, 4, 64), jnp.float32)
+        monkeypatch.setenv("RLT_DISABLE_KERNELS", "flash")
+        assert _flash_supported(q) is False
+
+    def test_disabled_ce_still_correct(self, monkeypatch):
+        """Numerics with the family disabled: the scan fallback answers."""
+        import jax
+        import jax.numpy as jnp
+
+        from ray_lightning_tpu.ops.cross_entropy import (
+            fused_lm_head_cross_entropy, naive_lm_head_cross_entropy)
+
+        k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+        x = jax.random.normal(k1, (2, 16, 128), jnp.float32)
+        w = jax.random.normal(k2, (256, 128), jnp.float32) * 0.1
+        t = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, 256)
+        monkeypatch.setenv("RLT_DISABLE_KERNELS", "ce")
+        fused = fused_lm_head_cross_entropy(
+            x, w, t, compute_dtype=jnp.float32, use_pallas=True)
+        naive = naive_lm_head_cross_entropy(x, w, t,
+                                            compute_dtype=jnp.float32)
+        assert float(jnp.abs(fused - naive).max()) < 1e-5

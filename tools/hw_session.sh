@@ -28,4 +28,16 @@ log "sweep_ce_blocks"
 timeout 2400 python tools/sweep_ce_blocks.py \
   2>&1 | tee "tools/hw_logs/${stamp}_sweep.log"
 
+log "kernel A/B: CE off"
+RLT_DISABLE_KERNELS=ce timeout 1800 python bench.py \
+  2>&1 | tee "tools/hw_logs/${stamp}_bench_no_ce.log"
+
+log "kernel A/B: LN off"
+RLT_DISABLE_KERNELS=ln timeout 1800 python bench.py \
+  2>&1 | tee "tools/hw_logs/${stamp}_bench_no_ln.log"
+
+log "kernel A/B: CE+LN off"
+RLT_DISABLE_KERNELS=ce,ln timeout 1800 python bench.py \
+  2>&1 | tee "tools/hw_logs/${stamp}_bench_no_ce_ln.log"
+
 log "done — logs in tools/hw_logs/${stamp}_*.log"
