@@ -114,13 +114,20 @@ def _one_in_subprocess(impl: str, S: int, B: int, H: int, D: int):
         # One slow config (e.g. the O(S^2) XLA arm at 32k) must not
         # discard the measurements already collected.
         return "error: timeout (1200s)"
-    # The child prints one json.dumps value — a dict for a timed run,
-    # but a bare JSON string ("oom", "error: ...") for a failed one.
+    # The child prints one backend-tagged JSON dict; failed measurements
+    # carry the marker under "result".
     for line in reversed(proc.stdout.strip().splitlines()):
         try:
-            return json.loads(line)
+            out = json.loads(line)
         except ValueError:
             continue
+        if not isinstance(out, dict):
+            continue
+        if out.get("backend") != "tpu":
+            return (f"error: child ran on {out.get('backend')!r}, not tpu "
+                    f"(tunnel dropped mid-sweep?)")
+        out.pop("backend", None)
+        return out.get("result", out)
     return f"error: subprocess rc={proc.returncode}: {proc.stderr[-200:]}"
 
 
@@ -131,8 +138,14 @@ def main() -> None:
         impl, S, B, H, D = sys.argv[2], *map(int, sys.argv[3:7])
         from bench import _detect_backend
 
-        _detect_backend()
-        print(json.dumps(_time_attn(impl, S, B, H, D)))
+        backend = _detect_backend()
+        res = _time_attn(impl, S, B, H, D)
+        # Always a dict tagged with the backend the child ACTUALLY ran
+        # on: if the tunnel drops mid-sweep, _detect_backend degrades to
+        # CPU and the parent must not record interpreter timings as TPU.
+        out = res if isinstance(res, dict) else {"result": res}
+        out["backend"] = backend
+        print(json.dumps(out))
         return
 
     from bench import _detect_backend

@@ -1,0 +1,92 @@
+"""ViT image-classification example: the vision-transformer workload
+under the sharded strategy (net-new model family; the reference's only
+vision-transformer-adjacent example is pl_bolts ImageGPT under
+``RayShardedPlugin``, ``examples/ray_ddp_sharded_example.py``).
+
+The Megatron TP layout is shared with the GPT family
+(``models/vit.py param_partition_specs``), so the same
+data × fsdp × tensor mesh that trains GPT trains ViT.
+
+Run (CPU mesh):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/tpu_vit_example.py --smoke-test
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from ray_lightning_tpu import RayShardedStrategy, Trainer
+from ray_lightning_tpu.core.callbacks import DeviceStatsCallback
+from ray_lightning_tpu.models import ViT, ViTConfig
+from ray_lightning_tpu.models.resnet import CIFARDataModule
+
+
+def train(
+    num_workers: int = 1,
+    num_epochs: int = 3,
+    batch_size: int = 128,
+    d_model: int = 384,
+    n_layer: int = 6,
+    zero_stage: int = 3,
+    data_path: str | None = None,
+    smoke_test: bool = False,
+):
+    if smoke_test:
+        cfg = ViTConfig.tiny()
+        num_epochs, batch_size = 1, 32
+    else:
+        cfg = ViTConfig(
+            d_model=d_model, n_layer=n_layer,
+            n_head=max(4, d_model // 64),
+        )
+    model = ViT(cfg)
+    model.precision = "bf16"
+
+    stats = DeviceStatsCallback()
+    trainer = Trainer(
+        strategy=RayShardedStrategy(
+            num_workers=num_workers, zero_stage=zero_stage,
+        ),
+        max_epochs=num_epochs,
+        callbacks=[stats],
+        default_root_dir="rlt_logs/vit",
+        enable_checkpointing=False,
+        limit_train_batches=4 if smoke_test else None,
+        limit_val_batches=1 if smoke_test else None,
+    )
+    trainer.fit(model, CIFARDataModule(
+        batch_size=batch_size,
+        num_samples=256 if smoke_test else 4096,
+        image_size=cfg.image_size,
+        data_path=data_path,
+    ))
+
+    print(f"val_accuracy: {trainer.callback_metrics.get('val_accuracy')}")
+    summary = stats.summary()
+    if "avg_epoch_time_s" in summary:
+        print(f"Average Epoch time: {summary['avg_epoch_time_s']:.2f} s")
+    return trainer
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--num-workers", type=int, default=1)
+    parser.add_argument("--num-epochs", type=int, default=3)
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--d-model", type=int, default=384)
+    parser.add_argument("--n-layer", type=int, default=6)
+    parser.add_argument("--zero-stage", type=int, default=3)
+    parser.add_argument("--data-path", type=str, default=None)
+    parser.add_argument("--smoke-test", action="store_true")
+    args = parser.parse_args()
+    train(
+        num_workers=args.num_workers,
+        num_epochs=args.num_epochs,
+        batch_size=args.batch_size,
+        d_model=args.d_model,
+        n_layer=args.n_layer,
+        zero_stage=args.zero_stage,
+        data_path=args.data_path,
+        smoke_test=args.smoke_test,
+    )

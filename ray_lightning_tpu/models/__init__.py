@@ -3,6 +3,7 @@ from .generate import decode_step, generate, init_kv_cache, prefill
 from .gpt import GPT, GPTConfig, SyntheticLMDataModule
 from .mnist import MNISTClassifier, MNISTDataModule
 from .resnet import ResNet, CIFARDataModule
+from .vit import ViT, ViTConfig
 
 __all__ = [
     "decode_step",
@@ -20,4 +21,6 @@ __all__ = [
     "SyntheticLMDataModule",
     "ResNet",
     "CIFARDataModule",
+    "ViT",
+    "ViTConfig",
 ]

@@ -99,6 +99,19 @@ class TrialSession:
 _tls = threading.local()
 _registry_lock = threading.Lock()
 _active: dict = {}  # id(session) -> session
+# Count of concurrent tune_run experiments in flight.  While nonzero the
+# sole-session fallback below is DISABLED: after one concurrent trial
+# finishes, a foreign-thread call would otherwise silently resolve to the
+# surviving trial's session — attributing trial A's metrics to trial B is
+# strictly worse than raising.
+_strict_experiments = 0
+
+
+def set_strict_sessions(on: bool) -> None:
+    """Entered/exited by ``tune_run(max_concurrent_trials>1)``."""
+    global _strict_experiments
+    with _registry_lock:
+        _strict_experiments += 1 if on else -1
 
 
 def _current() -> Optional[TrialSession]:
@@ -106,7 +119,7 @@ def _current() -> Optional[TrialSession]:
     if sess is not None:
         return sess
     with _registry_lock:
-        if len(_active) == 1:
+        if _strict_experiments == 0 and len(_active) == 1:
             return next(iter(_active.values()))
     return None
 
@@ -125,13 +138,13 @@ def get_trial_session() -> TrialSession:
     sess = _current()
     if sess is None:
         with _registry_lock:
-            n = len(_active)
-        if n > 1:
+            n, strict = len(_active), _strict_experiments
+        if n >= 1 and strict:
             raise ValueError(
-                f"{n} trial sessions are active but this thread owns "
-                f"none of them; under max_concurrent_trials>1, "
-                f"report()/checkpoint calls must run in the trial's own "
-                f"thread (or a thread it created that sets no session)."
+                f"{n} trial session(s) active in a concurrent experiment "
+                f"but this thread owns none of them; under "
+                f"max_concurrent_trials>1, report()/checkpoint calls "
+                f"must run in the trial's own thread."
             )
         raise ValueError(
             "No trial session is active; report() must run inside a "

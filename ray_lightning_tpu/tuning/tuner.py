@@ -163,11 +163,12 @@ def tune_run(
         if path is None or os.path.isfile(path):
             return path
         if os.path.isdir(path):
+            entries = os.listdir(path)
             files = [
-                os.path.join(path, f) for f in os.listdir(path)
+                os.path.join(path, f) for f in entries
                 if os.path.isfile(os.path.join(path, f))
             ]
-            if len(files) == 1:
+            if len(files) == 1 and len(entries) == 1:
                 return files[0]
             conventional = [
                 f for f in files
@@ -175,8 +176,11 @@ def tune_run(
             ]
             if conventional:
                 return max(conventional, key=os.path.getmtime)
-            if files:
-                return path  # custom multi-file layout: hand over the dir
+            if entries:
+                # Custom layout (multi-file, or a directory tree like an
+                # Orbax save): hand over the dir — the trainable that
+                # wrote it knows how to read it.
+                return path
         return None
 
     def run_one(i: int, cfg: Dict[str, Any]) -> None:
@@ -241,15 +245,24 @@ def tune_run(
     else:
         from concurrent.futures import ThreadPoolExecutor
 
-        with ThreadPoolExecutor(
-            max_workers=max_concurrent_trials,
-            thread_name_prefix="rlt-trial",
-        ) as pool:
-            futures = [
-                pool.submit(run_one, i, cfg)
-                for i, cfg in enumerate(configs)
-            ]
-            errors = [f.exception() for f in futures]
+        from .session import set_strict_sessions
+
+        # Strict session resolution for the whole experiment: foreign
+        # threads must never silently attach to whichever concurrent
+        # trial happens to survive.
+        set_strict_sessions(True)
+        try:
+            with ThreadPoolExecutor(
+                max_workers=max_concurrent_trials,
+                thread_name_prefix="rlt-trial",
+            ) as pool:
+                futures = [
+                    pool.submit(run_one, i, cfg)
+                    for i, cfg in enumerate(configs)
+                ]
+                errors = [f.exception() for f in futures]
+        finally:
+            set_strict_sessions(False)
         first = next((e for e in errors if e is not None), None)
         if first is not None:  # only when raise_on_trial_error
             raise first

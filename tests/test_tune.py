@@ -390,3 +390,76 @@ def test_report_from_helper_thread_single_trial(tmp_path):
         mode="min", local_dir=str(tmp_path / "tune"), verbose=False,
     )
     assert an.trials[0].last_result["side"] == 123.0
+
+
+def test_concurrent_experiment_rejects_foreign_thread_after_drain(tmp_path):
+    """Under max_concurrent_trials>1, a foreign-thread report must raise
+    even after the pool drains to a single surviving trial — silently
+    attributing it to the survivor would corrupt the scheduler."""
+    import threading
+    import time as _time
+
+    from ray_lightning_tpu.tuning import report
+
+    outcome = {}
+    release = threading.Event()
+
+    def fast(cfg):
+        report(m=1.0)
+
+    def slow(cfg):
+        release.wait(timeout=10)  # by now the fast trial has finished
+
+        def foreign():
+            try:
+                report(m=2.0)
+                outcome["raised"] = False
+            except ValueError:
+                outcome["raised"] = True
+
+        th = threading.Thread(target=foreign)
+        th.start()
+        th.join()
+        report(m=0.5)
+
+    def trainable(cfg):
+        if cfg["kind"] == "fast":
+            fast(cfg)
+            release.set()
+        else:
+            slow(cfg)
+
+    an = tune_run(
+        trainable, {"kind": grid_search(["fast", "slow"])}, metric="m",
+        mode="min", local_dir=str(tmp_path / "tune"), verbose=False,
+        max_concurrent_trials=2,
+    )
+    assert outcome.get("raised") is True
+    # The slow trial's own-thread report still worked.
+    slow_trial = next(t for t in an.trials if t.config["kind"] == "slow")
+    assert slow_trial.last_result["m"] == 0.5
+
+
+def test_resolve_ckpt_dir_tree_hands_over_directory(tmp_path):
+    """A donor checkpoint that is a directory TREE (e.g. an Orbax save)
+    resolves to the directory itself, not None."""
+    from ray_lightning_tpu.tuning import checkpoint_dir, get_checkpoint, report
+
+    seen = []
+
+    def trainable(cfg):
+        seen.append(get_checkpoint())
+        d = checkpoint_dir(step=1)
+        sub = os.path.join(d, "orbax_tree", "0")
+        os.makedirs(sub, exist_ok=True)
+        with open(os.path.join(sub, "arr.bin"), "wb") as f:
+            f.write(b"x")
+        report(loss=1.0)
+
+    pbt = PopulationBasedTraining(metric="loss", mode="min",
+                                  perturbation_interval=100)
+    tune_run(trainable, {"lr": grid_search([0.1])}, num_samples=2,
+             scheduler=pbt, metric="loss", mode="min",
+             local_dir=str(tmp_path / "tune"), verbose=False)
+    assert seen[0] is None
+    assert seen[1] is not None and os.path.isdir(seen[1])
