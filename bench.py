@@ -285,8 +285,13 @@ def main() -> None:
         cfg = GPTConfig.tiny()
         batch_size = max(4, 2 * jax.local_device_count())
 
+    # On-hardware A/B surface (PERFORMANCE.md prepared experiments):
+    # RLT_REMAT_POLICY picks what the remat backward keeps.
+    remat_policy = os.environ.get("RLT_REMAT_POLICY", "dots+flash")
+
     def make_module():
-        m = GPT(cfg, attn_impl="auto", remat=on_tpu)
+        m = GPT(cfg, attn_impl="auto", remat=on_tpu,
+                remat_policy=remat_policy)
         m.precision = "bf16"
         return m
 
@@ -318,6 +323,7 @@ def main() -> None:
         "raw_spread_pct": round(raw_spread, 2),
         "generate_tokens_per_sec": gen_tps,
         "kernel_path": kernel_path,
+        "remat_policy": remat_policy,
         "windows": WINDOWS,
         "window_steps": WINDOW_STEPS,
         "bottleneck": "attention bwd kernel + scan residual-save HBM "

@@ -134,6 +134,7 @@ class GPT(TpuModule):
         seq_axis: str = "sp",
         ring_layout: str = "contiguous",
         remat: bool = False,
+        remat_policy: str = "dots+flash",
     ):
         super().__init__()
         self.config = config or GPTConfig.tiny()
@@ -150,10 +151,32 @@ class GPT(TpuModule):
         # ~30% more FLOPs for ~n_layer× less activation memory — enables
         # bigger per-chip batches / longer sequences).  MXU outputs
         # (matmul results) are kept; cheap elementwise is recomputed.
+        #
+        # ``remat_policy`` selects what the backward keeps (an on-hardware
+        # A/B surface — PERFORMANCE.md "prepared experiments"):
+        #  * "dots+flash"     — matmul outputs + ALL named flash residuals
+        #    (out/lse/q/k/v).  Never re-runs the attention kernel, but may
+        #    double-save the qkv projections (the dots policy already
+        #    keeps the (B,T,3d) matmul output the per-head q/k/v are mere
+        #    transposes of).
+        #  * "dots+flash-out" — matmul outputs + flash out/lse only; the
+        #    backward re-derives the per-head transposes from the saved
+        #    qkv matmul output (cheap VPU work, ~150 MB/layer less
+        #    residual traffic at GPT-2-small/seq-1024 if the double-save
+        #    is real).
+        #  * "dots"           — matmul outputs only; the backward re-runs
+        #    the flash forward kernel (measured dead end, kept as the
+        #    control arm).
+        if remat_policy not in ("dots+flash", "dots+flash-out", "dots"):
+            raise ValueError(
+                f"remat_policy {remat_policy!r} not in "
+                f"('dots+flash', 'dots+flash-out', 'dots')"
+            )
         self.remat = remat
+        self.remat_policy = remat_policy
         self.save_hyperparameters(
             **dataclasses.asdict(self.config), attn_impl=attn_impl,
-            remat=remat,
+            remat=remat, remat_policy=remat_policy,
         )
 
     # -- params -------------------------------------------------------------
@@ -387,20 +410,21 @@ class GPT(TpuModule):
             return (self._constrain_residual(x), aux), None
 
         if self.remat:
-            # Save matmul outputs AND the flash-attention kernel outputs
-            # (out/lse, named in its vjp fwd) — recomputing elementwise is
-            # the remat bargain; re-running the attention kernel is not.
+            # Save matmul outputs AND (per remat_policy) the named
+            # flash-attention residuals — recomputing elementwise is the
+            # remat bargain; re-running the attention kernel is not.
             cp = jax.checkpoint_policies
-            block = jax.checkpoint(
-                block,
-                policy=cp.save_from_both_policies(
+            if self.remat_policy == "dots":
+                policy = cp.dots_with_no_batch_dims_saveable
+            else:
+                names = ("flash_out", "flash_lse")
+                if self.remat_policy == "dots+flash":
+                    names += ("flash_q", "flash_k", "flash_v")
+                policy = cp.save_from_both_policies(
                     cp.dots_with_no_batch_dims_saveable,
-                    cp.save_only_these_names(
-                        "flash_out", "flash_lse",
-                        "flash_q", "flash_k", "flash_v",
-                    ),
-                ),
-            )
+                    cp.save_only_these_names(*names),
+                )
+            block = jax.checkpoint(block, policy=policy)
         (x, aux), _ = jax.lax.scan(
             block, (x, jnp.zeros((), jnp.float32)), params["blocks"]
         )
@@ -486,10 +510,16 @@ class GPT(TpuModule):
         schedule = optax.warmup_cosine_decay_schedule(
             0.0, cfg.lr, cfg.warmup_steps, max(10 * cfg.warmup_steps, 1000)
         )
+        from ray_lightning_tpu.models.optim import decay_mask
+
+        # Decay matrices only (nanoGPT-style ndim rule): LN params and
+        # biases are exempt; decay_mask is aware of the stacked-blocks
+        # leading layer dim, so per-block biases/LN stay exempt too.
         tx = optax.chain(
             optax.clip_by_global_norm(1.0),
             optax.adamw(schedule, b1=0.9, b2=0.95,
                         weight_decay=cfg.weight_decay,
+                        mask=decay_mask,
                         mu_dtype=jnp.dtype(cfg.mu_dtype)),
         )
         return tx

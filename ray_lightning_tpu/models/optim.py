@@ -1,0 +1,31 @@
+"""Shared optimizer helpers for the in-framework model families."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+
+__all__ = ["decay_mask"]
+
+# Matrix-valued params by naming convention (GPT/ViT family): ``*_w``
+# projections, plus the embedding tables.  Everything else — biases
+# (``*_b``), LayerNorm gains (``*_g``), positional tables — is exempt.
+_DECAY_EXACT = {"wte", "wpe"}
+
+
+def decay_mask(params: Dict[str, Any]):
+    """AdamW weight-decay mask: decay matmul weights, never LayerNorm
+    params or biases.
+
+    Keyed on the family's naming convention rather than ndim: stacked
+    blocks carry a leading layer dim and MoE tensors an expert dim, so
+    a per-block MoE bias is 3-D while still being a bias — any raw
+    ``ndim > k`` rule misclassifies one group or another.
+    """
+
+    def rule(path, leaf):
+        name = getattr(path[-1], "key", "") if path else ""
+        return name.endswith("_w") or name in _DECAY_EXACT
+
+    return jax.tree_util.tree_map_with_path(rule, params)

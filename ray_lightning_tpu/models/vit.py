@@ -36,6 +36,7 @@ import optax
 from jax.sharding import PartitionSpec as P
 
 from ray_lightning_tpu.core.module import TpuModule
+from ray_lightning_tpu.models.optim import decay_mask
 from ray_lightning_tpu.ops.layer_norm import layer_norm
 
 __all__ = ["ViT", "ViTConfig"]
@@ -246,6 +247,5 @@ class ViT(TpuModule):
         return optax.chain(
             optax.clip_by_global_norm(1.0),
             optax.adamw(schedule, weight_decay=cfg.weight_decay,
-                        mask=lambda params: jax.tree.map(
-                            lambda a: a.ndim > 1, params)),
+                        mask=decay_mask),
         )

@@ -233,3 +233,71 @@ def test_gpt_shard_map_flavor_trains():
     assert base.callback_metrics["train_loss"] == pytest.approx(
         tr.callback_metrics["train_loss"], rel=1e-5
     )
+
+
+@pytest.mark.parametrize("policy", ["dots+flash", "dots+flash-out", "dots"])
+def test_remat_policy_variants_same_numerics(policy):
+    """remat_policy only changes WHAT the backward saves, never the
+    math: loss and grads must match the no-remat baseline.
+
+    attn_impl='flash' explicitly (interpret-mode Pallas on the CPU
+    mesh): under 'auto' the CPU path takes the XLA einsum, no flash_*
+    checkpoint_name residuals exist, and all three policies would
+    compile the same program — the arms must differ to be tested.
+    head_dim 64 to satisfy the kernel's lane constraint."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    cfg = GPTConfig(vocab_size=512, n_layer=2, n_head=4, d_model=256,
+                    seq_len=128, warmup_steps=2)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, cfg.seq_len + 1)),
+        jnp.int32)
+
+    def loss_fn(model):
+        params = model.init_params(jax.random.PRNGKey(0))
+
+        def loss(p):
+            l, _ = model.training_step(p, {"tokens": tokens}, jax.random.PRNGKey(1))
+            return l
+
+        val, grads = jax.jit(jax.value_and_grad(loss))(params)
+        return float(val), grads
+
+    base_val, base_grads = loss_fn(GPT(cfg, attn_impl="flash", remat=False))
+    val, grads = loss_fn(
+        GPT(cfg, attn_impl="flash", remat=True, remat_policy=policy))
+    assert val == pytest.approx(base_val, rel=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(base_grads),
+                    jax.tree_util.tree_leaves(grads)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-6)
+
+
+def test_remat_policy_rejects_unknown():
+    with pytest.raises(ValueError, match="remat_policy"):
+        GPT(GPTConfig.tiny(), remat_policy="everything")
+
+
+def test_decay_mask_exempts_norms_biases_everywhere():
+    """The weight-decay mask must exempt LN params and biases at every
+    nesting level — stacked blocks and MoE tensors carry extra leading
+    dims that break any raw ndim rule."""
+    from ray_lightning_tpu.models.optim import decay_mask
+    from ray_lightning_tpu.models import ViT, ViTConfig
+
+    p = GPT(GPTConfig.tiny_moe()).init_params(jax.random.PRNGKey(0))
+    m = decay_mask(p)
+    assert m["wte"] is True and m["wpe"] is True
+    assert m["ln_f_g"] is False and m["ln_f_b"] is False
+    b = m["blocks"]
+    assert b["qkv_w"] and b["moe_in_w"] and b["moe_out_w"] and b["gate_w"]
+    assert not (b["qkv_b"] or b["moe_in_b"] or b["moe_out_b"]
+                or b["ln1_g"] or b["ln2_b"])
+
+    pv = ViT(ViTConfig.tiny()).init_params(jax.random.PRNGKey(0))
+    mv = decay_mask(pv)
+    assert mv["patch_w"] and mv["head_w"] and mv["blocks"]["mlp_in_w"]
+    assert not (mv["pos"] or mv["patch_b"] or mv["head_b"]
+                or mv["blocks"]["mlp_in_b"] or mv["blocks"]["ln1_g"])

@@ -40,4 +40,8 @@ log "kernel A/B: CE+LN off"
 RLT_DISABLE_KERNELS=ce,ln timeout 1800 python bench.py \
   2>&1 | tee "tools/hw_logs/${stamp}_bench_no_ce_ln.log"
 
+log "remat A/B: drop flash_q/k/v saves (double-save hypothesis)"
+RLT_REMAT_POLICY=dots+flash-out timeout 1800 python bench.py \
+  2>&1 | tee "tools/hw_logs/${stamp}_bench_remat_flashout.log"
+
 log "done — logs in tools/hw_logs/${stamp}_*.log"
