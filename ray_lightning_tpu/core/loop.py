@@ -320,6 +320,7 @@ def init_train_state(
     mesh,
     zero_stage: int,
     seed: int,
+    use_preset: bool = True,
 ) -> Tuple[TrainState, Any]:
     """Build the (possibly ZeRO-sharded) initial train state.
 
@@ -331,18 +332,37 @@ def init_train_state(
     reference ``ray_ddp.py:223``).
     """
     rng = jax.random.PRNGKey(seed)
+    # Warm-start hook: a module with ``initial_params`` set (a host
+    # pytree — e.g. weights imported from a torch/HF checkpoint,
+    # utils/hf_import.py) starts the fit from those weights instead of
+    # init_params(rng).  Passed as a jit ARGUMENT, never a closure
+    # constant, so the arrays are transferred once, not baked into the
+    # compiled executable.  The caller sets ``use_preset=False`` when a
+    # resume checkpoint will overwrite the state anyway — shipping a
+    # GPT-scale pytree to the mesh just to discard it is gigabytes of
+    # wasted transfer per worker per restart.
+    preset = getattr(module, "initial_params", None) if use_preset else None
 
     def make(r):
         params = module.init_params(r)
         return TrainState.create(params, tx)
 
+    def make_from(params):
+        return TrainState.create(params, tx)
+
     if mesh is None:
+        if preset is not None:
+            return make_from(jax.device_put(preset)), None
         return make(rng), None
     abstract = jax.eval_shape(make, rng)
     shardings = shardlib.state_shardings_for_module(
         module, abstract, mesh, zero_stage
     )
-    state = jax.jit(make, out_shardings=shardings)(rng)
+    if preset is not None:
+        placed = jax.device_put(preset, shardings.params)
+        state = jax.jit(make_from, out_shardings=shardings)(placed)
+    else:
+        state = jax.jit(make, out_shardings=shardings)(rng)
     return state, shardings
 
 
@@ -483,7 +503,8 @@ def run_fit(
     _call_hooks(callbacks, "setup", ctx, module, "fit")
 
     state, state_shardings = init_train_state(
-        module, tx, mesh, zero_stage, config.seed
+        module, tx, mesh, zero_stage, config.seed,
+        use_preset=not config.resume_from_checkpoint,
     )
     start_epoch = 0
     if config.resume_from_checkpoint:

@@ -110,6 +110,12 @@ class TpuModule:
         self.hparams: Dict[str, Any] = {}
         self.trainer = None  # set by the loop (worker-side context)
         self.precision: str = "f32"
+        # Warm-start hook: set to a host param pytree (matching
+        # ``init_params``'s structure) to start ``fit`` from those
+        # weights instead of a fresh init — e.g. weights imported from a
+        # torch/HF checkpoint (``utils/hf_import.py``).  Sharded onto
+        # the active mesh exactly like fresh params.
+        self.initial_params = None
 
     # -- configuration ------------------------------------------------------
     def save_hyperparameters(self, **kwargs: Any) -> None:

@@ -9,9 +9,11 @@ import jax
 __all__ = ["decay_mask"]
 
 # Matrix-valued params by naming convention (GPT/ViT family): ``*_w``
-# projections, plus the embedding tables.  Everything else — biases
-# (``*_b``), LayerNorm gains (``*_g``), positional tables — is exempt.
-_DECAY_EXACT = {"wte", "wpe"}
+# projections, plus the token embedding (tied to the LM head — it IS the
+# output matrix).  Everything else — biases (``*_b``), LayerNorm gains
+# (``*_g``), positional tables (``wpe``/``pos``) — is exempt, in both
+# families.
+_DECAY_EXACT = {"wte"}
 
 
 def decay_mask(params: Dict[str, Any]):

@@ -16,17 +16,23 @@ __all__ = [
     "ORBAX_INSTALLED",
     "save_orbax",
     "load_orbax",
+    "import_gpt2",
+    "gpt_config_from_hf",
 ]
 
 _ORBAX_NAMES = ("ORBAX_INSTALLED", "save_orbax", "load_orbax")
+_HF_NAMES = ("import_gpt2", "gpt_config_from_hf")
 
 
 def __getattr__(name):
-    # PEP 562 lazy re-export: importing orbax costs ~3s (tensorstore),
-    # so `import ray_lightning_tpu` must not pay it — only an actual
-    # use of the interop bridge does.
+    # PEP 562 lazy re-exports: importing orbax (~3s of tensorstore) or
+    # the HF bridge (torch/transformers) must cost nothing until used.
     if name in _ORBAX_NAMES:
         from . import orbax_io
 
         return getattr(orbax_io, name)
+    if name in _HF_NAMES:
+        from . import hf_import
+
+        return getattr(hf_import, name)
     raise AttributeError(name)

@@ -1,0 +1,117 @@
+"""Hugging Face GPT-2 weight import: torch checkpoints → the in-framework GPT.
+
+Migration path for reference users: the reference trains torch modules
+(its examples wrap torchvision / pl_bolts / HF models in a
+LightningModule), so users arriving from it hold torch-format weights.
+This module maps a ``transformers`` GPT-2 LM checkpoint onto
+:class:`ray_lightning_tpu.models.gpt.GPT`'s parameter pytree, after
+which every strategy (ZeRO/TP/SP sharding, generation, tuning) applies
+unchanged.
+
+Architecture correspondence (verified numerically in
+``tests/test_hf_import.py``):
+
+* HF ``Conv1D`` stores ``(in, out)`` weights — the SAME orientation as
+  this framework's right-multiplied matmuls; no transposes.
+* HF ``gelu_new`` (tanh approximation) == ``jax.nn.gelu`` default.
+* LayerNorm epsilon 1e-5 on both sides; pre-LN blocks; tied LM head.
+* Vocab is NOT padded on import: a zero-padded row still contributes
+  ``exp(0)`` to every softmax partition, silently shifting the loss, so
+  imported configs keep HF's exact vocab (50257) and the vocab-chunked
+  CE masks the ragged tail.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+__all__ = ["import_gpt2", "gpt_config_from_hf"]
+
+
+def gpt_config_from_hf(hf_config) -> "GPTConfig":  # noqa: F821
+    """Map a ``transformers.GPT2Config`` onto :class:`GPTConfig`."""
+    from ray_lightning_tpu.models.gpt import GPTConfig
+
+    if getattr(hf_config, "activation_function", "gelu_new") not in (
+        "gelu_new", "gelu_pytorch_tanh"
+    ):
+        raise ValueError(
+            f"activation {hf_config.activation_function!r} differs from "
+            f"this framework's tanh-approximated GELU; import would be "
+            f"numerically wrong"
+        )
+    eps = float(getattr(hf_config, "layer_norm_epsilon", 1e-5))
+    if abs(eps - 1e-5) > 1e-12:
+        raise ValueError(
+            f"layer_norm_epsilon {eps} != 1e-5 (the framework's fused-LN "
+            f"constant); import would drift"
+        )
+    # Attention-math variants this framework does not implement: each
+    # would import cleanly and produce silently divergent logits.
+    if getattr(hf_config, "scale_attn_by_inverse_layer_idx", False):
+        raise ValueError(
+            "scale_attn_by_inverse_layer_idx=True divides attention "
+            "scores by (layer_idx+1); this framework scales by "
+            "1/sqrt(head_dim) only — import would be numerically wrong"
+        )
+    if getattr(hf_config, "reorder_and_upcast_attn", False):
+        raise ValueError(
+            "reorder_and_upcast_attn=True is a different attention "
+            "compute order; import would drift"
+        )
+    n_inner = getattr(hf_config, "n_inner", None)
+    if n_inner is not None and n_inner != 4 * hf_config.n_embd:
+        raise ValueError(
+            f"n_inner {n_inner} != 4*n_embd (the framework's mlp_ratio "
+            f"is integral); import unsupported"
+        )
+    return GPTConfig(
+        vocab_size=hf_config.vocab_size,
+        n_layer=hf_config.n_layer,
+        n_head=hf_config.n_head,
+        d_model=hf_config.n_embd,
+        seq_len=hf_config.n_positions,
+    )
+
+
+def _t(tensor) -> np.ndarray:
+    return tensor.detach().cpu().numpy().astype(np.float32)
+
+
+def import_gpt2(hf_model) -> Tuple["GPTConfig", Dict[str, Any]]:  # noqa: F821
+    """(config, params) from a ``transformers.GPT2LMHeadModel``.
+
+    Layers are stacked along a leading L dim — the ``lax.scan`` layout
+    :meth:`GPT.init_params` uses — so the result drops into any
+    strategy/sharding unchanged.
+    """
+    cfg = gpt_config_from_hf(hf_model.config)
+    tr = hf_model.transformer
+
+    def stack(fetch):
+        return np.stack([fetch(block) for block in tr.h], axis=0)
+
+    blocks = {
+        "ln1_g": stack(lambda b: _t(b.ln_1.weight)),
+        "ln1_b": stack(lambda b: _t(b.ln_1.bias)),
+        "qkv_w": stack(lambda b: _t(b.attn.c_attn.weight)),
+        "qkv_b": stack(lambda b: _t(b.attn.c_attn.bias)),
+        "proj_w": stack(lambda b: _t(b.attn.c_proj.weight)),
+        "proj_b": stack(lambda b: _t(b.attn.c_proj.bias)),
+        "ln2_g": stack(lambda b: _t(b.ln_2.weight)),
+        "ln2_b": stack(lambda b: _t(b.ln_2.bias)),
+        "mlp_in_w": stack(lambda b: _t(b.mlp.c_fc.weight)),
+        "mlp_in_b": stack(lambda b: _t(b.mlp.c_fc.bias)),
+        "mlp_out_w": stack(lambda b: _t(b.mlp.c_proj.weight)),
+        "mlp_out_b": stack(lambda b: _t(b.mlp.c_proj.bias)),
+    }
+    params = {
+        "wte": _t(tr.wte.weight),
+        "wpe": _t(tr.wpe.weight),
+        "blocks": blocks,
+        "ln_f_g": _t(tr.ln_f.weight),
+        "ln_f_b": _t(tr.ln_f.bias),
+    }
+    return cfg, params

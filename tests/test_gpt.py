@@ -289,7 +289,8 @@ def test_decay_mask_exempts_norms_biases_everywhere():
 
     p = GPT(GPTConfig.tiny_moe()).init_params(jax.random.PRNGKey(0))
     m = decay_mask(p)
-    assert m["wte"] is True and m["wpe"] is True
+    assert m["wte"] is True  # tied to the LM head — a matrix
+    assert m["wpe"] is False  # positional table — exempt in both families
     assert m["ln_f_g"] is False and m["ln_f_b"] is False
     b = m["blocks"]
     assert b["qkv_w"] and b["moe_in_w"] and b["moe_out_w"] and b["gate_w"]
