@@ -1,6 +1,12 @@
 from .boring import BoringModel, BoringDataModule, XORModel, XORDataModule
 from .generate import decode_step, generate, init_kv_cache, prefill
-from .gpt import GPT, GPTConfig, SyntheticLMDataModule
+from .gpt import (
+    GPT,
+    GPTConfig,
+    SyntheticLMDataModule,
+    add_lora_adapters,
+    merge_lora,
+)
 from .mnist import MNISTClassifier, MNISTDataModule
 from .resnet import ResNet, CIFARDataModule
 from .vit import ViT, ViTConfig
@@ -19,6 +25,8 @@ __all__ = [
     "GPT",
     "GPTConfig",
     "SyntheticLMDataModule",
+    "add_lora_adapters",
+    "merge_lora",
     "ResNet",
     "CIFARDataModule",
     "ViT",

@@ -237,6 +237,13 @@ def generate(
         generated continuation.
     """
     cfg = module.config
+    if any(str(k).startswith("lora_") for k in params.get("blocks", {})):
+        raise ValueError(
+            "params contain LoRA adapters, which the decode path does "
+            "not apply — running them would silently generate from the "
+            "frozen base weights. Fold them first: "
+            "params = merge_lora(params, module.config)."
+        )
     B, t0 = prompt.shape
     if t0 < 1:
         raise ValueError("prompt must contain at least one token")

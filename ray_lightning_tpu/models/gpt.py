@@ -36,7 +36,8 @@ from ray_lightning_tpu.core.data import TpuDataModule, NumpyLoader
 from ray_lightning_tpu.core.module import TpuModule
 from ray_lightning_tpu.ops import causal_attention
 
-__all__ = ["GPTConfig", "GPT", "SyntheticLMDataModule", "make_block_stage"]
+__all__ = ["GPTConfig", "GPT", "SyntheticLMDataModule", "make_block_stage",
+           "merge_lora", "add_lora_adapters"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -65,6 +66,16 @@ class GPTConfig:
     # optimizer-state leaves to this run's template dtypes on load
     # (core/loop.py resume path), so f32-era checkpoints restore cleanly.
     mu_dtype: str = "bfloat16"
+    # LoRA fine-tuning (0 = off).  rank>0 adds low-rank adapters on the
+    # attention projections (qkv column + output proj — the standard
+    # target set); the optimizer then trains ONLY the adapters (the base
+    # is frozen via optax.multi_transform, so it carries no Adam
+    # moments — the memory win that makes LoRA worth it).  Pairs with
+    # ``utils/hf_import.py`` + ``initial_params`` for fine-tuning
+    # imported checkpoints; ``merge_lora`` folds adapters into the base
+    # weights for inference/generation.
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
 
     @classmethod
     def tiny(cls) -> "GPTConfig":
@@ -172,6 +183,11 @@ class GPT(TpuModule):
                 f"remat_policy {remat_policy!r} not in "
                 f"('dots+flash', 'dots+flash-out', 'dots')"
             )
+        if self.config.lora_rank > 0 and self.config.n_experts > 0:
+            raise ValueError(
+                "LoRA adapters target the dense attention projections; "
+                "lora_rank > 0 with n_experts > 0 is not supported"
+            )
         self.remat = remat
         self.remat_policy = remat_policy
         self.save_hyperparameters(
@@ -200,6 +216,8 @@ class GPT(TpuModule):
             "ln2_g": jnp.ones((L, d)),
             "ln2_b": jnp.zeros((L, d)),
         }
+        if cfg.lora_rank > 0:
+            blocks.update(_init_lora_blocks(cfg, keys[6]))
         E = cfg.n_experts
         if E > 0:
             blocks.update({
@@ -245,6 +263,14 @@ class GPT(TpuModule):
             "proj_w": P(None, t, None), "proj_b": P(),
             "ln2_g": P(), "ln2_b": P(),
         }
+        if self.config.lora_rank > 0:
+            # Adapters follow the host matmul's layout: qkv's B matrix is
+            # column-parallel like qkv_w; proj's A contracts the
+            # tensor-sharded attention output (GSPMD inserts the psum).
+            blocks.update({
+                "lora_qkv_a": P(), "lora_qkv_b": P(None, None, t),
+                "lora_proj_a": P(None, t, None), "lora_proj_b": P(),
+            })
         if self.config.n_experts > 0:
             # ep × tp composition: experts over the expert axis, each
             # expert's hidden dim over tensor (column/row-parallel FFN).
@@ -388,10 +414,19 @@ class GPT(TpuModule):
             (params["wte"][tokens] + params["wpe"][:T]).astype(c)
         )
 
+        lora_s = (
+            cfg.lora_alpha / cfg.lora_rank if cfg.lora_rank > 0 else 0.0
+        )
+
         def block(carry, p):
             x, aux = carry
             h = _layer_norm(x, p["ln1_g"], p["ln1_b"], lnp)
             qkv = h @ p["qkv_w"].astype(c) + p["qkv_b"].astype(c)
+            if cfg.lora_rank > 0:
+                qkv = qkv + (
+                    (h @ p["lora_qkv_a"].astype(c))
+                    @ p["lora_qkv_b"].astype(c)
+                ) * lora_s
             q, k, v = jnp.split(qkv, 3, axis=-1)
 
             def heads(z):
@@ -399,7 +434,13 @@ class GPT(TpuModule):
 
             att = self._attention(heads(q), heads(k), heads(v))
             att = att.reshape(B, T, cfg.d_model)
-            x = x + att @ p["proj_w"].astype(c) + p["proj_b"].astype(c)
+            proj = att @ p["proj_w"].astype(c) + p["proj_b"].astype(c)
+            if cfg.lora_rank > 0:
+                proj = proj + (
+                    (att @ p["lora_proj_a"].astype(c))
+                    @ p["lora_proj_b"].astype(c)
+                ) * lora_s
+            x = x + proj
             if cfg.n_experts > 0:
                 x, layer_aux = _moe_residual(
                     x, p, cfg, groups=self._moe_groups(), ln_pallas=lnp
@@ -515,14 +556,94 @@ class GPT(TpuModule):
         # Decay matrices only (nanoGPT-style ndim rule): LN params and
         # biases are exempt; decay_mask is aware of the stacked-blocks
         # leading layer dim, so per-block biases/LN stay exempt too.
-        tx = optax.chain(
-            optax.clip_by_global_norm(1.0),
-            optax.adamw(schedule, b1=0.9, b2=0.95,
-                        weight_decay=cfg.weight_decay,
-                        mask=decay_mask,
-                        mu_dtype=jnp.dtype(cfg.mu_dtype)),
-        )
+        adamw = optax.adamw(schedule, b1=0.9, b2=0.95,
+                            weight_decay=cfg.weight_decay,
+                            mask=decay_mask,
+                            mu_dtype=jnp.dtype(cfg.mu_dtype))
+        if cfg.lora_rank > 0:
+            # LoRA: only adapter params train.  The frozen base gets
+            # set_to_zero (no Adam moments allocated for it — under
+            # multi_transform's masking the optimizer state exists only
+            # for the trained subset, the actual memory win of LoRA).
+            def labels(params):
+                return jax.tree_util.tree_map_with_path(
+                    lambda path, _: "train"
+                    if str(getattr(path[-1], "key", "")).startswith("lora_")
+                    else "freeze",
+                    params,
+                )
+
+            # Frozen grads are zeroed BEFORE the global-norm clip: the
+            # clip must see the ADAPTER gradient norm, not the full
+            # model's — otherwise base-weight grads (which never apply)
+            # scale down every adapter update.
+            return optax.chain(
+                optax.multi_transform(
+                    {"train": optax.identity(),
+                     "freeze": optax.set_to_zero()}, labels
+                ),
+                optax.clip_by_global_norm(1.0),
+                optax.multi_transform(
+                    {"train": adamw, "freeze": optax.set_to_zero()}, labels
+                ),
+            )
+        tx = optax.chain(optax.clip_by_global_norm(1.0), adamw)
         return tx
+
+
+def _init_lora_blocks(cfg: GPTConfig, rng: jax.Array) -> Dict[str, Any]:
+    """The four stacked adapter tensors — ONE source for both
+    ``GPT.init_params`` and :func:`add_lora_adapters`.  B is
+    zero-initialized: the adapter delta starts at exactly 0, so step 0
+    reproduces the base model bit-for-bit."""
+    L, d, r = cfg.n_layer, cfg.d_model, cfg.lora_rank
+    ka, kb = jax.random.split(rng)
+    return {
+        "lora_qkv_a": (jax.random.normal(ka, (L, d, r)) * 0.02).astype(
+            jnp.float32),
+        "lora_qkv_b": jnp.zeros((L, r, 3 * d)),
+        "lora_proj_a": (jax.random.normal(kb, (L, d, r)) * 0.02).astype(
+            jnp.float32),
+        "lora_proj_b": jnp.zeros((L, r, d)),
+    }
+
+
+def add_lora_adapters(
+    params: Dict[str, Any], cfg: GPTConfig, rng: jax.Array
+) -> Dict[str, Any]:
+    """Attach fresh LoRA adapters to a lora-free param tree (e.g. one
+    imported from a HF checkpoint, ``utils/hf_import.py``) so it can
+    warm-start a ``lora_rank > 0`` fit via ``module.initial_params``."""
+    if cfg.lora_rank <= 0:
+        return params
+    return {
+        **params,
+        "blocks": {**params["blocks"], **_init_lora_blocks(cfg, rng)},
+    }
+
+
+def merge_lora(params: Dict[str, Any], cfg: GPTConfig) -> Dict[str, Any]:
+    """Fold LoRA adapters into the base weights and strip them.
+
+    The result is a plain (lora-free) GPT param tree with identical
+    forward math — the inference/generation path (``models/generate.py``
+    consumes raw ``qkv_w``/``proj_w``) and any lora-unaware tooling run
+    it unchanged.  Merged-weight logits equal the adapter-form logits in
+    f32 exactly up to one fused-matmul reassociation.
+    """
+    if cfg.lora_rank <= 0:
+        return params
+    s = cfg.lora_alpha / cfg.lora_rank
+    blocks = dict(params["blocks"])
+    blocks["qkv_w"] = blocks["qkv_w"] + jnp.einsum(
+        "ldr,lrk->ldk", blocks["lora_qkv_a"], blocks["lora_qkv_b"]
+    ) * s
+    blocks["proj_w"] = blocks["proj_w"] + jnp.einsum(
+        "ldr,lrk->ldk", blocks["lora_proj_a"], blocks["lora_proj_b"]
+    ) * s
+    for k in ("lora_qkv_a", "lora_qkv_b", "lora_proj_a", "lora_proj_b"):
+        blocks.pop(k)
+    return {**params, "blocks": blocks}
 
 
 def make_block_stage(cfg: GPTConfig, compute_dtype=jnp.float32):
@@ -535,6 +656,12 @@ def make_block_stage(cfg: GPTConfig, compute_dtype=jnp.float32):
     """
     if cfg.n_experts > 0:
         raise ValueError("make_block_stage covers dense blocks only")
+    if cfg.lora_rank > 0:
+        raise ValueError(
+            "make_block_stage does not apply LoRA adapters; fold them "
+            "with merge_lora(params, cfg) first (running unmerged would "
+            "silently use the frozen base weights)"
+        )
 
     def stage(blocks, x):
         b, t = x.shape[0], x.shape[1]

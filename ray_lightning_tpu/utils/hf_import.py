@@ -61,6 +61,12 @@ def gpt_config_from_hf(hf_config) -> "GPTConfig":  # noqa: F821
             "reorder_and_upcast_attn=True is a different attention "
             "compute order; import would drift"
         )
+    if not getattr(hf_config, "scale_attn_weights", True):
+        raise ValueError(
+            "scale_attn_weights=False omits the 1/sqrt(head_dim) score "
+            "scale; this framework always applies it — import would be "
+            "numerically wrong"
+        )
     n_inner = getattr(hf_config, "n_inner", None)
     if n_inner is not None and n_inner != 4 * hf_config.n_embd:
         raise ValueError(

@@ -119,6 +119,9 @@ def test_import_rejects_attention_variants():
             **base, reorder_and_upcast_attn=True))
     with pytest.raises(ValueError, match="n_inner"):
         gpt_config_from_hf(transformers.GPT2Config(**base, n_inner=100))
+    with pytest.raises(ValueError, match="scale_attn_weights"):
+        gpt_config_from_hf(transformers.GPT2Config(
+            **base, scale_attn_weights=False))
 
 
 def test_resume_skips_preset_transfer(tmp_path):
