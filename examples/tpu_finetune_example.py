@@ -1,0 +1,108 @@
+"""LoRA fine-tuning example: the torch-ecosystem migration recipe.
+
+Import a Hugging Face GPT-2 checkpoint (``utils/hf_import.py``), attach
+LoRA adapters, fine-tune with the base frozen under a sharded strategy,
+merge, and generate — the end-to-end path a reference
+(``ray_lightning``) user follows to bring an existing torch LM onto
+TPU.
+
+Without ``--model-name`` (or offline), a randomly-initialized tiny HF
+GPT-2 stands in for the checkpoint so the flow runs in zero-egress
+environments; pass ``--model-name gpt2`` where the HF cache is
+available to fine-tune the real 124M model.
+
+Run (CPU mesh):
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/tpu_finetune_example.py --smoke-test
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_lightning_tpu import RayShardedStrategy, Trainer
+from ray_lightning_tpu.models import GPT, add_lora_adapters, merge_lora
+from ray_lightning_tpu.models.gpt import SyntheticLMDataModule
+from ray_lightning_tpu.models.generate import generate
+from ray_lightning_tpu.utils import import_gpt2
+
+
+def _load_hf(model_name: str | None):
+    import torch
+    import transformers
+
+    if model_name:
+        return transformers.GPT2LMHeadModel.from_pretrained(model_name)
+    config = transformers.GPT2Config(
+        vocab_size=97, n_positions=128, n_embd=64, n_layer=2, n_head=4,
+        attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0,
+    )
+    torch.manual_seed(0)
+    return transformers.GPT2LMHeadModel(config)
+
+
+def train(
+    model_name: str | None = None,
+    num_workers: int = 1,
+    num_epochs: int = 1,
+    batch_size: int = 8,
+    lora_rank: int = 8,
+    smoke_test: bool = False,
+):
+    hf = _load_hf(model_name)
+    cfg, params = import_gpt2(hf)
+    cfg = dataclasses.replace(
+        cfg, lora_rank=lora_rank, lr=1e-3, warmup_steps=0,
+    )
+    params = add_lora_adapters(params, cfg, jax.random.PRNGKey(0))
+
+    model = GPT(cfg, attn_impl="auto")
+    model.initial_params = params
+
+    trainer = Trainer(
+        strategy=RayShardedStrategy(num_workers=num_workers, zero_stage=1),
+        max_epochs=num_epochs,
+        default_root_dir="rlt_logs/finetune",
+        enable_checkpointing=False,
+        limit_train_batches=2 if smoke_test else None,
+        limit_val_batches=0,
+    )
+    trainer.fit(model, SyntheticLMDataModule(
+        cfg, batch_size=batch_size, num_batches=2 if smoke_test else 64,
+    ))
+
+    tuned = jax.device_get(trainer.params)
+    # The base is untouched; only adapters learned.
+    assert (tuned["blocks"]["qkv_w"] == params["blocks"]["qkv_w"]).all()
+    merged = merge_lora(tuned, cfg)
+    base = GPT(dataclasses.replace(cfg, lora_rank=0), attn_impl="auto")
+    out = generate(base, merged, jnp.ones((1, 8), jnp.int32),
+                   max_new_tokens=8)
+    print("generated continuation:", np.asarray(out)[0, 8:].tolist())
+    return trainer
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--model-name", type=str, default=None,
+                        help="HF checkpoint (e.g. gpt2); default: tiny "
+                             "random-init stand-in (offline-safe)")
+    parser.add_argument("--num-workers", type=int, default=1)
+    parser.add_argument("--num-epochs", type=int, default=1)
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--lora-rank", type=int, default=8)
+    parser.add_argument("--smoke-test", action="store_true")
+    args = parser.parse_args()
+    train(
+        model_name=args.model_name,
+        num_workers=args.num_workers,
+        num_epochs=args.num_epochs,
+        batch_size=args.batch_size,
+        lora_rank=args.lora_rank,
+        smoke_test=args.smoke_test,
+    )

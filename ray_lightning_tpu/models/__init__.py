@@ -1,4 +1,5 @@
 from .boring import BoringModel, BoringDataModule, XORModel, XORDataModule
+from .data_text import ByteLMDataModule, decode_bytes
 from .generate import decode_step, generate, init_kv_cache, prefill
 from .gpt import (
     GPT,
@@ -18,6 +19,8 @@ __all__ = [
     "prefill",
     "BoringModel",
     "BoringDataModule",
+    "ByteLMDataModule",
+    "decode_bytes",
     "XORModel",
     "XORDataModule",
     "MNISTClassifier",

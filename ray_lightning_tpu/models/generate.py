@@ -126,6 +126,20 @@ def _trunk_pass(cfg, params, cache, x, off, c):
     return logits, {"k": k_new, "v": v_new}
 
 
+def _reject_unmerged_lora(params: Dict[str, Any]) -> None:
+    """The decode block math consumes raw ``qkv_w``/``proj_w`` only; a
+    LoRA-bearing tree would silently generate from the frozen base
+    weights.  Checked at every public inference entry (trace-time cost
+    only — it inspects dict keys, not values)."""
+    if any(str(k).startswith("lora_") for k in params.get("blocks", {})):
+        raise ValueError(
+            "params contain LoRA adapters, which the decode path does "
+            "not apply — running them would silently generate from the "
+            "frozen base weights. Fold them first: "
+            "params = merge_lora(params, cfg)."
+        )
+
+
 def prefill(
     cfg: GPTConfig,
     params: Dict[str, Any],
@@ -140,6 +154,7 @@ def prefill(
     sequential single-token steps — the matmuls stay large for the MXU
     and the cache is written once per layer.
     """
+    _reject_unmerged_lora(params)
     c = compute_dtype
     T = tokens.shape[1]
     x = (params["wte"][tokens] + params["wpe"][:T]).astype(c)
@@ -156,6 +171,7 @@ def decode_step(
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """One token per sequence: ``tokens (B,) at position pos`` →
     ``(logits (B, V) f32, updated cache)``."""
+    _reject_unmerged_lora(params)
     c = compute_dtype
     x = (params["wte"][tokens] + params["wpe"][pos]).astype(c)[:, None]
     return _trunk_pass(cfg, params, cache, x, pos, c)
@@ -237,13 +253,7 @@ def generate(
         generated continuation.
     """
     cfg = module.config
-    if any(str(k).startswith("lora_") for k in params.get("blocks", {})):
-        raise ValueError(
-            "params contain LoRA adapters, which the decode path does "
-            "not apply — running them would silently generate from the "
-            "frozen base weights. Fold them first: "
-            "params = merge_lora(params, module.config)."
-        )
+    _reject_unmerged_lora(params)
     B, t0 = prompt.shape
     if t0 < 1:
         raise ValueError("prompt must contain at least one token")

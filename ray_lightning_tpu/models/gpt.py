@@ -616,6 +616,15 @@ def add_lora_adapters(
     warm-start a ``lora_rank > 0`` fit via ``module.initial_params``."""
     if cfg.lora_rank <= 0:
         return params
+    existing = [k for k in params["blocks"] if str(k).startswith("lora_")]
+    if existing:
+        # Overwriting would silently replace TRAINED adapters with
+        # fresh zero-delta ones — reverting the model to the base.
+        raise ValueError(
+            f"params already contain LoRA adapters ({sorted(existing)}); "
+            f"refusing to overwrite them. merge_lora() first, or reuse "
+            f"the existing adapters."
+        )
     return {
         **params,
         "blocks": {**params["blocks"], **_init_lora_blocks(cfg, rng)},

@@ -302,3 +302,82 @@ def test_decay_mask_exempts_norms_biases_everywhere():
     assert mv["patch_w"] and mv["head_w"] and mv["blocks"]["mlp_in_w"]
     assert not (mv["pos"] or mv["patch_b"] or mv["head_b"]
                 or mv["blocks"]["mlp_in_b"] or mv["blocks"]["ln1_g"])
+
+
+class TestByteLMDataModule:
+    def _write_text(self, tmp_path, n=4096):
+        p = tmp_path / "corpus.txt"
+        text = ("the quick brown fox jumps over the lazy dog. " * 200)
+        p.write_bytes(text.encode()[:n])
+        return str(p)
+
+    def test_windows_shape_and_bos(self, tmp_path):
+        from ray_lightning_tpu.models import ByteLMDataModule
+
+        dm = ByteLMDataModule(self._write_text(tmp_path), seq_len=64,
+                              batch_size=4)
+        dm.set_shard(0, 1)
+        dm.setup("fit")
+        batch = next(iter(dm.train_dataloader()))
+        assert batch["tokens"].shape == (4, 65)
+        assert batch["tokens"].dtype == np.int32
+        assert (batch["tokens"][:, 0] == 256).all()  # BOS
+        assert batch["tokens"].max() < ByteLMDataModule.vocab_size
+
+    def test_gpt_trains_on_real_text(self, tmp_path):
+        """End-to-end: byte-level GPT on real text, loss clearly below
+        uniform (ln 384 ≈ 5.95) after one epoch on repetitive text."""
+        from ray_lightning_tpu.models import ByteLMDataModule
+
+        dm = ByteLMDataModule(self._write_text(tmp_path, n=8192),
+                              seq_len=64, batch_size=8)
+        cfg = GPTConfig(vocab_size=ByteLMDataModule.vocab_size,
+                        n_layer=2, n_head=4, d_model=128, seq_len=64,
+                        warmup_steps=2, lr=3e-3)
+        tr = Trainer(strategy=LocalStrategy(), max_epochs=2,
+                     enable_checkpointing=False,
+                     default_root_dir=str(tmp_path))
+        tr.fit(GPT(cfg), dm)
+        assert tr.callback_metrics["train_loss"] < 4.0
+
+    def test_too_short_file_rejected(self, tmp_path):
+        from ray_lightning_tpu.models import ByteLMDataModule
+
+        p = tmp_path / "tiny.txt"
+        p.write_bytes(b"short")
+        dm = ByteLMDataModule(str(p), seq_len=64)
+        with pytest.raises(ValueError, match="too short"):
+            dm.setup("fit")
+
+    def test_decode_bytes_roundtrip(self):
+        from ray_lightning_tpu.models import decode_bytes
+
+        toks = [256] + [ord(c) for c in "hello"] + [300]
+        assert decode_bytes(np.asarray(toks)) == "hello"
+
+
+def test_bytelm_requires_full_batches(tmp_path):
+    """A file passing a naive 'two windows' check but yielding ZERO full
+    train batches must be rejected, not silently train nothing."""
+    from ray_lightning_tpu.models import ByteLMDataModule
+
+    p = tmp_path / "small.txt"
+    p.write_bytes(b"x" * 600)  # 9 windows at seq_len=64 < 8 train + 8 val
+    dm = ByteLMDataModule(str(p), seq_len=64, batch_size=8)
+    with pytest.raises(ValueError, match="too short"):
+        dm.setup("fit")
+
+
+def test_bytelm_val_is_file_tail(tmp_path):
+    """Temporal holdout: validation windows come from the END of the
+    file (documented contract — val on unseen later text)."""
+    from ray_lightning_tpu.models import ByteLMDataModule
+
+    p = tmp_path / "ab.txt"
+    # First 2/3 'a' bytes, final third 'b' bytes.
+    p.write_bytes(b"a" * 4000 + b"b" * 2000)
+    dm = ByteLMDataModule(str(p), seq_len=50, batch_size=4)
+    dm.set_shard(0, 1)
+    dm.setup("fit")
+    val = next(iter(dm.val_dataloader()))["tokens"]
+    assert (val[:, 1:] == ord("b")).all()  # tail-only content

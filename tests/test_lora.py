@@ -231,3 +231,25 @@ def test_clip_sees_adapter_norm_only():
     # (~lr * sign); if the clip had seen the 1e6 base norm, the adapter
     # update would be ~0.
     assert float(jnp.abs(lora_up).max()) > 1e-3
+
+
+def test_prefill_and_decode_reject_unmerged_lora():
+    from ray_lightning_tpu.models.generate import (
+        decode_step, init_kv_cache, prefill,
+    )
+
+    cfg = lora_cfg()
+    params = GPT(cfg).init_params(jax.random.PRNGKey(0))
+    cache = init_kv_cache(cfg, batch=1, total_len=8)
+    with pytest.raises(ValueError, match="merge_lora"):
+        prefill(cfg, params, cache, jnp.ones((1, 4), jnp.int32))
+    with pytest.raises(ValueError, match="merge_lora"):
+        decode_step(cfg, params, cache, jnp.ones((1,), jnp.int32),
+                    jnp.asarray(4))
+
+
+def test_add_lora_adapters_refuses_overwrite():
+    cfg = lora_cfg()
+    params = jax.device_get(GPT(cfg).init_params(jax.random.PRNGKey(0)))
+    with pytest.raises(ValueError, match="already contain"):
+        add_lora_adapters(params, cfg, jax.random.PRNGKey(1))
