@@ -448,6 +448,32 @@ def _run_validation(
     return acc.result()
 
 
+def _enable_compile_cache() -> None:
+    """Opt-in persistent XLA compilation cache (``RLT_COMPILE_CACHE``).
+
+    Workers receive it as ``JAX_COMPILATION_CACHE_DIR`` before their
+    first jax import (strategy env bus); this in-process hook covers the
+    LocalStrategy/driver path, where jax is already imported and only
+    ``jax.config`` still takes effect.  Failures are non-fatal — the
+    cache is an amortization, never a correctness dependency.
+    """
+    cache_dir = os.environ.get("RLT_COMPILE_CACHE")
+    if not cache_dir:
+        return
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # Cache EVERY compile: the default threshold skips "fast"
+        # compiles, but on the remote-TPU tunnel even those carry
+        # multi-second dispatch latency, and a threshold makes tiny-step
+        # caching nondeterministic (observed: the same fit caches or not
+        # depending on host load).
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception as e:  # noqa: BLE001 - best-effort amortization
+        import warnings
+
+        warnings.warn(f"RLT_COMPILE_CACHE ignored ({e})")
+
+
 def run_fit(
     module: TpuModule,
     datamodule: TpuDataModule,
@@ -467,6 +493,7 @@ def run_fit(
     path (+ callback states so driver-side callback objects reflect what
     happened remotely).
     """
+    _enable_compile_cache()
     tx = module.configure_optimizers()
     # configure_optimizers may return (tx, lr_schedule); careful — a bare
     # optax.GradientTransformation is itself a NamedTuple, so test for the
@@ -855,6 +882,7 @@ def run_eval(
 ) -> Dict[str, Any]:
     """Validation/test loop (≙ reference ``start_evaluating``,
     ``ray_ddp.py:283-286``)."""
+    _enable_compile_cache()
     stage = "validate" if kind == "validation" else "test"
     ctx = LoopContext(config, global_rank, world_size, mesh, queue)
     ctx.step_mode = mode
@@ -908,6 +936,7 @@ def run_predict(
     concatenates in rank order (an upgrade over the reference, which only
     returned rank-0 results).
     """
+    _enable_compile_cache()
     module.setup("predict")
     datamodule.set_shard(global_rank, world_size)
     datamodule.setup("predict")

@@ -224,6 +224,23 @@ class TpuStrategy:
         self.backend_name = backend
         self.mesh_axes = mesh_axes
         self.env_per_worker = dict(env_per_worker or {})
+        # Persistent XLA compilation cache (RLT_COMPILE_CACHE=dir): the
+        # first GPT-2-scale compile costs 20-40s on this platform; a
+        # shared on-disk cache amortizes it across worker respawns
+        # (elastic restarts), tuner trials, and sessions.  Forwarded as
+        # JAX_COMPILATION_CACHE_DIR, which must land BEFORE the worker's
+        # first jax import — exactly the pre-exec env contract actors
+        # already provide (≙ the reference's env bus, ray_ddp.py:215-228).
+        cache_dir = os.environ.get("RLT_COMPILE_CACHE")
+        if cache_dir and "JAX_COMPILATION_CACHE_DIR" not in self.env_per_worker:
+            self.env_per_worker["JAX_COMPILATION_CACHE_DIR"] = cache_dir
+            # Mirror the driver-side hook's threshold: without this,
+            # worker compiles under jax's ~1s default are silently not
+            # cached — exactly the nondeterminism the knob exists to
+            # remove.
+            self.env_per_worker.setdefault(
+                "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0"
+            )
         # Elastic fault tolerance (extends the reference, which only
         # fails fast — SURVEY §5 "failure detection: ABSENT"): on worker
         # death during fit, respawn the worker set up to ``max_restarts``
