@@ -448,19 +448,30 @@ def _run_validation(
     return acc.result()
 
 
+_compile_cache_on = [False]
+
+
 def _enable_compile_cache() -> None:
     """Opt-in persistent XLA compilation cache (``RLT_COMPILE_CACHE``).
 
     Workers receive it as ``JAX_COMPILATION_CACHE_DIR`` before their
     first jax import (strategy env bus); this in-process hook covers the
     LocalStrategy/driver path, where jax is already imported and only
-    ``jax.config`` still takes effect.  Failures are non-fatal — the
-    cache is an amortization, never a correctness dependency.
+    ``jax.config`` still takes effect.  The knob tracks the env var in
+    BOTH directions: unsetting it before a later fit in the same process
+    restores the defaults, so an A/B attribution run's "cache off" arm
+    really runs uncached.  Failures are non-fatal — the cache is an
+    amortization, never a correctness dependency.
     """
     cache_dir = os.environ.get("RLT_COMPILE_CACHE")
-    if not cache_dir:
-        return
     try:
+        if not cache_dir:
+            if _compile_cache_on[0]:
+                jax.config.update("jax_compilation_cache_dir", None)
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 1.0)
+                _compile_cache_on[0] = False
+            return
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         # Cache EVERY compile: the default threshold skips "fast"
         # compiles, but on the remote-TPU tunnel even those carry
@@ -468,6 +479,7 @@ def _enable_compile_cache() -> None:
         # caching nondeterministic (observed: the same fit caches or not
         # depending on host load).
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        _compile_cache_on[0] = True
     except Exception as e:  # noqa: BLE001 - best-effort amortization
         import warnings
 

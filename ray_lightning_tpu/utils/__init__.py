@@ -17,11 +17,12 @@ __all__ = [
     "save_orbax",
     "load_orbax",
     "import_gpt2",
+    "export_gpt2",
     "gpt_config_from_hf",
 ]
 
 _ORBAX_NAMES = ("ORBAX_INSTALLED", "save_orbax", "load_orbax")
-_HF_NAMES = ("import_gpt2", "gpt_config_from_hf")
+_HF_NAMES = ("import_gpt2", "export_gpt2", "gpt_config_from_hf")
 
 
 def __getattr__(name):

@@ -27,7 +27,7 @@ from typing import Any, Dict, Tuple
 
 import numpy as np
 
-__all__ = ["import_gpt2", "gpt_config_from_hf"]
+__all__ = ["import_gpt2", "export_gpt2", "gpt_config_from_hf"]
 
 
 def gpt_config_from_hf(hf_config) -> "GPTConfig":  # noqa: F821
@@ -84,6 +84,72 @@ def gpt_config_from_hf(hf_config) -> "GPTConfig":  # noqa: F821
 
 def _t(tensor) -> np.ndarray:
     return tensor.detach().cpu().numpy().astype(np.float32)
+
+
+def export_gpt2(params, cfg) -> "transformers.GPT2LMHeadModel":  # noqa: F821
+    """The inverse of :func:`import_gpt2`: an in-framework GPT param
+    tree → a ``transformers.GPT2LMHeadModel`` carrying those weights.
+
+    The migration-OUT path: train/fine-tune here, then serve with the
+    HF ecosystem (pipelines, ONNX export, hub upload).  LoRA trees must
+    be merged first (``models.gpt.merge_lora``) — adapters have no HF
+    GPT-2 representation, so exporting them unmerged is rejected.
+    """
+    import torch
+    import transformers
+
+    if any(str(k).startswith("lora_") for k in params.get("blocks", {})):
+        raise ValueError(
+            "params contain LoRA adapters with no GPT-2 representation; "
+            "merge_lora(params, cfg) before export"
+        )
+    if getattr(cfg, "n_experts", 0) > 0:
+        raise ValueError(
+            "MoE blocks have no GPT-2 representation; export is dense-only"
+        )
+    if getattr(cfg, "mlp_ratio", 4) != 4:
+        raise ValueError(
+            f"mlp_ratio {cfg.mlp_ratio} != 4: GPT-2's n_inner is 4*n_embd "
+            f"(the import side enforces the same symmetry)"
+        )
+    hf_config = transformers.GPT2Config(
+        vocab_size=cfg.vocab_size,
+        n_positions=cfg.seq_len,
+        n_embd=cfg.d_model,
+        n_layer=cfg.n_layer,
+        n_head=cfg.n_head,
+        activation_function="gelu_new",
+        layer_norm_epsilon=1e-5,
+    )
+    model = transformers.GPT2LMHeadModel(hf_config)
+    tr = model.transformer
+
+    def put(torch_param, value):
+        with torch.no_grad():
+            torch_param.copy_(torch.from_numpy(np.asarray(value,
+                                                          np.float32)))
+
+    put(tr.wte.weight, params["wte"])
+    put(tr.wpe.weight, params["wpe"])
+    b = params["blocks"]
+    for i, block in enumerate(tr.h):
+        put(block.ln_1.weight, b["ln1_g"][i])
+        put(block.ln_1.bias, b["ln1_b"][i])
+        put(block.attn.c_attn.weight, b["qkv_w"][i])
+        put(block.attn.c_attn.bias, b["qkv_b"][i])
+        put(block.attn.c_proj.weight, b["proj_w"][i])
+        put(block.attn.c_proj.bias, b["proj_b"][i])
+        put(block.ln_2.weight, b["ln2_g"][i])
+        put(block.ln_2.bias, b["ln2_b"][i])
+        put(block.mlp.c_fc.weight, b["mlp_in_w"][i])
+        put(block.mlp.c_fc.bias, b["mlp_in_b"][i])
+        put(block.mlp.c_proj.weight, b["mlp_out_w"][i])
+        put(block.mlp.c_proj.bias, b["mlp_out_b"][i])
+    put(tr.ln_f.weight, params["ln_f_g"])
+    put(tr.ln_f.bias, params["ln_f_b"])
+    model.tie_weights()  # lm_head shares wte, as in the source tree
+    model.eval()
+    return model
 
 
 def import_gpt2(hf_model) -> Tuple["GPTConfig", Dict[str, Any]]:  # noqa: F821

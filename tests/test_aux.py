@@ -196,3 +196,19 @@ def test_compile_cache_knob(tmp_path, monkeypatch):
     preds = trainer.predict(BoringModel(), BoringDataModule())
     assert len(preds) > 0
     assert len(os.listdir(cache)) >= n_before
+
+
+def test_compile_cache_knob_disables_on_unset(tmp_path, monkeypatch):
+    """Unsetting RLT_COMPILE_CACHE before a later fit restores the
+    uncached defaults (A/B attribution runs must not leak cache state)."""
+    import jax as _jax
+
+    from ray_lightning_tpu.core.loop import _enable_compile_cache
+
+    cache = str(tmp_path / "xla_cache2")
+    monkeypatch.setenv("RLT_COMPILE_CACHE", cache)
+    _enable_compile_cache()
+    assert _jax.config.jax_compilation_cache_dir == cache
+    monkeypatch.delenv("RLT_COMPILE_CACHE")
+    _enable_compile_cache()
+    assert _jax.config.jax_compilation_cache_dir is None

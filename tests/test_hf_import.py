@@ -148,3 +148,56 @@ def test_resume_skips_preset_transfer(tmp_path):
     cfg2 = FitConfig(max_epochs=2, seed=0, default_root_dir=str(tmp_path),
                      resume_from_checkpoint=p)
     run_fit(m2, x_dm, cfg2, callbacks=[])  # must not touch the preset
+
+
+def test_export_roundtrip_logits_parity():
+    """Train here, serve with HF: export reproduces the in-framework
+    logits, and import(export(x)) is the identity on weights."""
+    from ray_lightning_tpu.utils import export_gpt2
+
+    hf = _tiny_hf()
+    cfg, params = import_gpt2(hf)
+    # Perturb so we are not merely exporting what we imported.
+    params["blocks"]["mlp_in_w"] = params["blocks"]["mlp_in_w"] + 0.01
+
+    model = GPT(cfg, attn_impl="xla")
+    model.precision = "f32"
+    tokens = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (2, 16)).astype(np.int64)
+    ours = np.asarray(jax.jit(model.forward)(
+        params, jnp.asarray(tokens, jnp.int32)))
+
+    exported = export_gpt2(params, cfg)
+    with torch.no_grad():
+        ref = exported(torch.from_numpy(tokens)).logits.numpy()
+    np.testing.assert_allclose(ref, ours, rtol=2e-4, atol=2e-4)
+
+    cfg2, params2 = import_gpt2(exported)
+    assert cfg2 == cfg
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(params2)):
+        np.testing.assert_allclose(np.asarray(a), b, rtol=1e-6, atol=1e-6)
+
+
+def test_export_rejects_unmerged_lora():
+    from ray_lightning_tpu.models import GPT as _GPT
+    from ray_lightning_tpu.models.gpt import GPTConfig as _Cfg
+    from ray_lightning_tpu.utils import export_gpt2
+
+    cfg = _Cfg(vocab_size=97, n_layer=1, n_head=2, d_model=32,
+               seq_len=16, lora_rank=2)
+    params = _GPT(cfg).init_params(jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="merge_lora"):
+        export_gpt2(jax.device_get(params), cfg)
+
+
+def test_export_rejects_moe_and_wide_mlp():
+    from ray_lightning_tpu.models.gpt import GPTConfig as _Cfg
+    from ray_lightning_tpu.utils import export_gpt2
+
+    with pytest.raises(ValueError, match="MoE"):
+        export_gpt2({"blocks": {}}, _Cfg.tiny_moe(n_experts=2))
+    with pytest.raises(ValueError, match="mlp_ratio"):
+        export_gpt2({"blocks": {}}, _Cfg(vocab_size=64, n_layer=1,
+                                         n_head=2, d_model=32, seq_len=16,
+                                         mlp_ratio=2))
