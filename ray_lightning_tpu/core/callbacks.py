@@ -26,6 +26,7 @@ __all__ = [
     "ModelCheckpoint",
     "EarlyStopping",
     "CSVLogger",
+    "StochasticWeightAveraging",
     "DeviceStatsCallback",
     "ProfilerCallback",
 ]
@@ -450,3 +451,73 @@ class DeviceStatsCallback(Callback):
     def load_state_dict(self, state: Dict[str, Any]) -> None:
         self.epoch_times = list(state.get("epoch_times", []))
         self.peak_memories = list(state.get("peak_memories", []))
+
+
+class StochasticWeightAveraging(Callback):
+    """SWA: average the weights visited over the tail of training.
+
+    ≙ ``pl.callbacks.StochasticWeightAveraging``.  From
+    ``swa_start_epoch`` onward, the end-of-epoch params enter a running
+    mean; at fit end the averaged weights REPLACE the trained ones, so
+    the RETURNED state (``trainer.params``, the driver's recovered
+    weights, a post-fit ``trainer.save_checkpoint``) is the SWA point.
+    Checkpoints written DURING the fit (ModelCheckpoint epochs, elastic
+    restart snapshots) predate the swap and hold the raw weights —
+    serve from the post-fit state, not from a mid-fit
+    ``best_model_path``.
+
+    TPU-first: the running mean is a device pytree updated with one
+    fused ``tree_map`` per epoch — no host round-trip, and sharded
+    params average shard-local (the mean of identically-sharded trees
+    is identically sharded, so no resharding or gather happens).
+
+    Note the standard SWA caveat: the optimizer state is NOT averaged —
+    resuming training from an SWA checkpoint restarts optimization at
+    the averaged point.
+    """
+
+    def __init__(self, swa_start_epoch: int = 1):
+        if swa_start_epoch < 0:
+            raise ValueError("swa_start_epoch must be >= 0")
+        self.swa_start_epoch = swa_start_epoch
+        self._mean = None
+        self._count = 0
+
+    def on_train_epoch_end(self, trainer, module) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        if trainer.current_epoch < self.swa_start_epoch:
+            return
+        params = trainer.state.params
+        self._count += 1
+        if self._mean is None:
+            # COPY, never alias: the train step donates the state
+            # buffers, so holding the live params pytree would leave the
+            # mean pointing at deleted memory one step later.
+            self._mean = jax.tree_util.tree_map(jnp.copy, params)
+            return
+        n = float(self._count)
+        self._mean = jax.tree_util.tree_map(
+            lambda m, p: m + (p.astype(m.dtype) - m) / n, self._mean, params
+        )
+
+    def on_fit_end(self, trainer, module) -> None:
+        if self._mean is None:
+            return
+        from ray_lightning_tpu.core.module import TrainState
+
+        st = trainer.state
+        trainer.state = TrainState(self._mean, st.opt_state, st.step)
+
+    # SWA state is NOT persisted across resumes: the running mean is a
+    # full params-sized pytree — shipping it through every restart
+    # checkpoint would double their size.  A resumed fit restarts the
+    # average from the resume epoch (documented Lightning behavior for
+    # mid-SWA restarts is similarly lossy).
+    def state_dict(self) -> Dict[str, Any]:
+        return {"swa_start_epoch": self.swa_start_epoch}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.swa_start_epoch = state.get(
+            "swa_start_epoch", self.swa_start_epoch)

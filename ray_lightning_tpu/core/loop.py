@@ -448,7 +448,7 @@ def _run_validation(
     return acc.result()
 
 
-_compile_cache_on = [False]
+_compile_cache_dir = [None]  # the dir this process last configured
 
 
 def _enable_compile_cache() -> None:
@@ -458,28 +458,34 @@ def _enable_compile_cache() -> None:
     first jax import (strategy env bus); this in-process hook covers the
     LocalStrategy/driver path, where jax is already imported and only
     ``jax.config`` still takes effect.  The knob tracks the env var in
-    BOTH directions: unsetting it before a later fit in the same process
-    restores the defaults, so an A/B attribution run's "cache off" arm
-    really runs uncached.  Failures are non-fatal — the cache is an
-    amortization, never a correctness dependency.
+    BOTH directions — unset it before a later fit and that fit really
+    runs uncached (A/B attribution).  Any transition (on/off/dir change)
+    also calls jax's ``reset_cache``: jax memoizes the cache decision
+    and the cache object at the first compile, so flipping the config
+    alone would silently keep using the previous directory.  Failures
+    are non-fatal — the cache is an amortization, never a correctness
+    dependency.
     """
-    cache_dir = os.environ.get("RLT_COMPILE_CACHE")
+    cache_dir = os.environ.get("RLT_COMPILE_CACHE") or None
+    if cache_dir == _compile_cache_dir[0]:
+        return
     try:
-        if not cache_dir:
-            if _compile_cache_on[0]:
-                jax.config.update("jax_compilation_cache_dir", None)
-                jax.config.update(
-                    "jax_persistent_cache_min_compile_time_secs", 1.0)
-                _compile_cache_on[0] = False
-            return
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc,
+        )
+
+        _cc.reset_cache()
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        # Cache EVERY compile: the default threshold skips "fast"
-        # compiles, but on the remote-TPU tunnel even those carry
+        # Cache EVERY compile when on: the default ~1s threshold skips
+        # "fast" compiles, but on the remote-TPU tunnel even those carry
         # multi-second dispatch latency, and a threshold makes tiny-step
         # caching nondeterministic (observed: the same fit caches or not
         # depending on host load).
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-        _compile_cache_on[0] = True
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            0.0 if cache_dir else 1.0,
+        )
+        _compile_cache_dir[0] = cache_dir
     except Exception as e:  # noqa: BLE001 - best-effort amortization
         import warnings
 

@@ -199,9 +199,11 @@ def test_compile_cache_knob(tmp_path, monkeypatch):
 
 
 def test_compile_cache_knob_disables_on_unset(tmp_path, monkeypatch):
-    """Unsetting RLT_COMPILE_CACHE before a later fit restores the
-    uncached defaults (A/B attribution runs must not leak cache state)."""
+    """Unsetting RLT_COMPILE_CACHE before a later compile really stops
+    cache writes (jax memoizes its cache decision — the disable path
+    must reset it, not just flip the config)."""
     import jax as _jax
+    import jax.numpy as _jnp
 
     from ray_lightning_tpu.core.loop import _enable_compile_cache
 
@@ -209,6 +211,81 @@ def test_compile_cache_knob_disables_on_unset(tmp_path, monkeypatch):
     monkeypatch.setenv("RLT_COMPILE_CACHE", cache)
     _enable_compile_cache()
     assert _jax.config.jax_compilation_cache_dir == cache
+    # Force a compile so jax initializes (and memoizes) the cache.
+    _jax.jit(lambda x: x * 2 + 1)(_jnp.arange(7)).block_until_ready()
+    n_on = len(os.listdir(cache))
+    assert n_on > 0
+
     monkeypatch.delenv("RLT_COMPILE_CACHE")
     _enable_compile_cache()
     assert _jax.config.jax_compilation_cache_dir is None
+    # A NEW compile in the "off" arm must not write the old directory.
+    _jax.jit(lambda x: x * 3 - 4)(_jnp.arange(11)).block_until_ready()
+    assert len(os.listdir(cache)) == n_on
+
+
+class TestSWA:
+    def test_swa_params_are_epoch_mean(self, tmp_path):
+        """The final params equal the running mean of the end-of-epoch
+        params from swa_start_epoch onward."""
+        import jax
+
+        from ray_lightning_tpu.core.callbacks import (
+            Callback, StochasticWeightAveraging,
+        )
+        from ray_lightning_tpu.core.trainer import Trainer
+        from ray_lightning_tpu.models import BoringDataModule, BoringModel
+        from ray_lightning_tpu.parallel.strategies import LocalStrategy
+
+        class Spy(Callback):
+            def __init__(self):
+                self.snaps = []
+
+            def on_train_epoch_end(self, trainer, module):
+                self.snaps.append(jax.device_get(trainer.state.params))
+
+        spy, swa = Spy(), StochasticWeightAveraging(swa_start_epoch=1)
+        trainer = Trainer(
+            strategy=LocalStrategy(), max_epochs=4,
+            # Spy FIRST so it snapshots the raw trained params before
+            # SWA folds them into its mean.
+            callbacks=[spy, swa],
+            default_root_dir=str(tmp_path), enable_checkpointing=False,
+        )
+        trainer.fit(BoringModel(), BoringDataModule())
+        tail = spy.snaps[1:]  # epochs 1..3
+        expect = jax.tree_util.tree_map(
+            lambda *xs: sum(np.asarray(x, np.float64) for x in xs)
+            / len(xs), *tail)
+        got = jax.device_get(trainer.state.params)
+        for a, b in zip(jax.tree_util.tree_leaves(expect),
+                        jax.tree_util.tree_leaves(got)):
+            np.testing.assert_allclose(np.asarray(b), a, rtol=1e-5,
+                                       atol=1e-7)
+        # And the SWA point differs from the last epoch's raw params.
+        last = jax.tree_util.tree_leaves(spy.snaps[-1])
+        assert any(
+            np.abs(np.asarray(x) - np.asarray(y)).max() > 1e-8
+            for x, y in zip(last, jax.tree_util.tree_leaves(got))
+        )
+
+    def test_swa_under_sharded_mesh(self, tmp_path):
+        """SWA composes with GSPMD sharding (shard-local averaging)."""
+        import jax
+
+        from ray_lightning_tpu.core.callbacks import StochasticWeightAveraging
+        from ray_lightning_tpu.core.trainer import Trainer
+        from ray_lightning_tpu.models import BoringDataModule, BoringModel
+        from ray_lightning_tpu.parallel.strategies import LocalStrategy
+
+        trainer = Trainer(
+            strategy=LocalStrategy(mesh_axes={"data": 4, "fsdp": 2},
+                                   zero_stage=3),
+            max_epochs=3,
+            callbacks=[StochasticWeightAveraging(swa_start_epoch=1)],
+            default_root_dir=str(tmp_path), enable_checkpointing=False,
+        )
+        trainer.fit(BoringModel(), BoringDataModule())
+        assert np.isfinite(trainer.callback_metrics["train_loss"])
+        leaves = jax.tree_util.tree_leaves(trainer.params)
+        assert all(np.all(np.isfinite(np.asarray(l))) for l in leaves)
