@@ -483,6 +483,13 @@ class StochasticWeightAveraging(Callback):
         self._mean = None
         self._count = 0
 
+    def on_fit_start(self, trainer, module) -> None:
+        # Fresh average per fit: callback instances are reused across
+        # fits (the tuner/A-B pattern), and folding a previous model's
+        # weights into this fit's mean would corrupt it silently.
+        self._mean = None
+        self._count = 0
+
     def on_train_epoch_end(self, trainer, module) -> None:
         import jax
         import jax.numpy as jnp

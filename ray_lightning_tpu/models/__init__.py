@@ -9,6 +9,7 @@ from .gpt import (
     merge_lora,
 )
 from .mnist import MNISTClassifier, MNISTDataModule
+from .quant import is_quantized, quantize_decode_params
 from .resnet import ResNet, CIFARDataModule
 from .vit import ViT, ViTConfig
 
@@ -34,4 +35,6 @@ __all__ = [
     "CIFARDataModule",
     "ViT",
     "ViTConfig",
+    "is_quantized",
+    "quantize_decode_params",
 ]

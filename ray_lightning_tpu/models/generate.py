@@ -35,6 +35,7 @@ import jax.numpy as jnp
 from ray_lightning_tpu.models.gpt import (
     GPT, GPTConfig, _layer_norm, _mlp_residual, _moe_residual,
 )
+from ray_lightning_tpu.models.quant import resolve_weight
 from ray_lightning_tpu.ops.attention import _NEG_INF
 
 __all__ = ["init_kv_cache", "prefill", "decode_step", "generate"]
@@ -69,7 +70,7 @@ def _block_pass(
     """
     B, T = x.shape[0], x.shape[1]
     h = _layer_norm(x, p["ln1_g"], p["ln1_b"])
-    qkv = h @ p["qkv_w"].astype(c) + p["qkv_b"].astype(c)
+    qkv = h @ resolve_weight(p, "qkv_w", c) + p["qkv_b"].astype(c)
     q, k, v = jnp.split(qkv, 3, axis=-1)
 
     def heads(z):
@@ -93,7 +94,7 @@ def _block_pass(
     att = jnp.einsum(
         "bhqs,bshd->bqhd", probs, v_l.astype(jnp.float32)
     ).reshape(B, T, cfg.d_model).astype(c)
-    x = x + att @ p["proj_w"].astype(c) + p["proj_b"].astype(c)
+    x = x + att @ resolve_weight(p, "proj_w", c) + p["proj_b"].astype(c)
     if cfg.n_experts > 0:
         # Same routed-MLP math as training (groups=1 — inference is
         # chip-local).  Capacity competition is per ROUTED SET: the full
@@ -120,10 +121,29 @@ def _trunk_pass(cfg, params, cache, x, off, c):
     )
     x = _layer_norm(x[:, -1], params["ln_f_g"], params["ln_f_b"])
     logits = jnp.einsum(
-        "bd,vd->bv", x, params["wte"].astype(c),
+        "bd,vd->bv", x, _wte(params, c),
         preferred_element_type=jnp.float32,
     )
     return logits, {"k": k_new, "v": v_new}
+
+
+def _wte(params, c):
+    """Token embedding table in compute dtype (int8-storage aware)."""
+    if "wte_q8" in params:
+        # Per-row scales broadcast over the feature dim.
+        return (params["wte_q8"].astype(c)
+                * params["wte_sc"].astype(c)[:, None])
+    return params["wte"].astype(c)
+
+
+def _embed(params, tokens, c):
+    """Embedding lookup in compute dtype (int8-storage aware): gather
+    the int8 rows, then scale — only the LOOKED-UP rows are converted,
+    never the whole table."""
+    if "wte_q8" in params:
+        return (params["wte_q8"][tokens].astype(c)
+                * params["wte_sc"][tokens].astype(c)[..., None])
+    return params["wte"][tokens].astype(c)
 
 
 def _reject_unmerged_lora(params: Dict[str, Any]) -> None:
@@ -157,7 +177,7 @@ def prefill(
     _reject_unmerged_lora(params)
     c = compute_dtype
     T = tokens.shape[1]
-    x = (params["wte"][tokens] + params["wpe"][:T]).astype(c)
+    x = _embed(params, tokens, c) + params["wpe"][:T].astype(c)
     return _trunk_pass(cfg, params, cache, x, 0, c)
 
 
@@ -173,7 +193,8 @@ def decode_step(
     ``(logits (B, V) f32, updated cache)``."""
     _reject_unmerged_lora(params)
     c = compute_dtype
-    x = (params["wte"][tokens] + params["wpe"][pos]).astype(c)[:, None]
+    x = (_embed(params, tokens, c)
+         + params["wpe"][pos].astype(c))[:, None]
     return _trunk_pass(cfg, params, cache, x, pos, c)
 
 

@@ -112,9 +112,14 @@ def _mlp_residual(x: jax.Array, p: Dict[str, Any], c,
     Shape-agnostic over leading dims; shared by the training scan, the
     pipeline stage, and single-token decode so the block math has one
     source."""
+    from ray_lightning_tpu.models.quant import resolve_weight
+
     h = _layer_norm(x, p["ln2_g"], p["ln2_b"], ln_pallas)
-    h = jax.nn.gelu(h @ p["mlp_in_w"].astype(c) + p["mlp_in_b"].astype(c))
-    return x + h @ p["mlp_out_w"].astype(c) + p["mlp_out_b"].astype(c)
+    h = jax.nn.gelu(
+        h @ resolve_weight(p, "mlp_in_w", c) + p["mlp_in_b"].astype(c)
+    )
+    return (x + h @ resolve_weight(p, "mlp_out_w", c)
+            + p["mlp_out_b"].astype(c))
 
 
 def _moe_residual(x, p, cfg, groups: int, ln_pallas: bool = False):
@@ -123,10 +128,13 @@ def _moe_residual(x, p, cfg, groups: int, ln_pallas: bool = False):
     (≙ the `_mlp_residual` discipline).  Returns ``(x, aux_loss)``."""
     from ray_lightning_tpu.ops.moe import moe_mlp
 
+    from ray_lightning_tpu.models.quant import resolve_weight
+
     h = _layer_norm(x, p["ln2_g"], p["ln2_b"], ln_pallas)
     y, aux = moe_mlp(
-        h, p["gate_w"], p["moe_in_w"], p["moe_in_b"],
-        p["moe_out_w"], p["moe_out_b"],
+        h, p["gate_w"],
+        resolve_weight(p, "moe_in_w", p["gate_w"].dtype), p["moe_in_b"],
+        resolve_weight(p, "moe_out_w", p["gate_w"].dtype), p["moe_out_b"],
         top_k=cfg.moe_top_k,
         capacity_factor=cfg.moe_capacity_factor,
         groups=groups,

@@ -289,3 +289,20 @@ class TestSWA:
         assert np.isfinite(trainer.callback_metrics["train_loss"])
         leaves = jax.tree_util.tree_leaves(trainer.params)
         assert all(np.all(np.isfinite(np.asarray(l))) for l in leaves)
+
+
+def test_swa_resets_between_fits(tmp_path):
+    """One SWA instance across two fits must not fold the first model's
+    weights into the second fit's average."""
+    from ray_lightning_tpu.core.callbacks import StochasticWeightAveraging
+    from ray_lightning_tpu.core.trainer import Trainer
+    from ray_lightning_tpu.models import BoringDataModule, BoringModel
+    from ray_lightning_tpu.parallel.strategies import LocalStrategy
+
+    swa = StochasticWeightAveraging(swa_start_epoch=0)
+    for _ in range(2):
+        tr = Trainer(strategy=LocalStrategy(), max_epochs=2,
+                     callbacks=[swa], default_root_dir=str(tmp_path),
+                     enable_checkpointing=False)
+        tr.fit(BoringModel(), BoringDataModule())
+        assert swa._count == 2  # epochs of THIS fit only

@@ -1,0 +1,142 @@
+"""Weight-only int8 decode quantization (net-new): storage halves vs
+bf16 (4x vs f32) on the bandwidth-bound decode path, logits stay close,
+and the generation API consumes quantized trees transparently.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.models import GPT, GPTConfig
+from ray_lightning_tpu.models.generate import (
+    generate,
+    init_kv_cache,
+    prefill,
+)
+from ray_lightning_tpu.models.quant import (
+    is_quantized,
+    quantize_decode_params,
+    resolve_weight,
+)
+
+
+def tiny():
+    return GPTConfig(vocab_size=512, n_layer=2, n_head=4, d_model=128,
+                     seq_len=128, warmup_steps=2)
+
+
+def test_per_channel_error_bound():
+    """Symmetric int8 with per-output-channel scales: reconstruction
+    error is bounded by scale/2 = amax/254 per channel."""
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((64, 96)).astype(np.float32))
+    params = {"blocks": {"qkv_w": w, "qkv_b": jnp.zeros(96)},
+              "wte": jnp.asarray(
+                  rng.standard_normal((32, 64)).astype(np.float32))}
+    q = quantize_decode_params(params, tiny())
+    deq = np.asarray(resolve_weight(q["blocks"], "qkv_w", jnp.float32))
+    amax = np.abs(np.asarray(w)).max(axis=0)
+    assert (np.abs(deq - np.asarray(w)) <= amax / 254 + 1e-7).all()
+    # wte is row-quantized.
+    deq_wte = np.asarray(q["wte_q8"]).astype(np.float32) * \
+        np.asarray(q["wte_sc"])[:, None]
+    amax_r = np.abs(np.asarray(params["wte"])).max(axis=1, keepdims=True)
+    assert (np.abs(deq_wte - np.asarray(params["wte"]))
+            <= amax_r / 254 + 1e-7).all()
+
+
+def test_quantized_tree_is_4x_smaller():
+    params = GPT(tiny()).init_params(jax.random.PRNGKey(0))
+    q = quantize_decode_params(jax.device_get(params), tiny())
+
+    def nbytes(tree, pred):
+        return sum(
+            np.asarray(x).nbytes
+            for x in jax.tree_util.tree_leaves(tree) if pred(x)
+        )
+
+    big_f32 = nbytes(params, lambda x: np.asarray(x).ndim >= 2
+                     and np.asarray(x).size > 10_000)
+    big_q = nbytes(q, lambda x: np.asarray(x).dtype == np.int8)
+    assert big_q * 3.9 < big_f32  # int8 + small scale arrays vs f32
+
+
+def test_quantized_decode_logits_close():
+    """Prefill logits from the int8 tree stay close to f32: small max
+    error and near-total top-1 agreement on a random model."""
+    cfg = tiny()
+    params = jax.device_get(GPT(cfg).init_params(jax.random.PRNGKey(0)))
+    q = quantize_decode_params(params, cfg)
+    assert is_quantized(q) and not is_quantized(params)
+
+    tokens = jnp.asarray(
+        np.random.default_rng(1).integers(0, cfg.vocab_size, (4, 32)),
+        jnp.int32)
+    cache = init_kv_cache(cfg, batch=4, total_len=48)
+    logits_f, _ = jax.jit(lambda p, t: prefill(cfg, p, cache, t))(
+        params, tokens)
+    logits_q, _ = jax.jit(lambda p, t: prefill(cfg, p, cache, t))(q, tokens)
+    lf, lq = np.asarray(logits_f), np.asarray(logits_q)
+    assert np.abs(lf - lq).max() < 0.5 * np.abs(lf).max()
+    agree = (lf.argmax(-1) == lq.argmax(-1)).mean()
+    assert agree >= 0.75, agree
+
+
+def test_generate_accepts_quantized_tree():
+    cfg = tiny()
+    params = jax.device_get(GPT(cfg).init_params(jax.random.PRNGKey(0)))
+    q = quantize_decode_params(params, cfg)
+    out = generate(GPT(cfg, attn_impl="xla"), q,
+                   jnp.ones((2, 4), jnp.int32), max_new_tokens=6)
+    out = np.asarray(out)
+    assert out.shape == (2, 10)
+    assert (out >= 0).all() and (out < cfg.vocab_size).all()
+    # Greedy decode from the quantized tree matches the f32 tree on a
+    # strong-signal model?  Not guaranteed at near-ties — instead check
+    # both decode without error and stay in-vocab (above) and that the
+    # quantized continuation equals ITSELF deterministically.
+    out2 = np.asarray(generate(GPT(cfg, attn_impl="xla"), q,
+                               jnp.ones((2, 4), jnp.int32),
+                               max_new_tokens=6))
+    np.testing.assert_array_equal(out, out2)
+
+
+def test_quantized_moe_decode_runs():
+    cfg = GPTConfig.tiny_moe(n_experts=4, moe_capacity_factor=4.0)
+    params = jax.device_get(GPT(cfg).init_params(jax.random.PRNGKey(0)))
+    q = quantize_decode_params(params, cfg)
+    assert "moe_in_w_q8" in q["blocks"]
+    out = generate(GPT(cfg, attn_impl="xla"), q,
+                   jnp.ones((1, 4), jnp.int32), max_new_tokens=4)
+    assert np.asarray(out).shape == (1, 8)
+
+
+def test_quantize_guards():
+    cfg = GPTConfig(vocab_size=128, n_layer=1, n_head=2, d_model=64,
+                    seq_len=32, lora_rank=2)
+    lora_params = jax.device_get(GPT(cfg).init_params(jax.random.PRNGKey(0)))
+    with pytest.raises(ValueError, match="merge_lora"):
+        quantize_decode_params(lora_params, cfg)
+    plain = jax.device_get(GPT(tiny()).init_params(jax.random.PRNGKey(0)))
+    q = quantize_decode_params(plain, tiny())
+    with pytest.raises(ValueError, match="already"):
+        quantize_decode_params(q, tiny())
+
+
+def test_fit_rejects_quantized_warm_start(tmp_path):
+    from ray_lightning_tpu.core.trainer import Trainer
+    from ray_lightning_tpu.models.gpt import SyntheticLMDataModule
+    from ray_lightning_tpu.parallel.strategies import LocalStrategy
+
+    cfg = tiny()
+    model = GPT(cfg)
+    model.initial_params = quantize_decode_params(
+        jax.device_get(model.init_params(jax.random.PRNGKey(0))), cfg)
+    trainer = Trainer(strategy=LocalStrategy(), max_epochs=1,
+                      limit_train_batches=1, limit_val_batches=0,
+                      enable_checkpointing=False,
+                      default_root_dir=str(tmp_path))
+    with pytest.raises(Exception, match="int8-quantized"):
+        trainer.fit(model, SyntheticLMDataModule(cfg, batch_size=8,
+                                                 num_batches=1))
