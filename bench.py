@@ -189,10 +189,13 @@ def _bench_fit(module: GPT, cfg: GPTConfig, batch_size: int):
 
 def _bench_generate(module: GPT, cfg: GPTConfig, on_tpu: bool):
     """Greedy decode throughput (new tokens/s, whole batch) through the
-    KV-cache generation path.  Strictly best-effort: any failure returns
-    None rather than costing the headline training line."""
+    KV-cache generation path — f32/bf16 weights AND the int8-storage
+    tree (models/quant.py), so the weight-traffic win is recorded.
+    Strictly best-effort: any failure returns None rather than costing
+    the headline training line."""
     try:
         from ray_lightning_tpu.models.generate import generate
+        from ray_lightning_tpu.models.quant import quantize_decode_params
 
         B = 8 if on_tpu else 2
         new = 128 if on_tpu else 8
@@ -202,17 +205,26 @@ def _bench_generate(module: GPT, cfg: GPTConfig, on_tpu: bool):
         fn = jax.jit(
             lambda p, pr: generate(module, p, pr, max_new_tokens=new)
         )
-        jax.block_until_ready(fn(params, prompt))  # compile
-        tps = []
-        for _ in range(WINDOWS):
-            t0 = time.perf_counter()
-            jax.block_until_ready(fn(params, prompt))
-            tps.append(B * new / (time.perf_counter() - t0))
-        med, _ = _median_spread(tps)
-        return round(med, 1)
+
+        def measure(tree):
+            jax.block_until_ready(fn(tree, prompt))  # compile
+            tps = []
+            for _ in range(WINDOWS):
+                t0 = time.perf_counter()
+                jax.block_until_ready(fn(tree, prompt))
+                tps.append(B * new / (time.perf_counter() - t0))
+            return round(_median_spread(tps)[0], 1)
+
+        full = measure(params)
+        try:
+            q8 = measure(quantize_decode_params(params, cfg))
+        except Exception as e:  # noqa: BLE001 - int8 arm is optional
+            sys.stderr.write(f"int8 decode bench skipped: {e}\n")
+            q8 = None
+        return full, q8
     except Exception as e:  # pragma: no cover - defensive
         sys.stderr.write(f"generate bench skipped: {e}\n")
-        return None
+        return None, None
 
 
 def _kernel_paths(cfg: GPTConfig, on_tpu: bool) -> dict:
@@ -298,7 +310,7 @@ def main() -> None:
     kernel_path = _kernel_paths(cfg, on_tpu)
     raw_tps, raw_spread = _bench_raw_step(make_module(), cfg, batch_size)
     fit_tps, fit_spread = _bench_fit(make_module(), cfg, batch_size)
-    gen_tps = _bench_generate(make_module(), cfg, on_tpu)
+    gen_tps, gen_tps_int8 = _bench_generate(make_module(), cfg, on_tpu)
 
     peak = _peak_flops_per_chip() if on_tpu else None
 
@@ -322,6 +334,7 @@ def main() -> None:
         "spread_pct": round(fit_spread, 2),
         "raw_spread_pct": round(raw_spread, 2),
         "generate_tokens_per_sec": gen_tps,
+        "generate_tokens_per_sec_int8": gen_tps_int8,
         "kernel_path": kernel_path,
         "remat_policy": remat_policy,
         "windows": WINDOWS,

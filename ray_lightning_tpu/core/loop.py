@@ -342,18 +342,18 @@ def init_train_state(
     # GPT-scale pytree to the mesh just to discard it is gigabytes of
     # wasted transfer per worker per restart.
     preset = getattr(module, "initial_params", None) if use_preset else None
-    if preset is not None and (
-        "wte_q8" in preset
-        or any(str(k).endswith("_q8") for k in preset.get("blocks", {}))
-    ):
-        # int8 decode storage (models/quant.py) is inference-only: the
-        # optimizer cannot step int8 weights, and silently dequantizing
-        # would train a different (already-rounded) model.
-        raise ValueError(
-            "initial_params are int8-quantized (decode storage); "
-            "training needs the original float tree — keep it, or "
-            "dequantize explicitly before warm-starting"
-        )
+    if preset is not None and isinstance(preset, dict):
+        from ray_lightning_tpu.models.quant import is_quantized
+
+        if is_quantized(preset):
+            # int8 decode storage (models/quant.py) is inference-only:
+            # the optimizer cannot step int8 weights, and silently
+            # dequantizing would train an already-rounded model.
+            raise ValueError(
+                "initial_params are int8-quantized (decode storage); "
+                "training needs the original float tree — keep it, or "
+                "dequantize explicitly before warm-starting"
+            )
 
     def make(r):
         params = module.init_params(r)

@@ -81,10 +81,9 @@ def quantize_decode_params(
     if is_quantized(params):
         raise ValueError("params are already int8-quantized")
     blocks = dict(params["blocks"])
-    if getattr(cfg, "n_experts", 0) > 0:
-        quant_keys = _QUANT_BLOCK_KEYS + ("moe_in_w", "moe_out_w")
-    else:
-        quant_keys = _QUANT_BLOCK_KEYS
+    # Keyed on TREE contents, not cfg: a cfg/tree mismatch must never
+    # silently leave the dominant (expert) weights unquantized.
+    quant_keys = _QUANT_BLOCK_KEYS + ("moe_in_w", "moe_out_w")
     for key in quant_keys:
         if key not in blocks:
             continue
