@@ -342,7 +342,9 @@ def init_train_state(
     # GPT-scale pytree to the mesh just to discard it is gigabytes of
     # wasted transfer per worker per restart.
     preset = getattr(module, "initial_params", None) if use_preset else None
-    if preset is not None and isinstance(preset, dict):
+    import collections.abc
+
+    if preset is not None and isinstance(preset, collections.abc.Mapping):
         from ray_lightning_tpu.models.quant import is_quantized
 
         if is_quantized(preset):

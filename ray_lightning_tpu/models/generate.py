@@ -151,7 +151,9 @@ def _reject_unmerged_lora(params: Dict[str, Any]) -> None:
     LoRA-bearing tree would silently generate from the frozen base
     weights.  Checked at every public inference entry (trace-time cost
     only — it inspects dict keys, not values)."""
-    if any(str(k).startswith("lora_") for k in params.get("blocks", {})):
+    from ray_lightning_tpu.models.gpt import has_lora_adapters
+
+    if has_lora_adapters(params):
         raise ValueError(
             "params contain LoRA adapters, which the decode path does "
             "not apply — running them would silently generate from the "

@@ -37,7 +37,7 @@ from ray_lightning_tpu.core.module import TpuModule
 from ray_lightning_tpu.ops import causal_attention
 
 __all__ = ["GPTConfig", "GPT", "SyntheticLMDataModule", "make_block_stage",
-           "merge_lora", "add_lora_adapters"]
+           "merge_lora", "add_lora_adapters", "has_lora_adapters"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -126,9 +126,8 @@ def _moe_residual(x, p, cfg, groups: int, ln_pallas: bool = False):
     """LN2 + routed expert MLP + residual — the MoE second half of a GPT
     block.  Single source for the training scan and single-token decode
     (≙ the `_mlp_residual` discipline).  Returns ``(x, aux_loss)``."""
-    from ray_lightning_tpu.ops.moe import moe_mlp
-
     from ray_lightning_tpu.models.quant import resolve_weight
+    from ray_lightning_tpu.ops.moe import moe_mlp
 
     h = _layer_norm(x, p["ln2_g"], p["ln2_b"], ln_pallas)
     y, aux = moe_mlp(
@@ -599,6 +598,15 @@ class GPT(TpuModule):
         return tx
 
 
+def has_lora_adapters(params: Dict[str, Any]) -> bool:
+    """True when the tree carries unmerged LoRA adapters — the shared
+    predicate behind every 'merge first' guard (generation, pipeline,
+    quantization, HF export)."""
+    return any(
+        str(k).startswith("lora_") for k in params.get("blocks", {})
+    )
+
+
 def _init_lora_blocks(cfg: GPTConfig, rng: jax.Array) -> Dict[str, Any]:
     """The four stacked adapter tensors — ONE source for both
     ``GPT.init_params`` and :func:`add_lora_adapters`.  B is
@@ -624,14 +632,13 @@ def add_lora_adapters(
     warm-start a ``lora_rank > 0`` fit via ``module.initial_params``."""
     if cfg.lora_rank <= 0:
         return params
-    existing = [k for k in params["blocks"] if str(k).startswith("lora_")]
-    if existing:
+    if has_lora_adapters(params):
         # Overwriting would silently replace TRAINED adapters with
         # fresh zero-delta ones — reverting the model to the base.
         raise ValueError(
-            f"params already contain LoRA adapters ({sorted(existing)}); "
-            f"refusing to overwrite them. merge_lora() first, or reuse "
-            f"the existing adapters."
+            "params already contain LoRA adapters; refusing to "
+            "overwrite them. merge_lora() first, or reuse the existing "
+            "adapters."
         )
     return {
         **params,

@@ -72,8 +72,16 @@ def quantize_decode_params(
     the embedding lookup and the tied LM-head contraction, which reduce
     over d_model).  Everything else passes through.  LoRA trees must be
     merged first (adapters would silently be dropped otherwise).
+
+    ``cfg`` is currently unused — which weights quantize is keyed on
+    TREE contents, never config (a cfg/tree mismatch must not skip
+    weights) — but stays in the signature for symmetry with the other
+    param-tree transforms (``merge_lora``/``add_lora_adapters``) and
+    future config-dependent choices (e.g. per-family bit widths).
     """
-    if any(str(k).startswith("lora_") for k in params.get("blocks", {})):
+    from ray_lightning_tpu.models.gpt import has_lora_adapters
+
+    if has_lora_adapters(params):
         raise ValueError(
             "params contain LoRA adapters; merge_lora(params, cfg) "
             "before quantizing for decode"

@@ -102,6 +102,40 @@ class ExperimentAnalysis:
         return pd.DataFrame(rows)
 
 
+def _resolve_ckpt_file(path: Optional[str]) -> Optional[str]:
+    """last_checkpoint may be a DIRECTORY (trainable used the bare
+    ``checkpoint_dir`` API rather than the checkpoint callback).
+    Resolve to something the trainable can consume: a lone file, or
+    the newest conventionally-named stream file (``checkpoint*`` /
+    ``ckpt*`` — what the framework's callbacks write and
+    ``Trainer(resume_from_checkpoint=...)`` reads).  A multi-file
+    custom layout is returned as the directory itself — a trainable
+    that wrote its own format knows its own layout, and guessing a
+    member file would feed garbage to ``resume_from_checkpoint``."""
+    if path is None or os.path.isfile(path):
+        return path
+    if os.path.isdir(path):
+        entries = os.listdir(path)
+        files = [
+            os.path.join(path, f) for f in entries
+            if os.path.isfile(os.path.join(path, f))
+        ]
+        if len(files) == 1 and len(entries) == 1:
+            return files[0]
+        conventional = [
+            f for f in files
+            if os.path.basename(f).startswith(("checkpoint", "ckpt"))
+        ]
+        if conventional:
+            return max(conventional, key=os.path.getmtime)
+        if entries:
+            # Custom layout (multi-file, or a directory tree like an
+            # Orbax save): hand over the dir — the trainable that
+            # wrote it knows how to read it.
+            return path
+    return None
+
+
 def tune_run(
     trainable: Callable[[Dict[str, Any]], Any],
     config: Dict[str, Any],
@@ -149,39 +183,6 @@ def tune_run(
     # One lock guards every shared structure (scheduler state, the
     # donor-checkpoint pool, trial report lists read by the scheduler).
     lock = threading.Lock()
-
-    def _resolve_ckpt_file(path: Optional[str]) -> Optional[str]:
-        """last_checkpoint may be a DIRECTORY (trainable used the bare
-        ``checkpoint_dir`` API rather than the checkpoint callback).
-        Resolve to something the trainable can consume: a lone file, or
-        the newest conventionally-named stream file (``checkpoint*`` /
-        ``ckpt*`` — what the framework's callbacks write and
-        ``Trainer(resume_from_checkpoint=...)`` reads).  A multi-file
-        custom layout is returned as the directory itself — a trainable
-        that wrote its own format knows its own layout, and guessing a
-        member file would feed garbage to ``resume_from_checkpoint``."""
-        if path is None or os.path.isfile(path):
-            return path
-        if os.path.isdir(path):
-            entries = os.listdir(path)
-            files = [
-                os.path.join(path, f) for f in entries
-                if os.path.isfile(os.path.join(path, f))
-            ]
-            if len(files) == 1 and len(entries) == 1:
-                return files[0]
-            conventional = [
-                f for f in files
-                if os.path.basename(f).startswith(("checkpoint", "ckpt"))
-            ]
-            if conventional:
-                return max(conventional, key=os.path.getmtime)
-            if entries:
-                # Custom layout (multi-file, or a directory tree like an
-                # Orbax save): hand over the dir — the trainable that
-                # wrote it knows how to read it.
-                return path
-        return None
 
     def run_one(i: int, cfg: Dict[str, Any]) -> None:
         with lock:

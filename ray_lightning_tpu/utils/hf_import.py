@@ -98,7 +98,9 @@ def export_gpt2(params, cfg) -> "transformers.GPT2LMHeadModel":  # noqa: F821
     import torch
     import transformers
 
-    if any(str(k).startswith("lora_") for k in params.get("blocks", {})):
+    from ray_lightning_tpu.models.gpt import has_lora_adapters
+
+    if has_lora_adapters(params):
         raise ValueError(
             "params contain LoRA adapters with no GPT-2 representation; "
             "merge_lora(params, cfg) before export"
