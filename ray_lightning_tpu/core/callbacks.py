@@ -78,6 +78,7 @@ class ModelCheckpoint(Callback):
         mode: str = "min",
         save_top_k: int = 1,
         every_n_epochs: int = 1,
+        async_write: bool = False,
     ):
         if mode not in ("min", "max"):
             raise ValueError(f"mode must be min|max, got {mode!r}")
@@ -87,6 +88,11 @@ class ModelCheckpoint(Callback):
         self.mode = mode
         self.save_top_k = save_top_k
         self.every_n_epochs = every_n_epochs
+        # async_write: serialization + disk IO happen on a background
+        # writer thread (the gather stays collective/synchronous); the
+        # fit joins pending writes at fit end, and pruning flushes
+        # before deleting so it never races an in-flight write.
+        self.async_write = async_write
         self.best_model_path: str = ""
         self.best_model_score: Optional[float] = None
         self._saved: list = []  # [(score, path)]
@@ -125,7 +131,11 @@ class ModelCheckpoint(Callback):
         os.makedirs(self.dirpath, exist_ok=True)
         name = self.filename.format(epoch=epoch, step=trainer.global_step)
         path = os.path.join(self.dirpath, name + ".ckpt")
-        trainer.save_checkpoint(path)
+        if self.async_write and hasattr(trainer, "flush_checkpoints"):
+            trainer.save_checkpoint(path, async_write=True)
+        else:
+            # Sync, or a trainer facade without the async machinery.
+            trainer.save_checkpoint(path)
         if score is None:
             # monitor=None ⇒ Lightning semantics: "best" is simply the most
             # recent; rank saves by recency (global_step, mode=max) so
@@ -143,6 +153,9 @@ class ModelCheckpoint(Callback):
     def _prune(self, trainer, force_mode: Optional[str] = None) -> None:
         if self.save_top_k < 0 or len(self._saved) <= self.save_top_k:
             return
+        if self.async_write and hasattr(trainer, "flush_checkpoints"):
+            # Never delete a path whose write may still be in flight.
+            trainer.flush_checkpoints()
         reverse = (force_mode or self.mode) == "max"
         ranked = sorted(self._saved, key=lambda t: t[0], reverse=reverse)
         keep = set(p for _, p in ranked[: self.save_top_k])

@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import os
+import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -140,6 +141,7 @@ class LoopContext:
         self.mesh = mesh
         self.queue = queue
         self.tx = tx
+        self._ckpt_queue = None  # lazy async checkpoint writer
         self.current_epoch = 0
         # Lightning convention: global_step counts OPTIMIZER steps;
         # micro_step counts micro-batches (they differ only under
@@ -197,12 +199,83 @@ class LoopContext:
             **(extra or {}),
         }
 
-    def save_checkpoint(self, path: str) -> None:
-        """Gather (all ranks — collective) and write (rank 0 only)."""
+    def save_checkpoint(self, path: str, async_write: bool = False) -> None:
+        """Gather (all ranks — collective) and write (rank 0 only).
+
+        ``async_write=True`` moves serialization + disk IO to a single
+        background writer thread, so the training loop resumes as soon
+        as the host gather finishes — at GPT scale the msgpack encode +
+        write is seconds per checkpoint that otherwise stall every
+        epoch.  The GATHER stays synchronous on all ranks (it is a
+        collective; backgrounding it would deadlock the mesh).  Pending
+        writes are joined by :meth:`flush_checkpoints` (called at fit
+        end, and by consumers before they read/delete checkpoint
+        files); a failed background write raises there.
+        """
         payload = self.checkpoint_payload()
-        if self.is_global_zero:
-            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if not self.is_global_zero:
+            return
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        if not async_write:
             state_stream_to_file(to_state_stream(payload), path)
+            return
+        if self._ckpt_queue is None:
+            import queue as _q
+
+            # maxsize=1: at most ONE payload (a full host copy of the
+            # train state — GBs at LM scale) waits in RAM; a slow disk
+            # backpressures the loop instead of accumulating copies.
+            self._ckpt_queue = _q.Queue(maxsize=1)
+            self._ckpt_errors: List[BaseException] = []
+            q, errors = self._ckpt_queue, self._ckpt_errors
+
+            def writer():  # captures the queue/list, NOT self — the
+                # LoopContext (with its device-side state) must stay
+                # collectable once the writer is closed.
+                while True:
+                    item = q.get()
+                    try:
+                        if item is None:
+                            return
+                        p, pl = item
+                        state_stream_to_file(to_state_stream(pl), p)
+                    except BaseException as e:  # noqa: BLE001
+                        errors.append(e)
+                    finally:
+                        q.task_done()
+
+            self._ckpt_thread = threading.Thread(
+                target=writer, name="rlt-ckpt-writer", daemon=True
+            )
+            self._ckpt_thread.start()
+        self._ckpt_queue.put((path, payload))
+
+    def flush_checkpoints(self) -> None:
+        """Join pending async checkpoint writes; re-raise any failure.
+        A checkpoint the user believes exists must exist — a silently
+        dropped write is worse than a loud one."""
+        if getattr(self, "_ckpt_queue", None) is None:
+            return
+        self._ckpt_queue.join()
+        if self._ckpt_errors:
+            err = self._ckpt_errors[:]
+            self._ckpt_errors.clear()
+            raise RuntimeError(
+                f"async checkpoint write failed: {err[0]!r}"
+            ) from err[0]
+
+    def close_checkpoint_writer(self) -> None:
+        """Flush, then retire the writer thread (one per fit, never one
+        per process lifetime — tuner sweeps run many fits)."""
+        if getattr(self, "_ckpt_queue", None) is None:
+            return
+        try:
+            self.flush_checkpoints()
+        finally:
+            self._ckpt_queue.put(None)
+            self._ckpt_thread.join(timeout=30)
+            self._ckpt_queue = None
+            self._ckpt_thread = None
 
 
 def _call_hooks(callbacks: List[Callback], hook: str, *args) -> None:
@@ -815,8 +888,13 @@ def run_fit(
         if stop or ctx.should_stop:
             break
 
+    # Every async checkpoint write must be durable (and any failure
+    # raised) BEFORE on_fit_end consumers run — the standard
+    # load-best-at-fit-end pattern reads best_model_path there.
+    ctx.flush_checkpoints()
     module.on_fit_end()
     _call_hooks(callbacks, "on_fit_end", ctx, module)
+    ctx.close_checkpoint_writer()
     module.teardown("fit")
     _call_hooks(callbacks, "teardown", ctx, module, "fit")
     datamodule.teardown("fit")

@@ -306,3 +306,68 @@ def test_swa_resets_between_fits(tmp_path):
                      enable_checkpointing=False)
         tr.fit(BoringModel(), BoringDataModule())
         assert swa._count == 2  # epochs of THIS fit only
+
+
+def test_async_checkpoint_writes(tmp_path):
+    """ModelCheckpoint(async_write=True): files are durable by fit end,
+    top-k pruning holds, and the checkpoint resumes."""
+    from ray_lightning_tpu.core.callbacks import ModelCheckpoint
+    from ray_lightning_tpu.models import BoringDataModule, BoringModel
+    from ray_lightning_tpu.parallel.strategies import LocalStrategy
+
+    ckpt_dir = str(tmp_path / "ckpts")
+    cb = ModelCheckpoint(dirpath=ckpt_dir, save_top_k=2,
+                         async_write=True)
+    trainer = Trainer(strategy=LocalStrategy(), max_epochs=4,
+                      callbacks=[cb], default_root_dir=str(tmp_path),
+                      enable_checkpointing=False)
+    trainer.fit(BoringModel(), BoringDataModule())
+    files = sorted(os.listdir(ckpt_dir))
+    assert len(files) == 2, files  # top-k pruned, all writes durable
+    assert cb.best_model_path and os.path.exists(cb.best_model_path)
+
+    trainer2 = Trainer(strategy=LocalStrategy(), max_epochs=5,
+                       default_root_dir=str(tmp_path),
+                       enable_checkpointing=False,
+                       resume_from_checkpoint=cb.best_model_path)
+    trainer2.fit(BoringModel(), BoringDataModule())
+    assert trainer2.global_step > trainer.global_step
+
+
+def test_async_checkpoint_write_failure_raises(tmp_path, monkeypatch):
+    """A failed BACKGROUND write (not the sync makedirs) must surface as
+    a RuntimeError at flush — the deferred-error machinery itself."""
+    import ray_lightning_tpu.core.loop as loop_mod
+    from ray_lightning_tpu.core.loop import LoopContext, FitConfig
+
+    def boom(stream, path):
+        raise OSError("disk gone")
+
+    monkeypatch.setattr(loop_mod, "state_stream_to_file", boom)
+    ctx = LoopContext(FitConfig(max_epochs=1), 0, 1)
+    ctx.state = None
+    monkeypatch.setattr(ctx, "checkpoint_payload", lambda: {"state": {}})
+    ctx.save_checkpoint(str(tmp_path / "x.ckpt"), async_write=True)
+    with pytest.raises(RuntimeError, match="async checkpoint write"):
+        ctx.flush_checkpoints()
+    ctx.close_checkpoint_writer()
+
+
+def test_async_checkpoint_writer_retires_per_fit(tmp_path):
+    """The writer thread is per-fit, not per-process: after fit end no
+    rlt-ckpt-writer thread survives (tuner sweeps run many fits)."""
+    import threading as _threading
+
+    from ray_lightning_tpu.core.callbacks import ModelCheckpoint
+    from ray_lightning_tpu.models import BoringDataModule, BoringModel
+    from ray_lightning_tpu.parallel.strategies import LocalStrategy
+
+    for _ in range(2):
+        cb = ModelCheckpoint(dirpath=str(tmp_path / "c"), async_write=True)
+        tr = Trainer(strategy=LocalStrategy(), max_epochs=1,
+                     callbacks=[cb], default_root_dir=str(tmp_path),
+                     enable_checkpointing=False)
+        tr.fit(BoringModel(), BoringDataModule())
+    alive = [t.name for t in _threading.enumerate()
+             if t.name == "rlt-ckpt-writer"]
+    assert not alive, alive
