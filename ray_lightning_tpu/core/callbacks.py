@@ -27,6 +27,7 @@ __all__ = [
     "EarlyStopping",
     "CSVLogger",
     "StochasticWeightAveraging",
+    "ExponentialMovingAverage",
     "DeviceStatsCallback",
     "ProfilerCallback",
 ]
@@ -541,3 +542,96 @@ class StochasticWeightAveraging(Callback):
     def load_state_dict(self, state: Dict[str, Any]) -> None:
         self.swa_start_epoch = state.get(
             "swa_start_epoch", self.swa_start_epoch)
+
+
+class ExponentialMovingAverage(Callback):
+    """EMA of the weights: ``ema = d*ema + (1-d)*params`` per OPTIMIZER
+    step — the standard eval/serving average for vision and diffusion
+    workloads (SWA's uniform tail mean is the LM-style counterpart).
+
+    TPU-first like SWA: the shadow is a device pytree updated with one
+    fused ``tree_map`` (shard-local under GSPMD, no gathers).  Updates
+    track ``trainer.global_step`` — under gradient accumulation the
+    params change once per optimizer step, and so does the EMA (a
+    micro-batch cadence would silently shrink the horizon by the
+    accumulation factor).  ``update_every_n_steps`` thins the update
+    cadence; the decay compounds over the steps actually elapsed, so
+    the averaging horizon is cadence-independent.
+
+    At fit end the EMA weights REPLACE the trained ones in the returned
+    state when ``swap_at_end=True`` (default).  With
+    ``swap_at_end=False`` the shadow travels in the callback's
+    ``state_dict`` (host-gathered), so it survives the worker→driver
+    round-trip of remote strategies — read ``.ema_params`` on the
+    driver-side callback after fit.  Mid-fit checkpoints predate any
+    swap — same caveat as SWA.
+    """
+
+    def __init__(self, decay: float = 0.999,
+                 update_every_n_steps: int = 1,
+                 swap_at_end: bool = True):
+        if not 0.0 < decay < 1.0:
+            raise ValueError("decay must be in (0, 1)")
+        if update_every_n_steps < 1:
+            raise ValueError("update_every_n_steps must be >= 1")
+        self.decay = decay
+        self.update_every_n_steps = update_every_n_steps
+        self.swap_at_end = swap_at_end
+        self.ema_params = None
+        self._last_step: Optional[int] = None
+
+    def on_fit_start(self, trainer, module) -> None:
+        # Fresh shadow per fit (callback instances are reused across
+        # fits in tuner sweeps).
+        self.ema_params = None
+        self._last_step = None
+
+    def on_train_batch_end(self, trainer, module, logs, batch_idx) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        gs = trainer.global_step
+        if gs == 0 or gs == self._last_step:
+            return  # no optimizer update completed since the last EMA
+        params = trainer.state.params
+        if self.ema_params is None:
+            # COPY, never alias — the train step donates state buffers.
+            self.ema_params = jax.tree_util.tree_map(jnp.copy, params)
+            self._last_step = gs
+            return
+        advanced = gs - self._last_step
+        if advanced < self.update_every_n_steps:
+            return
+        # Compound over the optimizer steps actually elapsed.
+        d = self.decay ** advanced
+        self.ema_params = jax.tree_util.tree_map(
+            lambda e, p: e * d + p.astype(e.dtype) * (1.0 - d),
+            self.ema_params, params,
+        )
+        self._last_step = gs
+
+    def on_fit_end(self, trainer, module) -> None:
+        if self.ema_params is None or not self.swap_at_end:
+            return
+        from ray_lightning_tpu.core.module import TrainState
+
+        st = trainer.state
+        trainer.state = TrainState(self.ema_params, st.opt_state, st.step)
+
+    def state_dict(self) -> Dict[str, Any]:
+        state: Dict[str, Any] = {"decay": self.decay}
+        if not self.swap_at_end and self.ema_params is not None:
+            # The shadow is the run's whole point when not swapping;
+            # ship it host-side so remote fits return it to the driver
+            # (and resumes restore it).  Only in this mode — with
+            # swap_at_end the returned state already carries it, and
+            # doubling every checkpoint payload would be waste.
+            import jax
+
+            state["ema_params"] = jax.device_get(self.ema_params)
+        return state
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.decay = state.get("decay", self.decay)
+        if "ema_params" in state:
+            self.ema_params = state["ema_params"]
