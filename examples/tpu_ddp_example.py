@@ -27,6 +27,7 @@ def train_mnist(
     num_epochs: int = 4,
     batch_size: int = 32,
     use_tune: bool = False,
+    grad_comm: str = "full",
 ):
     """≙ reference ``train_mnist`` (``ray_ddp_example.py:18-52``)."""
     callbacks = (
@@ -38,7 +39,9 @@ def train_mnist(
         else []
     )
     trainer = Trainer(
-        strategy=RayStrategy(num_workers=num_workers),
+        # grad_comm="int8_ef" compresses the cross-host gradient wire
+        # ~4x (parallel/grad_sync.py); "full" is the exact default.
+        strategy=RayStrategy(num_workers=num_workers, grad_comm=grad_comm),
         max_epochs=num_epochs,
         callbacks=callbacks,
         log_every_n_steps=10,
@@ -90,6 +93,8 @@ if __name__ == "__main__":
     parser.add_argument("--tune", action="store_true")
     parser.add_argument("--num-samples", type=int, default=2)
     parser.add_argument("--smoke-test", action="store_true")
+    parser.add_argument("--grad-comm", default="full",
+                        choices=["full", "int8", "int8_ef"])
     args = parser.parse_args()
 
     epochs = 1 if args.smoke_test else args.num_epochs
@@ -99,7 +104,7 @@ if __name__ == "__main__":
     else:
         trainer = train_mnist(
             {}, num_workers=args.num_workers, num_epochs=epochs,
-            batch_size=args.batch_size,
+            batch_size=args.batch_size, grad_comm=args.grad_comm,
         )
         print("final metrics:", {
             k: round(v, 4) for k, v in trainer.callback_metrics.items()

@@ -46,6 +46,17 @@ class Callback:
         self, trainer, module, logs: Dict[str, float], batch_idx: int
     ) -> None: ...
 
+    def on_accumulation_flush(
+        self, trainer, module, logs: Dict[str, float], batch_idx: int
+    ) -> None:
+        """The epoch-end partial-accumulation flush completed one extra
+        OPTIMIZER step (``trainer.global_step`` already advanced) without
+        a new micro-batch.  Default: no-op — re-broadcasting
+        ``on_train_batch_end`` here would double-fire side-effecting
+        batch-cadence callbacks (CSV rows, tune reports) on an event
+        they already observed.  Step-cadence callbacks (EMA) override
+        this to observe the flushed update."""
+
     def on_train_epoch_end(self, trainer, module) -> None: ...
 
     def on_validation_epoch_end(self, trainer, module) -> None: ...
@@ -154,13 +165,22 @@ class ModelCheckpoint(Callback):
     def _prune(self, trainer, force_mode: Optional[str] = None) -> None:
         if self.save_top_k < 0 or len(self._saved) <= self.save_top_k:
             return
-        if self.async_write and hasattr(trainer, "flush_checkpoints"):
-            # Never delete a path whose write may still be in flight.
-            trainer.flush_checkpoints()
         reverse = (force_mode or self.mode) == "max"
         ranked = sorted(self._saved, key=lambda t: t[0], reverse=reverse)
         keep = set(p for _, p in ranked[: self.save_top_k])
         keep.add(self.best_model_path)
+        doomed = [p for _, p in self._saved if p not in keep]
+        if self.async_write and hasattr(trainer, "flush_checkpoints"):
+            # Never delete a path whose write may still be in flight —
+            # but ONLY join when one actually is.  Joining every prune
+            # made steady-state save_top_k=1 synchronous again: the
+            # just-enqueued save is always the newest (kept) path, and
+            # last epoch's doomed file finished writing long ago.  A
+            # trainer without pending-write tracking gets the
+            # conservative unconditional join.
+            pending = getattr(trainer, "checkpoint_write_pending", None)
+            if pending is None or any(pending(p) for p in doomed):
+                trainer.flush_checkpoints()
         for score, path in list(self._saved):
             if path not in keep:
                 # Bookkeeping runs on every rank (kept consistent for the
@@ -529,7 +549,9 @@ class StochasticWeightAveraging(Callback):
         from ray_lightning_tpu.core.module import TrainState
 
         st = trainer.state
-        trainer.state = TrainState(self._mean, st.opt_state, st.step)
+        trainer.state = TrainState(
+            self._mean, st.opt_state, st.step, st.grad_residual
+        )
 
     # SWA state is NOT persisted across resumes: the running mean is a
     # full params-sized pytree — shipping it through every restart
@@ -542,6 +564,17 @@ class StochasticWeightAveraging(Callback):
     def load_state_dict(self, state: Dict[str, Any]) -> None:
         self.swa_start_epoch = state.get(
             "swa_start_epoch", self.swa_start_epoch)
+
+
+def _host_copy(tree, mesh=None):
+    """Host numpy copy of a device pytree, safe on multi-host meshes —
+    the shared replicate-then-get discipline (one cached jitted identity
+    per mesh; also behind ``LoopContext._gathered_state``).  The
+    replicate is a COLLECTIVE: on a multi-host mesh every rank must call
+    this at the same point."""
+    from ray_lightning_tpu.parallel.sharding import host_replicated_copy
+
+    return host_replicated_copy(tree, mesh)
 
 
 class ExponentialMovingAverage(Callback):
@@ -585,6 +618,8 @@ class ExponentialMovingAverage(Callback):
         # fits in tuner sweeps).
         self.ema_params = None
         self._last_step = None
+        self._mesh = getattr(trainer, "mesh", None)
+        self._host_ema = None
 
     def on_train_batch_end(self, trainer, module, logs, batch_idx) -> None:
         import jax
@@ -610,13 +645,29 @@ class ExponentialMovingAverage(Callback):
         )
         self._last_step = gs
 
+    def on_accumulation_flush(self, trainer, module, logs, batch_idx):
+        # The flush is one more optimizer step — fold it into the shadow
+        # exactly like a window-completing micro-batch would have.
+        self.on_train_batch_end(trainer, module, logs, batch_idx)
+
     def on_fit_end(self, trainer, module) -> None:
-        if self.ema_params is None or not self.swap_at_end:
+        if self.ema_params is None:
+            return
+        if not self.swap_at_end:
+            # Pull the shadow host-side HERE — on_fit_end runs on every
+            # rank, so the replicate collective inside _host_copy is
+            # safe; state_dict (rank-0-only on remote strategies) then
+            # serves the cached copy.  device_get alone would raise on a
+            # multi-host ZeRO-3/TP mesh, where the shadow inherits the
+            # params' sharding and is not fully addressable.
+            self._host_ema = _host_copy(self.ema_params, self._mesh)
             return
         from ray_lightning_tpu.core.module import TrainState
 
         st = trainer.state
-        trainer.state = TrainState(self.ema_params, st.opt_state, st.step)
+        trainer.state = TrainState(
+            self.ema_params, st.opt_state, st.step, st.grad_residual
+        )
 
     def state_dict(self) -> Dict[str, Any]:
         state: Dict[str, Any] = {"decay": self.decay}
@@ -626,9 +677,36 @@ class ExponentialMovingAverage(Callback):
             # (and resumes restore it).  Only in this mode — with
             # swap_at_end the returned state already carries it, and
             # doubling every checkpoint payload would be waste.
-            import jax
+            if getattr(self, "_host_ema", None) is not None:
+                state["ema_params"] = self._host_ema
+            else:
+                # Mid-fit call (restart-checkpoint metadata).  This call
+                # site is rank-0-only, so a replicate COLLECTIVE here
+                # would deadlock a multi-host mesh — gather only when
+                # every shard is already addressable; otherwise omit the
+                # shadow from this checkpoint (EMA restart is documented
+                # lossy, like SWA) and let on_fit_end's all-ranks gather
+                # ship it at fit end.
+                import jax
 
-            state["ema_params"] = jax.device_get(self.ema_params)
+                addressable = all(
+                    getattr(x, "is_fully_addressable", True)
+                    for x in jax.tree_util.tree_leaves(self.ema_params)
+                )
+                if addressable:
+                    state["ema_params"] = _host_copy(
+                        self.ema_params, getattr(self, "_mesh", None)
+                    )
+                else:
+                    import warnings
+
+                    warnings.warn(
+                        "EMA shadow omitted from this mid-fit "
+                        "checkpoint: it is not fully addressable and "
+                        "state_dict ran on rank 0 only (a gather here "
+                        "would deadlock the mesh); the fit-end "
+                        "state_dict carries it."
+                    )
         return state
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:

@@ -153,6 +153,11 @@ class LoopContext:
         self.logged_metrics: Dict[str, float] = {}
         self.state: Optional[TrainState] = None
         self.default_root_dir = config.default_root_dir
+        # Gradient-communication status (populated by run_fit): modules
+        # consult ``grad_sync_active`` to pick per-device-safe compute
+        # paths when their step runs inside the quantized-sync island.
+        self.grad_sync_active = False
+        self.comm_stats: Dict[str, Any] = {}
 
     @property
     def is_global_zero(self) -> bool:
@@ -178,16 +183,18 @@ class LoopContext:
         the file WRITE may be rank-guarded).
         """
         state = self.state
-        leaves = jax.tree_util.tree_leaves(state)
-        fully_addressable = all(
-            getattr(x, "is_fully_addressable", True) for x in leaves
-        )
-        if self.world_size > 1 and not fully_addressable:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            repl = NamedSharding(self.mesh, P())
-            state = jax.jit(lambda s: s, out_shardings=repl)(state)
-        return jax.device_get(state)
+        if getattr(state, "grad_residual", None) is not None:
+            # The EF residual is (n_devices, ~param_count) f32 — one
+            # params-sized row PER DEVICE.  Gathering it would blow up
+            # every checkpoint payload and the rank-0→driver stream by
+            # n_devices × model size (device OOM at pod scale), to
+            # preserve at most one step of compression error; resumes
+            # re-attach a zero residual instead
+            # (``GradSync.reconcile_resumed_state``).  The sharded
+            # restart path (``sharded_ckpt.save_shard``) still persists
+            # it cheaply — each host writes only its own rows.
+            state = TrainState(state.params, state.opt_state, state.step)
+        return shardlib.host_replicated_copy(state, self.mesh)
 
     def checkpoint_payload(self, extra: Optional[Dict[str, Any]] = None) -> dict:
         return {
@@ -227,7 +234,15 @@ class LoopContext:
             # backpressures the loop instead of accumulating copies.
             self._ckpt_queue = _q.Queue(maxsize=1)
             self._ckpt_errors: List[BaseException] = []
+            # Paths with an enqueued-but-unfinished write: consumers that
+            # only need to delete a FINISHED file (ModelCheckpoint._prune)
+            # consult this instead of joining the whole queue — joining
+            # unconditionally turned steady-state save_top_k=1 back into
+            # a synchronous write every epoch.
+            self._ckpt_pending: set = set()
+            self._ckpt_lock = threading.Lock()
             q, errors = self._ckpt_queue, self._ckpt_errors
+            pending, lock = self._ckpt_pending, self._ckpt_lock
 
             def writer():  # captures the queue/list, NOT self — the
                 # LoopContext (with its device-side state) must stay
@@ -242,13 +257,28 @@ class LoopContext:
                     except BaseException as e:  # noqa: BLE001
                         errors.append(e)
                     finally:
+                        if item is not None:
+                            with lock:
+                                pending.discard(item[0])
                         q.task_done()
 
             self._ckpt_thread = threading.Thread(
                 target=writer, name="rlt-ckpt-writer", daemon=True
             )
             self._ckpt_thread.start()
+        with self._ckpt_lock:
+            self._ckpt_pending.add(path)
         self._ckpt_queue.put((path, payload))
+
+    def checkpoint_write_pending(self, path: str) -> bool:
+        """True while an async write of ``path`` is still enqueued or in
+        flight.  False for finished writes, sync writes, and trainer
+        facades without the async machinery — so callers can gate a
+        flush on it unconditionally."""
+        if getattr(self, "_ckpt_queue", None) is None:
+            return False
+        with self._ckpt_lock:
+            return path in self._ckpt_pending
 
     def flush_checkpoints(self) -> None:
         """Join pending async checkpoint writes; re-raise any failure.
@@ -327,7 +357,9 @@ def _build_accum_flush(inner_tx, mesh, state_shardings):
                 jnp.zeros_like, ms.acc_grads
             ),
         )
-        return TrainState(new_params, new_ms, state.step + 1)
+        return TrainState(
+            new_params, new_ms, state.step + 1, state.grad_residual
+        )
 
     if mesh is None or state_shardings is None:
         return jax.jit(flush, donate_argnums=0)
@@ -589,6 +621,7 @@ def run_fit(
     mesh=None,
     mode: str = "gspmd",
     zero_stage: int = 0,
+    grad_comm=None,
     queue=None,
 ) -> Dict[str, Any]:
     """The full fit loop.  Returns the rank-0 result package.
@@ -634,10 +667,33 @@ def run_fit(
     datamodule.setup("fit")
     _call_hooks(callbacks, "setup", ctx, module, "fit")
 
+    # Gradient-communication coercion (str | dict | GradCommConfig | None
+    # — None reads the RLT_GRAD_COMM env bus, defaulting to full-width).
+    # Resolution happens against the REAL mesh/stage shape and warns on
+    # every downgrade; modules consult ``trainer.grad_sync_active`` to
+    # pick per-device-safe compute paths inside the sync island.
+    from ray_lightning_tpu.parallel import grad_sync as gsync
+
+    grad_sync = gsync.maybe_build_grad_sync(
+        module, mesh, grad_comm, mode=mode, zero_stage=zero_stage
+    )
+    ctx.grad_sync_active = grad_sync is not None
+    ctx.comm_stats = (
+        grad_sync.stats() if grad_sync is not None
+        else {"grad_sync_mode": "full"}
+    )
+
     state, state_shardings = init_train_state(
         module, tx, mesh, zero_stage, config.seed,
         use_preset=not config.resume_from_checkpoint,
     )
+    if grad_sync is not None:
+        # Error-feedback residual (int8_ef): attached to BOTH the state
+        # and its sharding tree before the step compiles, so the jit's
+        # in/out shardings stay congruent with the donated state.
+        state, state_shardings = grad_sync.attach_residual(
+            state, state_shardings
+        )
     start_epoch = 0
     if config.resume_from_checkpoint:
         from ray_lightning_tpu.utils import sharded_ckpt
@@ -654,6 +710,18 @@ def run_fit(
                 state_stream_from_file(config.resume_from_checkpoint)
             )
         host_state = payload["state"]
+        if grad_sync is not None:
+            # A stream written without EF (or from another world size)
+            # gets a fresh zero residual; one written with EF resuming
+            # into a full-width run sheds it — either way the resumed
+            # tree stays congruent with this run's state template.
+            host_state = grad_sync.reconcile_resumed_state(host_state)
+        elif getattr(host_state, "grad_residual", None) is not None:
+            from ray_lightning_tpu.core.module import TrainState as _TS
+
+            host_state = _TS(
+                host_state.params, host_state.opt_state, host_state.step
+            )
         # Reconcile checkpoint dtypes with THIS run's state template: a
         # dtype-policy change between runs (e.g. AdamW mu f32 → bf16,
         # models/gpt.py ``mu_dtype``) must not leak the old dtype into
@@ -700,7 +768,7 @@ def run_fit(
     )
     train_step = step_fns.build_train_step(
         module, tx, mesh, mode=mode, zero_stage=zero_stage,
-        state_shardings=state_shardings,
+        state_shardings=state_shardings, grad_sync=grad_sync,
     )
     val_loader = datamodule.val_dataloader()
     eval_step = (
@@ -759,6 +827,8 @@ def run_fit(
             train_loader if cap is None
             else itertools.islice(iter(train_loader), cap + 1)
         )
+        last_logs: Dict[str, Any] = {}
+        last_batch_idx = -1
         for batch_idx, gbatch in enumerate(
             _prefetched(source, lambda b: _place_batch(b, mesh))
         ):
@@ -788,6 +858,7 @@ def run_fit(
             _call_hooks(
                 callbacks, "on_train_batch_end", ctx, module, logs, batch_idx
             )
+            last_logs, last_batch_idx = logs, batch_idx
 
         # Flush a partial accumulation window (Lightning semantics: the
         # last incomplete window of an epoch still steps, from the mean
@@ -805,6 +876,17 @@ def run_fit(
             ctx.state = flush_step(ctx.state)
             ctx.global_step += 1
             since_update = 0  # the flush reset MultiSteps' window
+            # The flush IS an optimizer step: step-cadence callbacks
+            # (EMA shadow updates) must observe it — via the dedicated
+            # on_accumulation_flush hook, NOT a re-broadcast of
+            # on_train_batch_end, which would double-fire batch-cadence
+            # side effects (CSV rows, tune reports) for an event they
+            # already saw.  Without this, the final epoch's flushed
+            # update never entered the EMA average.
+            _call_hooks(
+                callbacks, "on_accumulation_flush", ctx, module,
+                last_logs, last_batch_idx,
+            )
 
         train_metrics = epoch_mean.result()
         ctx.log_metrics(train_metrics)
@@ -924,6 +1006,7 @@ def run_fit(
         "epochs_run": ctx.current_epoch + 1,
         "global_step": ctx.global_step,
         "micro_step": ctx.micro_step,
+        "comm_stats": dict(ctx.comm_stats),
     }
 
 

@@ -45,15 +45,30 @@ class TrainState:
     sharded, donated, state-streamed and checkpointed without special
     casing (the property behind topology-independent checkpoints,
     SURVEY §7 hard-part #4).
+
+    ``grad_residual`` (default ``None`` — an *empty* pytree node, so
+    legacy 3-field states flatten/unflatten and checkpoint identically)
+    carries the per-device error-feedback residual of quantized gradient
+    sync (``parallel/grad_sync.py``, ``grad_comm="int8_ef"``): one f32
+    row per sync participant, sharded so row ``d`` lives on device ``d``.
     """
 
-    def __init__(self, params: Any, opt_state: Any, step: jax.Array):
+    def __init__(
+        self,
+        params: Any,
+        opt_state: Any,
+        step: jax.Array,
+        grad_residual: Any = None,
+    ):
         self.params = params
         self.opt_state = opt_state
         self.step = step
+        self.grad_residual = grad_residual
 
     def tree_flatten(self):
-        return (self.params, self.opt_state, self.step), None
+        return (
+            self.params, self.opt_state, self.step, self.grad_residual
+        ), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -72,7 +87,9 @@ class TrainState:
         import optax
 
         new_params = optax.apply_updates(self.params, updates)
-        return TrainState(new_params, new_opt_state, self.step + 1)
+        return TrainState(
+            new_params, new_opt_state, self.step + 1, self.grad_residual
+        )
 
     def __repr__(self):
         n = sum(

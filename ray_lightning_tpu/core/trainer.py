@@ -143,6 +143,9 @@ class Trainer:
         self.global_step: int = 0   # optimizer steps (Lightning convention)
         self.micro_step: int = 0    # micro-batches (= global_step unless
         # gradient accumulation is active)
+        # Gradient-sync wire accounting from the workers (grad_sync_mode,
+        # grad_sync_bytes, compression ratio — parallel/grad_sync.py).
+        self.comm_stats: Dict[str, Any] = {}
         self._state_stream: Optional[bytes] = None
 
     # -- live metric streaming (driver-side queue pump hook) ----------------
@@ -192,6 +195,7 @@ class Trainer:
         self.epochs_run = rank0["epochs_run"]
         self.global_step = rank0["global_step"]
         self.micro_step = rank0.get("micro_step", self.global_step)
+        self.comm_stats = dict(rank0.get("comm_stats", {}))
         # Driver-side callback objects reflect what happened remotely
         # (≙ best_model_path adoption, ray_ddp.py:393-395 — generalized).
         for cb, cb_state in zip(self.callbacks, rank0["callback_states"]):

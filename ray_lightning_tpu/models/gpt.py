@@ -356,8 +356,14 @@ class GPT(TpuModule):
         mesh = getattr(trainer, "mesh", None)
         # Under shard_map (the Horovod-duality flavor) the body is already
         # per-device with Manual axes — a named sharding constraint there
-        # is both meaningless and a trace-time error.  gspmd only.
-        if mesh is None or getattr(trainer, "step_mode", "gspmd") != "gspmd":
+        # is both meaningless and a trace-time error.  gspmd only; the
+        # quantized grad-sync island (grad_sync_active) also runs this
+        # body per-device under shard_map, so it skips the anchor too.
+        if (
+            mesh is None
+            or getattr(trainer, "step_mode", "gspmd") != "gspmd"
+            or getattr(trainer, "grad_sync_active", False)
+        ):
             return x
         from jax.sharding import NamedSharding
 
@@ -531,6 +537,11 @@ class GPT(TpuModule):
         if not set(mesh.axis_names) <= {"data", "fsdp"}:
             return False
         if getattr(trainer, "step_mode", None) != "gspmd":
+            return False
+        # Inside the quantized grad-sync island the step body is already
+        # per-device shard_map — nesting the CE island would double-wrap;
+        # the vocab-chunk scan is the per-device-safe path there.
+        if getattr(trainer, "grad_sync_active", False):
             return False
         if batch_dim % getattr(mesh, "size", 1):
             return False

@@ -15,6 +15,11 @@ fuse optimally:
 """
 
 from ray_lightning_tpu.ops.attention import causal_attention
+from ray_lightning_tpu.ops.collective_quant import (
+    dequantize_block_scaled,
+    int8_all_reduce,
+    quantize_block_scaled,
+)
 from ray_lightning_tpu.ops.ring_attention import (
     ring_attention_sharded,
     ring_causal_attention,
@@ -24,4 +29,7 @@ __all__ = [
     "causal_attention",
     "ring_causal_attention",
     "ring_attention_sharded",
+    "quantize_block_scaled",
+    "dequantize_block_scaled",
+    "int8_all_reduce",
 ]

@@ -643,7 +643,9 @@ def _fused_ce_shmap_fwd(x, wte, targets, mesh, batch_axes, num_chunks,
         )
         return loss, lse
 
-    loss, lse = jax.shard_map(
+    from ray_lightning_tpu.utils.jax_compat import shard_map
+
+    loss, lse = shard_map(
         local, mesh=mesh, in_specs=(Pb, P(), Pb), out_specs=(Pb, Pb),
         check_vma=False,
     )(x, wte, targets)
@@ -668,7 +670,9 @@ def _fused_ce_shmap_bwd(mesh, batch_axes, num_chunks, compute_dtype,
         # partial dwte of its batch shard).
         return dxl, jax.lax.psum(dwp, axes)
 
-    dx, dwte = jax.shard_map(
+    from ray_lightning_tpu.utils.jax_compat import shard_map
+
+    dx, dwte = shard_map(
         local, mesh=mesh,
         in_specs=(Pb, P(), Pb, Pb, Pb), out_specs=(Pb, P()),
         check_vma=False,

@@ -11,8 +11,12 @@ representative shapes) once per cache key and downgrades failures:
   kernel will never work here, use the fallback permanently;
 * everything else — including bare ``ValueError``/``TypeError``, which
   can be raised transiently at dispatch time under momentary device
-  pressure — falls back for the current call only and re-probes next
-  time.
+  pressure — falls back for the current call and re-probes next time,
+  but only up to :data:`_MAX_IDENTICAL_FAILURES` consecutive *identical*
+  failures: a permanent breakage whose message the marker list misses
+  must not re-run a multi-second compile on every dispatch forever.  A
+  different message resets the count (a changing error is evidence of a
+  transient environment, not a fixed compiler verdict).
 
 Off-TPU (the Pallas interpreter) kernels always work: probes are
 skipped.
@@ -28,6 +32,9 @@ import jax
 __all__ = ["kernel_available", "kernel_family_disabled", "_interpret"]
 
 _CACHE: dict = {}
+# key -> (last failure message, consecutive identical-failure count).
+_FAILURES: dict = {}
+_MAX_IDENTICAL_FAILURES = 3
 
 
 def kernel_family_disabled(family: str) -> bool:
@@ -78,6 +85,7 @@ def kernel_available(key: Hashable, probe: Callable[[], None]) -> bool:
     try:
         probe()
         _CACHE[key] = True
+        _FAILURES.pop(key, None)
         return True
     except Exception as e:
         import warnings
@@ -86,6 +94,17 @@ def kernel_available(key: Hashable, probe: Callable[[], None]) -> bool:
         permanent = isinstance(e, NotImplementedError) or any(
             m in msg.lower() for m in _COMPILE_ERROR_MARKERS
         )
+        if not permanent:
+            # Bounded retry for unrecognized failures: N consecutive
+            # IDENTICAL messages ⇒ treat as permanent (the marker list
+            # missed it) instead of paying the probe compile on every
+            # dispatch.  A different message resets the count.
+            last_msg, count = _FAILURES.get(key, (None, 0))
+            count = count + 1 if msg == last_msg else 1
+            _FAILURES[key] = (msg, count)
+            if count >= _MAX_IDENTICAL_FAILURES:
+                permanent = True
+                _FAILURES.pop(key, None)
         if permanent:
             _CACHE[key] = False
         warnings.warn(

@@ -146,10 +146,12 @@ def ring_causal_attention(
     # Initial carries must carry the same varying-manual-axes type as the
     # loop outputs (shard_map VMA typing) — mark them varying over every
     # axis the inputs vary over.
-    vma = tuple(jax.typeof(q).vma)
+    from ray_lightning_tpu.utils.jax_compat import pcast, vma_of
+
+    vma = vma_of(q)
 
     def varying(x):
-        return jax.lax.pcast(x, vma, to="varying")
+        return pcast(x, vma, to="varying")
 
     acc0 = varying(jnp.zeros((b, h, s_loc, d), jnp.float32))
     m0 = varying(jnp.full((b, h, s_loc, 1), _NEG_INF, jnp.float32))
@@ -185,7 +187,7 @@ def ring_attention_sharded(
     should instead permute tokens once at the data layer
     (:func:`zigzag_indices`) and call the per-device body directly.
     """
-    from jax import shard_map
+    from ray_lightning_tpu.utils.jax_compat import shard_map
 
     from ray_lightning_tpu.parallel import sharding as shardlib
 

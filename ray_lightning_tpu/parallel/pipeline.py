@@ -38,6 +38,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ray_lightning_tpu.utils.jax_compat import pcast
+
 __all__ = ["pipeline_apply", "pipelined_scan"]
 
 
@@ -100,8 +102,8 @@ def pipelined_scan(
     # Initial carries must hold the varying-manual-axes type the loop
     # body produces (same shard_map VMA discipline as ring_attention).
     init = (
-        jax.lax.pcast(zeros, (axis_name,), to="varying"),
-        jax.lax.pcast(out0, (axis_name,), to="varying"),
+        pcast(zeros, (axis_name,), to="varying"),
+        pcast(out0, (axis_name,), to="varying"),
     )
     (_, outputs), _ = jax.lax.scan(tick, init, jnp.arange(ticks))
     # Replicate the last stage's outputs across the pipe group: sum a
@@ -125,7 +127,7 @@ def pipeline_apply(
     shard.  The batch is split into ``num_microbatches`` (default: one
     per stage — callers should raise it to shrink the bubble).
     """
-    from jax import shard_map
+    from ray_lightning_tpu.utils.jax_compat import shard_map
 
     n_stages = mesh.shape[pipe_axis]
     if num_microbatches is not None and num_microbatches < 1:

@@ -18,6 +18,7 @@ Sharding of Weight Update in Data-Parallel Training", arXiv:2004.13336).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Optional
 
 import jax
@@ -26,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = [
     "replicated",
+    "host_replicated_copy",
     "batch_sharding",
     "data_axes",
     "default_zero_axis",
@@ -39,6 +41,33 @@ __all__ = [
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+@functools.lru_cache(maxsize=8)
+def _replicate_fn(mesh: Mesh):
+    """Cached jitted identity with replicated out_shardings — one trace
+    per mesh, not one per call site invocation (a fresh ``jax.jit`` of a
+    fresh lambda re-traces the whole tree every checkpoint)."""
+    return jax.jit(lambda t: t, out_shardings=replicated(mesh))
+
+
+def host_replicated_copy(tree: Any, mesh: Optional[Mesh]) -> Any:
+    """Host numpy copy of a device pytree, safe on multi-host meshes.
+
+    ``jax.device_get`` alone raises on non-fully-addressable arrays
+    (ZeRO-3/TP shards living on other hosts); replicate first via an
+    identity jit with replicated out_shardings (an XLA all-gather over
+    ICI/DCN), then pull the local replica.  The replicate is a
+    COLLECTIVE: on a multi-host mesh every rank must call this at the
+    same point.  Fully-addressable trees skip the gather entirely.
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    fully_addressable = all(
+        getattr(x, "is_fully_addressable", True) for x in leaves
+    )
+    if not fully_addressable and mesh is not None:
+        tree = _replicate_fn(mesh)(tree)
+    return jax.device_get(tree)
 
 
 def data_axes(mesh: Mesh) -> tuple:

@@ -244,7 +244,7 @@ def tune_run(
         for i, cfg in enumerate(configs):
             run_one(i, cfg)
     else:
-        from concurrent.futures import ThreadPoolExecutor
+        from concurrent.futures import ThreadPoolExecutor, as_completed
 
         from .session import set_strict_sessions
 
@@ -252,6 +252,7 @@ def tune_run(
         # threads must never silently attach to whichever concurrent
         # trial happens to survive.
         set_strict_sessions(True)
+        first: Optional[BaseException] = None
         try:
             with ThreadPoolExecutor(
                 max_workers=max_concurrent_trials,
@@ -261,10 +262,21 @@ def tune_run(
                     pool.submit(run_one, i, cfg)
                     for i, cfg in enumerate(configs)
                 ]
-                errors = [f.exception() for f in futures]
+                # Fail-fast (sequential mode's contract, kept): a future
+                # only carries an exception when raise_on_trial_error —
+                # the first one cancels every not-yet-started trial
+                # instead of burning accelerator time on doomed configs.
+                # Already-running trials finish (the `with` joins them);
+                # cancelled ones never ran and stay out of the analysis.
+                for fut in as_completed(futures):
+                    err = fut.exception()
+                    if err is not None:
+                        first = err
+                        for other in futures:
+                            other.cancel()
+                        break
         finally:
             set_strict_sessions(False)
-        first = next((e for e in errors if e is not None), None)
         if first is not None:  # only when raise_on_trial_error
             raise first
     return ExperimentAnalysis(

