@@ -49,6 +49,13 @@ from ray_lightning_tpu.core.trainer import Trainer
 from ray_lightning_tpu.models.gpt import GPT, GPTConfig, SyntheticLMDataModule
 from ray_lightning_tpu.parallel.step_fns import build_train_step
 from ray_lightning_tpu.parallel.strategies import LocalStrategy
+# The analytic-FLOPs/peak accounting lives in the telemetry subsystem
+# now (telemetry/step_stats.py) — bench and the live fit loop must agree
+# on the MFU arithmetic by construction, not by copy.
+from ray_lightning_tpu.telemetry import (
+    model_flops_per_token,
+    peak_flops_per_chip,
+)
 
 WARMUP_STEPS = 3
 WINDOW_STEPS = 8          # steps per timing window
@@ -56,42 +63,6 @@ WINDOWS = 3               # median-of-k windows (k >= 3)
 # First recorded number for this config family (BENCH_r01.json, round 1:
 # raw-step path, B=8, XLA-recompute attention backward).
 R1_TOKENS_PER_SEC = 66010.1
-
-# Peak bf16 FLOP/s per chip by device_kind substring (dense MXU peak).
-_PEAK_FLOPS = (
-    ("v5 lite", 197e12),   # v5e
-    ("v5e", 197e12),
-    ("v5p", 459e12),
-    ("v6", 918e12),        # Trillium
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-)
-
-
-def _peak_flops_per_chip() -> float:
-    kind = jax.devices()[0].device_kind.lower()
-    for key, peak in _PEAK_FLOPS:
-        if key in kind:
-            return peak
-    return 197e12  # unknown TPU: assume v5e-class
-
-
-def model_flops_per_token(cfg: GPTConfig, attn: str = "full") -> float:
-    """Fwd+bwd matmul FLOPs per token (backward = 2x forward, no
-    remat-recompute credit).
-
-    ``attn="full"`` charges the full S² attention matrix (the standard
-    published-MFU convention); ``attn="causal"`` charges the causal half
-    the kernels actually execute.
-    """
-    d, L, s, V = cfg.d_model, cfg.n_layer, cfg.seq_len, cfg.vocab_size
-    mm = 24 * L * d * d          # qkv + proj + mlp weight matmuls
-    attn_term = 4 * L * s * d    # QK^T and AV, full square
-    if attn == "causal":
-        attn_term /= 2
-    head = 2 * d * V             # tied LM head
-    return 3.0 * (mm + attn_term + head)
 
 
 def _median_spread(vals):
@@ -154,7 +125,9 @@ def _bench_raw_step(module: GPT, cfg: GPTConfig, batch_size: int):
 
 
 def _bench_fit(module: GPT, cfg: GPTConfig, batch_size: int):
-    """Median tokens/s through the real Trainer.fit() path."""
+    """Median tokens/s through the real Trainer.fit() path.  Also
+    returns the run's fleet telemetry report (the BENCH_* telemetry
+    block, making the perf trajectory machine-comparable)."""
     timer = _StepTimer()
     total = WARMUP_STEPS + WINDOWS * WINDOW_STEPS + 1
     trainer = Trainer(
@@ -184,7 +157,46 @@ def _bench_fit(module: GPT, cfg: GPTConfig, batch_size: int):
         WINDOW_STEPS * batch_size * cfg.seq_len / dt / n_chips
         for dt in times[:WINDOWS]
     ]
-    return _median_spread(tps)
+    med, spread = _median_spread(tps)
+    return med, spread, trainer.telemetry_report
+
+
+def _bench_boring_fit(tier: str, steps: int = 80) -> float:
+    """Steady-state seconds/step of a boring-model fit at one telemetry
+    tier (the overhead probe's measurement arm)."""
+    from ray_lightning_tpu.models.boring import (
+        BoringDataModule,
+        BoringModel,
+    )
+
+    timer = _StepTimer()
+    trainer = Trainer(
+        strategy=LocalStrategy(telemetry=tier),
+        max_epochs=1,
+        limit_train_batches=steps,
+        limit_val_batches=0,
+        enable_checkpointing=False,
+        log_every_n_steps=10_000,
+        callbacks=[timer],
+    )
+    trainer.fit(
+        BoringModel(),
+        BoringDataModule(length=steps * 16 + 16, batch_size=16),
+    )
+    times = timer.window_times()
+    assert len(times) >= WINDOWS
+    return _median_spread(
+        [dt / WINDOW_STEPS for dt in times[:WINDOWS]]
+    )[0]
+
+
+def _telemetry_overhead_pct() -> float:
+    """Measured per-step cost of the default cheap telemetry tier vs a
+    telemetry-off run on the boring model — the precise record of the
+    <1% acceptance budget (the smoke test asserts it loosely)."""
+    off = _bench_boring_fit("off")
+    cheap = _bench_boring_fit("cheap")
+    return 100.0 * (cheap - off) / off if off else 0.0
 
 
 def _bench_generate(module: GPT, cfg: GPTConfig, on_tpu: bool):
@@ -309,10 +321,17 @@ def main() -> None:
 
     kernel_path = _kernel_paths(cfg, on_tpu)
     raw_tps, raw_spread = _bench_raw_step(make_module(), cfg, batch_size)
-    fit_tps, fit_spread = _bench_fit(make_module(), cfg, batch_size)
+    fit_tps, fit_spread, tel_report = _bench_fit(
+        make_module(), cfg, batch_size
+    )
     gen_tps, gen_tps_int8 = _bench_generate(make_module(), cfg, on_tpu)
+    try:
+        overhead_pct = round(_telemetry_overhead_pct(), 3)
+    except Exception as e:  # noqa: BLE001 - probe must not cost the line
+        sys.stderr.write(f"telemetry overhead probe skipped: {e}\n")
+        overhead_pct = None
 
-    peak = _peak_flops_per_chip() if on_tpu else None
+    peak = peak_flops_per_chip() if on_tpu else None
 
     def mfu(attn):
         if peak is None:
@@ -337,6 +356,21 @@ def main() -> None:
         "generate_tokens_per_sec_int8": gen_tps_int8,
         "kernel_path": kernel_path,
         "remat_policy": remat_policy,
+        # Machine-comparable telemetry block (schema:
+        # telemetry/schema.py, gated by tools/check_telemetry_schema.py):
+        # the fit run's step-time breakdown + the measured cost of the
+        # always-on cheap tier.
+        "telemetry": {
+            # The tier the fit ACTUALLY ran at (RLT_TELEMETRY may have
+            # overridden the cheap default; an artifact claiming a tier
+            # that never ran would poison round comparisons).
+            "tier": tel_report.get("tier") or "off",
+            "overhead_pct": overhead_pct,
+            "report": {
+                "step_stats": tel_report.get("step_stats", {}),
+                "counters": tel_report.get("counters", {}),
+            },
+        },
         "windows": WINDOWS,
         "window_steps": WINDOW_STEPS,
         "bottleneck": "attention bwd kernel + scan residual-save HBM "

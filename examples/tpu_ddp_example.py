@@ -28,6 +28,7 @@ def train_mnist(
     batch_size: int = 32,
     use_tune: bool = False,
     grad_comm: str = "full",
+    telemetry: str = "cheap",
 ):
     """≙ reference ``train_mnist`` (``ray_ddp_example.py:18-52``)."""
     callbacks = (
@@ -41,7 +42,12 @@ def train_mnist(
     trainer = Trainer(
         # grad_comm="int8_ef" compresses the cross-host gradient wire
         # ~4x (parallel/grad_sync.py); "full" is the exact default.
-        strategy=RayStrategy(num_workers=num_workers, grad_comm=grad_comm),
+        # telemetry="cheap" (the default) records the step-time split +
+        # throughput into callback_metrics for free; "full" additionally
+        # exports span traces (Perfetto-loadable) under
+        # rlt_logs/mnist_ddp/telemetry — see docs/OBSERVABILITY.md.
+        strategy=RayStrategy(num_workers=num_workers, grad_comm=grad_comm,
+                             telemetry=telemetry),
         max_epochs=num_epochs,
         callbacks=callbacks,
         log_every_n_steps=10,
@@ -95,6 +101,8 @@ if __name__ == "__main__":
     parser.add_argument("--smoke-test", action="store_true")
     parser.add_argument("--grad-comm", default="full",
                         choices=["full", "int8", "int8_ef"])
+    parser.add_argument("--telemetry", default="cheap",
+                        choices=["off", "cheap", "full"])
     args = parser.parse_args()
 
     epochs = 1 if args.smoke_test else args.num_epochs
@@ -105,7 +113,12 @@ if __name__ == "__main__":
         trainer = train_mnist(
             {}, num_workers=args.num_workers, num_epochs=epochs,
             batch_size=args.batch_size, grad_comm=args.grad_comm,
+            telemetry=args.telemetry,
         )
         print("final metrics:", {
             k: round(v, 4) for k, v in trainer.callback_metrics.items()
         })
+        if trainer.telemetry_report:
+            from ray_lightning_tpu.telemetry import format_report
+
+            print(format_report(trainer.telemetry_report))

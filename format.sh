@@ -12,7 +12,9 @@
 #      in indentation, no trailing whitespace, LF endings;
 #   2. flake8 (pinned below, when importable) — the CI lint gate;
 #   3. yapf --diff/--in-place (pinned below, when importable) with the
-#      repo .style.yapf.
+#      repo .style.yapf;
+#   4. telemetry artifact schema gate (tools/check_telemetry_schema.py,
+#      no deps beyond the package) — exporter/schema drift fails fast.
 # Missing optional tools are reported and skipped; the builtin layer
 # still gates, so "./format.sh --all" is meaningful everywhere.
 set -euo pipefail
@@ -96,6 +98,11 @@ if python -c "import yapf" 2>/dev/null; then
 else
   echo "format.sh: yapf not installed (pip install yapf==${YAPF_VERSION}) — skipped"
 fi
+
+# -- layer 4: telemetry artifact schemas (zero extra deps) -------------------
+# Gates producer/schema drift: exporter self-test + BENCH_*.json telemetry
+# blocks (tools/check_telemetry_schema.py).
+python tools/check_telemetry_schema.py || fail=1
 
 if [ $fail -ne 0 ]; then
   echo "format.sh: FAILED (run ./format.sh --fix after installing tools)"

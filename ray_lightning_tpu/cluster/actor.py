@@ -87,6 +87,14 @@ def _remote_get_node_ip() -> str:
     return rpc.get_node_ip()
 
 
+def _remote_get_host_stats() -> Dict[str, Any]:
+    """Host load/memory of the actor's node (straggler context for the
+    fleet telemetry report; jax-free — safe before/without PJRT init)."""
+    from ray_lightning_tpu.telemetry.aggregate import host_stats
+
+    return {"ip": rpc.get_node_ip(), **host_stats()}
+
+
 def _remote_get_device_info() -> Dict[str, Any]:
     """TPU analogue of get_node_and_gpu_ids (reference ``ray_ddp.py:55-58``).
 
@@ -371,6 +379,10 @@ class ProcessActor:
 
     def get_device_info(self) -> Dict[str, Any]:
         return self.execute(_remote_get_device_info)
+
+    def get_host_stats(self) -> Dict[str, Any]:
+        """Load/memory of the actor's host (straggler context)."""
+        return self.execute(_remote_get_host_stats)
 
     # -- lifecycle ----------------------------------------------------------
     def is_alive(self) -> bool:

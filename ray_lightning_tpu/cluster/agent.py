@@ -86,8 +86,14 @@ class NodeAgent:
     def _handle(self, msg: Tuple) -> Tuple:
         kind = msg[0]
         if kind == "ping":
+            # Host load/memory ride the ping so the driver can attach
+            # straggler context ("rank 3 is slow AND its host is at
+            # load 40") without a second RPC (telemetry/aggregate.py).
+            from ray_lightning_tpu.telemetry.aggregate import host_stats
+
             return ("ok", {"ip": rpc.get_node_ip(),
-                           "pid_count": len(self._procs)})
+                           "pid_count": len(self._procs),
+                           **host_stats()})
         if kind == "spawn":
             from .actor import spawn_child
 

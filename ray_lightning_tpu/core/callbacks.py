@@ -30,6 +30,7 @@ __all__ = [
     "ExponentialMovingAverage",
     "DeviceStatsCallback",
     "ProfilerCallback",
+    "TelemetryCallback",
 ]
 
 
@@ -361,28 +362,75 @@ class ProfilerCallback(Callback):
     TensorBoard or Perfetto.  Rank 0 only by default (per-device timelines
     are near-identical under SPMD); pass ``rank_zero_only=False`` for one
     trace per worker.  Traces land in ``<dirpath>/rank<k>/`` (``dirpath``
-    defaults to ``<default_root_dir>/profiler``).  The window opens at the
-    first step ``>= start_step`` — skipping early steps keeps compilation
-    noise out of the capture; on a resumed run it opens immediately.
+    defaults to the telemetry output dir when the telemetry subsystem is
+    active — so ``jax.profiler`` traces and the span exports land in one
+    place — else ``<default_root_dir>/profiler``).  The window opens at
+    the first step ``>= start_step`` — skipping early steps keeps
+    compilation noise out of the capture; on a resumed run it opens
+    immediately.
+
+    ``schedule`` generalizes to several capture windows per fit:
+    ``[(start_step, num_steps), ...]``.  Overlapping/touching windows
+    are MERGED at construction — ``jax.profiler.start_trace`` raises on
+    a second start, so overlap must never reach it — and the runtime
+    start is additionally ``_active``-guarded (a resume that restores a
+    stale ``_active=True``, or any double-fire, degrades to a skipped
+    window, never a crash).  ``teardown`` is idempotent.
     """
 
     def __init__(self, dirpath: Optional[str] = None, start_step: int = 2,
-                 num_steps: int = 3, rank_zero_only: bool = True):
-        if num_steps < 1:
-            raise ValueError("num_steps must be >= 1")
+                 num_steps: int = 3, rank_zero_only: bool = True,
+                 schedule: Optional[list] = None):
+        if schedule is None:
+            if num_steps < 1:
+                raise ValueError("num_steps must be >= 1")
+            windows = [(int(start_step), int(num_steps))]
+        else:
+            if not schedule:
+                raise ValueError("schedule must name at least one window")
+            spans = []
+            for item in schedule:
+                s, n = int(item[0]), int(item[1])
+                if s < 0 or n < 1:
+                    raise ValueError(
+                        f"schedule window {item!r}: start must be >= 0 "
+                        "and num_steps >= 1"
+                    )
+                spans.append((s, s + n))
+            # Merge overlapping/touching [start, end) intervals: two
+            # windows covering the same step must become ONE start/stop
+            # pair (double start_trace is a hard jax error).
+            spans.sort()
+            merged = [list(spans[0])]
+            for s, e in spans[1:]:
+                if s <= merged[-1][1]:
+                    merged[-1][1] = max(merged[-1][1], e)
+                else:
+                    merged.append([s, e])
+            windows = [(s, e - s) for s, e in merged]
         self.dirpath = dirpath
-        self.start_step = start_step
-        self.num_steps = num_steps
+        self.start_step = windows[0][0]   # introspection compat
+        self.num_steps = windows[0][1]
         self.rank_zero_only = rank_zero_only
+        self._windows = windows
+        self._win_i = 0
         self.trace_dir: Optional[str] = None
         self._active = False
         self._started_at: Optional[int] = None
 
     def setup(self, trainer, module, stage: str) -> None:
         if self.dirpath is None:
-            self.dirpath = os.path.join(
-                trainer.default_root_dir, "profiler"
+            tel_dir = getattr(trainer, "telemetry_dir", None)
+            self.dirpath = (
+                os.path.join(tel_dir, "profiler") if tel_dir
+                else os.path.join(trainer.default_root_dir, "profiler")
             )
+        # Fresh capture state per fit: callback objects are reused across
+        # fits (tuner sweeps) and re-shipped to workers on elastic
+        # restarts — stale ``_active``/window progress must never leak in.
+        self._active = False
+        self._win_i = 0
+        self._started_at = None
 
     def _enabled(self, trainer) -> bool:
         return trainer.is_global_zero or not self.rank_zero_only
@@ -393,29 +441,47 @@ class ProfilerCallback(Callback):
         if not self._enabled(trainer):
             return
         step = trainer.global_step
-        if (not self._active and self._started_at is None
-                and step >= self.start_step):
+        if not self._active:
+            if (self._win_i >= len(self._windows)
+                    or step < self._windows[self._win_i][0]):
+                return
             self.trace_dir = os.path.join(
                 self.dirpath, f"rank{trainer.global_rank}"
             )
             os.makedirs(self.trace_dir, exist_ok=True)
-            jax.profiler.start_trace(self.trace_dir)
+            try:
+                jax.profiler.start_trace(self.trace_dir)
+            except RuntimeError as e:
+                # A trace is already active (double-start from a stale
+                # resume, or an outer jax.profiler.trace context): skip
+                # this window rather than crash the fit.
+                import warnings
+
+                warnings.warn(f"ProfilerCallback: start_trace skipped ({e})")
+                self._win_i += 1
+                return
             self._active = True
             self._started_at = step
-        elif self._active and step >= self._started_at + self.num_steps:
+        elif step >= self._started_at + self._windows[self._win_i][1]:
             # Make the traced window's device work observable before stop.
             jax.block_until_ready(logs)
-            jax.profiler.stop_trace()
-            self._active = False
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                self._active = False
+                self._win_i += 1
 
     def teardown(self, trainer, module, stage: str) -> None:
-        if self._active:  # short runs: close the trace cleanly
-            import jax
+        if not self._active:  # idempotent: second teardown is a no-op
+            return
+        import jax
 
+        try:
             state = getattr(trainer, "state", None)
             if state is not None:  # flush async-dispatched traced work
                 jax.block_until_ready(state)
             jax.profiler.stop_trace()
+        finally:
             self._active = False
 
     def state_dict(self) -> Dict[str, Any]:
@@ -423,6 +489,80 @@ class ProfilerCallback(Callback):
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
         self.trace_dir = state.get("trace_dir")
+        # A state dict can NEVER restore a live trace: a restored
+        # ``_active=True`` would block every future window (or double-
+        # stop a trace this process never started).
+        self._active = False
+
+
+class TelemetryCallback(Callback):
+    """Span recording + artifact export for the telemetry subsystem.
+
+    The loop records cheap-tier telemetry (counters, step-time split,
+    headline ``callback_metrics``) on every fit without any callback.
+    Adding this callback is the per-fit opt-in for the rest:
+
+    * ``spans=True`` (default) upgrades the fit's tracer to record phase
+      spans even when the global tier is ``cheap`` — the callback IS the
+      explicit request, mirroring ``telemetry="full"`` on the strategy;
+    * at teardown it exports span JSONL + Chrome trace + the snapshot
+      into ``dirpath`` (default: the fit's telemetry dir — the same
+      output-dir family ``ProfilerCallback`` folds its ``jax.profiler``
+      traces into, so one directory opens the whole story in Perfetto);
+    * ``.report`` on the driver-side callback object carries the rank-0
+      snapshot after a remote fit (state-dict round-trip).
+    """
+
+    def __init__(self, dirpath: Optional[str] = None, spans: bool = True):
+        self.dirpath = dirpath
+        self.spans = spans
+        self.report: Dict[str, Any] = {}
+        self.export_paths: Dict[str, str] = {}
+
+    def _tel(self, trainer):
+        tel = getattr(trainer, "telemetry", None)
+        return tel if tel is not None and tel.enabled else None
+
+    def setup(self, trainer, module, stage: str) -> None:
+        tel = self._tel(trainer)
+        if self.dirpath is None:
+            self.dirpath = (
+                getattr(trainer, "telemetry_dir", None)
+                or os.path.join(trainer.default_root_dir, "telemetry")
+            )
+        if tel is not None and self.spans:
+            tel.tracer.enabled = True
+
+    def on_fit_end(self, trainer, module) -> None:
+        tel = self._tel(trainer)
+        if tel is not None:
+            self.report = tel.snapshot()
+
+    def teardown(self, trainer, module, stage: str) -> None:
+        tel = self._tel(trainer)
+        if tel is None:
+            return
+        if not self.report:
+            self.report = tel.snapshot()
+        if tel.tracer.enabled:
+            try:
+                self.export_paths = tel.export(self.dirpath)
+            except OSError as e:
+                import warnings
+
+                warnings.warn(f"telemetry export failed ({e})")
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "report": dict(self.report),
+            "dirpath": self.dirpath,
+            "export_paths": dict(self.export_paths),
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.report = dict(state.get("report", {}))
+        self.dirpath = state.get("dirpath", self.dirpath)
+        self.export_paths = dict(state.get("export_paths", {}))
 
 
 class DeviceStatsCallback(Callback):

@@ -17,6 +17,7 @@ import dataclasses
 import itertools
 import os
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -28,6 +29,7 @@ from ray_lightning_tpu.core.data import TpuDataModule
 from ray_lightning_tpu.core.module import TpuModule, TrainState
 from ray_lightning_tpu.parallel import sharding as shardlib
 from ray_lightning_tpu.parallel import step_fns
+from ray_lightning_tpu.telemetry import Telemetry
 from ray_lightning_tpu.utils.state_stream import (
     load_state_stream,
     state_stream_from_file,
@@ -158,6 +160,11 @@ class LoopContext:
         # paths when their step runs inside the quantized-sync island.
         self.grad_sync_active = False
         self.comm_stats: Dict[str, Any] = {}
+        # Telemetry runtime for this stage (always present; tier "off"
+        # degrades every surface to a no-op).  ``telemetry_dir`` is where
+        # exporters (span dumps, ProfilerCallback traces) co-locate.
+        self.telemetry: Optional[Telemetry] = None
+        self.telemetry_dir: Optional[str] = None
 
     @property
     def is_global_zero(self) -> bool:
@@ -194,7 +201,13 @@ class LoopContext:
             # restart path (``sharded_ckpt.save_shard``) still persists
             # it cheaply — each host writes only its own rows.
             state = TrainState(state.params, state.opt_state, state.step)
-        return shardlib.host_replicated_copy(state, self.mesh)
+        tel = self.telemetry
+        if tel is None:
+            return shardlib.host_replicated_copy(state, self.mesh)
+        with tel.span("host_transfer"):
+            out = shardlib.host_replicated_copy(state, self.mesh)
+        tel.add_counter("host_transfers", 1)
+        return out
 
     def checkpoint_payload(self, extra: Optional[Dict[str, Any]] = None) -> dict:
         return {
@@ -222,9 +235,18 @@ class LoopContext:
         payload = self.checkpoint_payload()
         if not self.is_global_zero:
             return
+        if self.telemetry is not None:
+            self.telemetry.add_counter("checkpoint_writes", 1)
+        tracer = (
+            self.telemetry.tracer if self.telemetry is not None else None
+        )
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         if not async_write:
-            state_stream_to_file(to_state_stream(payload), path)
+            if tracer is None:
+                state_stream_to_file(to_state_stream(payload), path)
+                return
+            with tracer.span("checkpoint_write", path=path):
+                state_stream_to_file(to_state_stream(payload), path)
             return
         if self._ckpt_queue is None:
             import queue as _q
@@ -243,6 +265,7 @@ class LoopContext:
             self._ckpt_lock = threading.Lock()
             q, errors = self._ckpt_queue, self._ckpt_errors
             pending, lock = self._ckpt_pending, self._ckpt_lock
+            wtracer = tracer  # tracer holds no device state — safe capture
 
             def writer():  # captures the queue/list, NOT self — the
                 # LoopContext (with its device-side state) must stay
@@ -253,7 +276,14 @@ class LoopContext:
                         if item is None:
                             return
                         p, pl = item
+                        t0 = time.perf_counter()
                         state_stream_to_file(to_state_stream(pl), p)
+                        if wtracer is not None:
+                            wtracer.record(
+                                "checkpoint_write", t0,
+                                time.perf_counter() - t0,
+                                args={"path": p, "async": True},
+                            )
                     except BaseException as e:  # noqa: BLE001
                         errors.append(e)
                     finally:
@@ -311,6 +341,20 @@ class LoopContext:
 def _call_hooks(callbacks: List[Callback], hook: str, *args) -> None:
     for cb in callbacks:
         getattr(cb, hook)(*args)
+
+
+def _maybe_export_telemetry(tel: Telemetry, out_dir: Optional[str]) -> None:
+    """Full tier: drop this rank's span dump + Chrome trace + snapshot
+    beside any ProfilerCallback capture (same output dir family).  A
+    failed export warns — telemetry must never cost the stage result."""
+    if not (tel.tracer.enabled and out_dir):
+        return
+    try:
+        tel.export(out_dir)
+    except OSError as e:
+        import warnings
+
+        warnings.warn(f"telemetry export failed ({e})")
 
 
 def _mesh_barrier(mesh) -> None:
@@ -393,30 +437,51 @@ class _RunningMeanLogs:
     is carried in f32 regardless of the logged dtype — a bf16 running
     sum would stop absorbing per-step increments once it exceeds ~256x
     their size (7-bit mantissa), silently biasing long-epoch means.
+
+    Non-finite step values (a NaN loss spike, an inf grad-norm log) are
+    EXCLUDED from the mean — one poisoned step must not turn the whole
+    epoch metric into NaN silently.  The exclusion happens on-device
+    (``isfinite`` + ``where``, no host sync per step); the count of
+    skipped values surfaces as ``nonfinite_count`` after :meth:`result`
+    so telemetry can make the poisoning loud instead of hidden.
     """
 
     def __init__(self) -> None:
         self._sum: Optional[Dict[str, Any]] = None
+        self._cnt: Optional[Dict[str, Any]] = None
         self._n = 0
+        self.nonfinite_count = 0  # populated by result()
 
     def update(self, logs: Dict[str, Any]) -> None:
         if self._sum is None:
-            self._sum = {
-                k: jnp.asarray(v).astype(jnp.float32)
-                for k, v in logs.items()
-            }
+            self._sum, self._cnt = {}, {}
+            for k, v in logs.items():
+                v32 = jnp.asarray(v).astype(jnp.float32)
+                finite = jnp.isfinite(v32)
+                self._sum[k] = jnp.where(finite, v32, 0.0)
+                self._cnt[k] = finite.astype(jnp.float32)
         else:
-            self._sum = {
-                k: self._sum[k] + jnp.asarray(logs[k]).astype(jnp.float32)
-                for k in self._sum
-            }
+            for k in self._sum:
+                v32 = jnp.asarray(logs[k]).astype(jnp.float32)
+                finite = jnp.isfinite(v32)
+                self._sum[k] = self._sum[k] + jnp.where(finite, v32, 0.0)
+                self._cnt[k] = self._cnt[k] + finite.astype(jnp.float32)
         self._n += 1
 
     def result(self) -> Dict[str, float]:
         if self._sum is None:
             return {}
-        host = jax.device_get(self._sum)
-        return {k: float(v) / self._n for k, v in host.items()}
+        host_sum, host_cnt = jax.device_get((self._sum, self._cnt))
+        out: Dict[str, float] = {}
+        nonfinite = 0
+        for k, s in host_sum.items():
+            c = float(host_cnt[k])
+            nonfinite += self._n - int(round(c))
+            # Every value non-finite: nothing to average — report NaN
+            # (loudly wrong) rather than a fabricated 0.
+            out[k] = float(s) / c if c else float("nan")
+        self.nonfinite_count = nonfinite
+        return out
 
 
 def init_train_state(
@@ -491,7 +556,8 @@ def _place_batch(batch, mesh):
     return shardlib.make_global_batch(batch, mesh)
 
 
-def _prefetched(loader, place: Callable[[Any], Any], depth: int = 2):
+def _prefetched(loader, place: Callable[[Any], Any], depth: int = 2,
+                telemetry: Optional[Telemetry] = None):
     """Iterate ``loader`` with host→device placement running ``depth``
     batches ahead on a background thread.
 
@@ -499,6 +565,12 @@ def _prefetched(loader, place: Callable[[Any], Any], depth: int = 2):
     first serial bottleneck: without prefetch every step pays the numpy
     slice + ``device_put`` latency on the critical path.  A thread is
     enough — placement releases the GIL during the host→HBM DMA.
+
+    ``telemetry`` (producer-side accounting): total host→device
+    placement seconds and batch count land in the counters, so the
+    consumer's ``data_wait_ms`` (how long the LOOP stalled) can be read
+    against how busy the producer actually was — a high place total with
+    near-zero data wait means the prefetch depth is doing its job.
     """
     import queue as pyqueue
     import threading
@@ -515,7 +587,15 @@ def _prefetched(loader, place: Callable[[Any], Any], depth: int = 2):
     def producer() -> None:
         try:
             for item in loader:
+                t0 = time.perf_counter()
                 placed = place(item)
+                if telemetry is not None:
+                    # Counter keys are producer-thread-private; the dict
+                    # update itself is GIL-atomic.
+                    telemetry.add_counter(
+                        "prefetch_place_s", time.perf_counter() - t0
+                    )
+                    telemetry.add_counter("prefetch_batches", 1)
                 while not stop.is_set():
                     try:
                         buf.put(placed, timeout=0.1)
@@ -622,6 +702,7 @@ def run_fit(
     mode: str = "gspmd",
     zero_stage: int = 0,
     grad_comm=None,
+    telemetry=None,
     queue=None,
 ) -> Dict[str, Any]:
     """The full fit loop.  Returns the rank-0 result package.
@@ -629,7 +710,9 @@ def run_fit(
     Result shape ≙ reference ``execute_remote``'s rank-0 return tuple
     (``ray_ddp.py:490-519``): state stream + callback metrics + best model
     path (+ callback states so driver-side callback objects reflect what
-    happened remotely).
+    happened remotely).  Every rank's package additionally carries its
+    telemetry snapshot, so the driver can build the fleet-wide skew view
+    (``trainer.telemetry_report``) — not just rank-0's numbers.
     """
     _enable_compile_cache()
     tx = module.configure_optimizers()
@@ -656,6 +739,21 @@ def run_fit(
     module.trainer = ctx
     module.precision = config.precision
 
+    # Telemetry: on by default at the cheap tier (counters + step stats);
+    # spans/export engage at tier "full" (telemetry= / RLT_TELEMETRY).
+    n_chips = len(mesh.devices.flat) if mesh is not None else 1
+    tel = Telemetry.build(
+        telemetry, global_rank, world_size, n_chips=n_chips
+    )
+    ctx.telemetry = tel
+    ctx.telemetry_dir = (
+        tel.export_dir_for(config.default_root_dir) if tel.enabled
+        else None
+    )
+    tel_stats = tel.step_stats
+    if tel_stats is not None:
+        tel_stats.configure_model(module)
+
     module.setup("fit")
     datamodule.set_shard(global_rank, world_size)
     # prepare_data is per-HOST work (downloads land on each host's local
@@ -678,10 +776,15 @@ def run_fit(
         module, mesh, grad_comm, mode=mode, zero_stage=zero_stage
     )
     ctx.grad_sync_active = grad_sync is not None
-    ctx.comm_stats = (
-        grad_sync.stats() if grad_sync is not None
-        else {"grad_sync_mode": "full"}
-    )
+    # Wire accounting flows through the telemetry counters (the unified
+    # report) — ``ctx.comm_stats`` stays as a compatibility view of the
+    # same numbers, not a parallel bookkeeping path.
+    if grad_sync is not None:
+        grad_sync.register_telemetry(tel)
+        ctx.comm_stats = grad_sync.stats()
+    else:
+        tel.set_meta("grad_sync_mode", "full")
+        ctx.comm_stats = {"grad_sync_mode": "full"}
 
     state, state_shardings = init_train_state(
         module, tx, mesh, zero_stage, config.seed,
@@ -829,9 +932,18 @@ def run_fit(
         )
         last_logs: Dict[str, Any] = {}
         last_batch_idx = -1
+        # Telemetry marks: ``t_mark`` is set at the end of each loop body,
+        # so the gap to the next batch's arrival is exactly the time spent
+        # blocked on the (prefetched) input pipeline — data_wait.
+        t_mark = time.perf_counter()
+        tracer = tel.tracer
         for batch_idx, gbatch in enumerate(
-            _prefetched(source, lambda b: _place_batch(b, mesh))
+            _prefetched(
+                source, lambda b: _place_batch(b, mesh),
+                telemetry=tel if tel.enabled else None,
+            )
         ):
+            t_ready = time.perf_counter()
             if (
                 config.limit_train_batches >= 0
                 and batch_idx >= config.limit_train_batches
@@ -845,7 +957,17 @@ def run_fit(
                 stop = True
                 break
             rng = jax.random.fold_in(base_rng, ctx.micro_step)
+            t_disp = time.perf_counter()
             ctx.state, logs = train_step(ctx.state, gbatch, rng)
+            t_disp_end = time.perf_counter()
+            # Periodic device sampling: make THIS step's wall time
+            # include device execution (async dispatch hides it
+            # otherwise).  Never per-step — that would serialize host
+            # and device and become the overhead telemetry promises
+            # not to add.
+            sampled = tel_stats is not None and tel_stats.should_sample()
+            if sampled:
+                jax.block_until_ready(logs)
             epoch_mean.update(logs)
             ctx.micro_step += 1
             since_update += 1
@@ -859,6 +981,24 @@ def run_fit(
                 callbacks, "on_train_batch_end", ctx, module, logs, batch_idx
             )
             last_logs, last_batch_idx = logs, batch_idx
+            t_end = time.perf_counter()
+            if tel_stats is not None:
+                leaves = jax.tree_util.tree_leaves(gbatch)
+                shape = getattr(leaves[0], "shape", None) if leaves else None
+                tel_stats.record_step(
+                    step_s=t_end - t_mark,
+                    data_wait_s=t_ready - t_mark,
+                    dispatch_s=t_disp_end - t_disp,
+                    examples=int(shape[0]) if shape else 1,
+                    sampled=sampled,
+                )
+            if tracer.enabled:
+                tracer.record("data_wait", t_mark, t_ready - t_mark)
+                tracer.record(
+                    "compile" if ctx.micro_step == 1 else "dispatch",
+                    t_disp, t_disp_end - t_disp,
+                )
+            t_mark = t_end
 
         # Flush a partial accumulation window (Lightning semantics: the
         # last incomplete window of an epoch still steps, from the mean
@@ -891,6 +1031,16 @@ def run_fit(
         train_metrics = epoch_mean.result()
         ctx.log_metrics(train_metrics)
         _log_lr(ctx, lr_schedule)
+        if tel.enabled:
+            # NaN/inf step logs were excluded from the epoch means above;
+            # surface the count so the exclusion is loud, not silent.
+            if epoch_mean.nonfinite_count:
+                tel.add_counter(
+                    "nonfinite_logs", epoch_mean.nonfinite_count
+                )
+            # Headline telemetry rides callback_metrics on every plain
+            # fit (step_time_ms, data_wait_ms, examples_per_sec, mfu…).
+            ctx.log_metrics(tel.headline_metrics())
         module.on_train_epoch_end(epoch, train_metrics)
 
         # -- validation ----------------------------------------------------
@@ -898,9 +1048,11 @@ def run_fit(
             eval_step is not None
             and (epoch + 1) % config.check_val_every_n_epoch == 0
         ):
-            val_metrics = _run_validation(
-                module, eval_step, val_loader, ctx, config.limit_val_batches
-            )
+            with tel.span("validation", epoch=epoch):
+                val_metrics = _run_validation(
+                    module, eval_step, val_loader, ctx,
+                    config.limit_val_batches,
+                )
             ctx.log_metrics(val_metrics)
             module.on_validation_epoch_end(val_metrics)
             _call_hooks(callbacks, "on_validation_epoch_end", ctx, module)
@@ -985,8 +1137,12 @@ def run_fit(
     # The gather is collective: every rank participates, then only rank 0
     # serializes and ships the bytes.
     gathered = ctx._gathered_state()
+    _maybe_export_telemetry(tel, ctx.telemetry_dir)
+    # Snapshots ride EVERY rank's package (small dicts), so the driver
+    # can aggregate min/max/mean across the fleet, not just rank 0.
+    tel_snapshot = tel.snapshot()
     if not ctx.is_global_zero:
-        return {"rank": global_rank}
+        return {"rank": global_rank, "telemetry": tel_snapshot}
     best_path = ""
     for cb in callbacks:
         if isinstance(cb, ModelCheckpoint):
@@ -1007,6 +1163,7 @@ def run_fit(
         "global_step": ctx.global_step,
         "micro_step": ctx.micro_step,
         "comm_stats": dict(ctx.comm_stats),
+        "telemetry": tel_snapshot,
     }
 
 
@@ -1071,6 +1228,7 @@ def run_eval(
     zero_stage: int = 0,
     params_stream: Optional[bytes] = None,
     ckpt_path: Optional[str] = None,
+    telemetry=None,
     queue=None,
 ) -> Dict[str, Any]:
     """Validation/test loop (≙ reference ``start_evaluating``,
@@ -1081,6 +1239,15 @@ def run_eval(
     ctx.step_mode = mode
     ctx.zero_stage = zero_stage
     module.trainer = ctx
+    n_chips = len(mesh.devices.flat) if mesh is not None else 1
+    tel = Telemetry.build(
+        telemetry, global_rank, world_size, n_chips=n_chips
+    )
+    ctx.telemetry = tel
+    ctx.telemetry_dir = (
+        tel.export_dir_for(config.default_root_dir) if tel.enabled
+        else None
+    )
     module.setup(stage)
     datamodule.set_shard(global_rank, world_size)
     datamodule.setup(stage)
@@ -1101,15 +1268,21 @@ def run_eval(
     eval_step = step_fns.build_eval_step(
         module, mesh, kind, mode=mode, params_shardings=params_shardings
     )
-    metrics = _run_validation(
-        module, eval_step, loader, ctx, config.limit_val_batches
-    )
+    with tel.span("validation", kind=kind):
+        metrics = _run_validation(
+            module, eval_step, loader, ctx, config.limit_val_batches
+        )
     ctx.log_metrics(metrics)
     module.teardown(stage)
     _call_hooks(callbacks, "teardown", ctx, module, stage)
+    _maybe_export_telemetry(tel, ctx.telemetry_dir)
     if not ctx.is_global_zero:
-        return {"rank": global_rank}
-    return {"rank": 0, "callback_metrics": metrics}
+        return {"rank": global_rank, "telemetry": tel.snapshot()}
+    return {
+        "rank": 0,
+        "callback_metrics": metrics,
+        "telemetry": tel.snapshot(),
+    }
 
 
 def run_predict(
@@ -1122,6 +1295,7 @@ def run_predict(
     zero_stage: int = 0,
     params_stream: Optional[bytes] = None,
     ckpt_path: Optional[str] = None,
+    telemetry=None,
 ) -> Dict[str, Any]:
     """Prediction loop (≙ reference ``start_predicting``, ``ray_ddp.py:287-289``).
 
@@ -1130,6 +1304,10 @@ def run_predict(
     returned rank-0 results).
     """
     _enable_compile_cache()
+    tel = Telemetry.build(
+        telemetry, global_rank, world_size,
+        n_chips=len(mesh.devices.flat) if mesh is not None else 1,
+    )
     module.setup("predict")
     datamodule.set_shard(global_rank, world_size)
     datamodule.setup("predict")
@@ -1145,20 +1323,31 @@ def run_predict(
 
     outputs: List[np.ndarray] = []
     for batch in loader:
-        out = predict_step(params, _place_batch(batch, mesh))
+        with tel.span("dispatch"):
+            out = predict_step(params, _place_batch(batch, mesh))
         # Host-local rows only: each host contributes its addressable
         # shards (its own slice of the global batch), ordered by shard
         # index so rows stay in loader order within the host.
-        if mesh is not None and world_size > 1:
-            shards = sorted(
-                out.addressable_shards, key=lambda s: s.index[0].start or 0
-            )
-            local = [s.data for s in shards]
-            outputs.append(np.concatenate(jax.device_get(local)))
-        else:
-            outputs.append(np.asarray(jax.device_get(out)))
+        with tel.span("host_transfer"):
+            if mesh is not None and world_size > 1:
+                shards = sorted(
+                    out.addressable_shards,
+                    key=lambda s: s.index[0].start or 0,
+                )
+                local = [s.data for s in shards]
+                outputs.append(np.concatenate(jax.device_get(local)))
+            else:
+                outputs.append(np.asarray(jax.device_get(out)))
     module.teardown("predict")
+    _maybe_export_telemetry(
+        tel, tel.export_dir_for(config.default_root_dir)
+        if tel.enabled else None,
+    )
     # Per-batch arrays (NOT pre-concatenated): each global batch is split
     # host-contiguously by NumpyLoader, so the driver must interleave
     # ranks batch-by-batch to recover dataset row order.
-    return {"rank": global_rank, "prediction_batches": outputs}
+    return {
+        "rank": global_rank,
+        "prediction_batches": outputs,
+        "telemetry": tel.snapshot(),
+    }

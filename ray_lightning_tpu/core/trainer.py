@@ -145,7 +145,12 @@ class Trainer:
         # gradient accumulation is active)
         # Gradient-sync wire accounting from the workers (grad_sync_mode,
         # grad_sync_bytes, compression ratio — parallel/grad_sync.py).
+        # Compatibility view: the same numbers appear as counters in the
+        # unified ``telemetry_report`` below.
         self.comm_stats: Dict[str, Any] = {}
+        # Fleet-wide telemetry (telemetry/aggregate.py): every worker's
+        # snapshot merged into min/max/mean-across-ranks skew views.
+        self.telemetry_report: Dict[str, Any] = {}
         self._state_stream: Optional[bytes] = None
 
     # -- live metric streaming (driver-side queue pump hook) ----------------
@@ -196,10 +201,26 @@ class Trainer:
         self.global_step = rank0["global_step"]
         self.micro_step = rank0.get("micro_step", self.global_step)
         self.comm_stats = dict(rank0.get("comm_stats", {}))
+        self._merge_telemetry(results, replace=True)
         # Driver-side callback objects reflect what happened remotely
         # (≙ best_model_path adoption, ray_ddp.py:393-395 — generalized).
         for cb, cb_state in zip(self.callbacks, rank0["callback_states"]):
             cb.load_state_dict(cb_state)
+
+    def _merge_telemetry(self, results: List[Dict[str, Any]],
+                         replace: bool = False) -> None:
+        """Merge EVERY rank's telemetry snapshot (each result package
+        carries one — the non-zero ranks' packages exist for exactly
+        this) into the fleet skew report.  Runs for fit, eval AND
+        predict.  A fit REPLACES the report (even with an empty one —
+        telemetry="off" must read as off); eval/predict update it only
+        when they actually produced one, so a quick validate never
+        wipes the fit's record."""
+        from ray_lightning_tpu.telemetry import merge_snapshots
+
+        report = merge_snapshots([r.get("telemetry") for r in results])
+        if report or replace:
+            self.telemetry_report = report
 
     @property
     def params(self):
@@ -231,6 +252,7 @@ class Trainer:
         rank0 = next(r for r in results if r.get("rank") == 0)
         metrics = rank0["callback_metrics"]
         self.callback_metrics.update(metrics)
+        self._merge_telemetry(results)
         return metrics
 
     def _params_stream_for_eval(self, ckpt_path: Optional[str]):
@@ -282,6 +304,7 @@ class Trainer:
             )
         finally:
             self.strategy.teardown()
+        self._merge_telemetry(results)
         # Reassemble dataset row order: every global batch was split
         # host-contiguously (NumpyLoader), so interleave ranks per batch —
         # batch b = [rank0's slice, rank1's slice, ...] — then chain

@@ -302,6 +302,26 @@ class GradSync:
             "grad_sync_devices": self.n_shards,
         }
 
+    def register_telemetry(self, telemetry) -> None:
+        """Publish the wire accounting through the unified telemetry
+        counters (numbers) / meta (mode strings) instead of a bespoke
+        stats dict: ``grad_sync_bytes`` then appears in the fleet report
+        (``trainer.telemetry_report``) next to step timings, and a
+        grad-sync metadata span marks the plan in exported traces."""
+        for key, value in self.stats().items():
+            if isinstance(value, bool) or value is None:
+                telemetry.set_meta(key, value)
+            elif isinstance(value, (int, float)):
+                telemetry.set_counter(key, value)
+            else:
+                telemetry.set_meta(key, value)
+        telemetry.tracer.instant(
+            "grad_sync",
+            mode=self.cfg.mode,
+            buckets=self.plan.num_buckets,
+            bytes_per_step=self.bytes_per_step,
+        )
+
     # -- error-feedback residual -------------------------------------------
     def residual_sharding(self) -> NamedSharding:
         """One f32 row per sync participant, row ``d`` living on device

@@ -141,6 +141,7 @@ def _execute_remote(task_ref, global_rank: int, queue_handle) -> Dict[str, Any]:
                 mode=task["mode"],
                 zero_stage=task["zero_stage"],
                 grad_comm=task.get("grad_comm"),
+                telemetry=task.get("telemetry"),
                 queue=queue_handle,
                 **common,
             )
@@ -152,6 +153,7 @@ def _execute_remote(task_ref, global_rank: int, queue_handle) -> Dict[str, Any]:
                 zero_stage=task["zero_stage"],
                 params_stream=task.get("params_stream"),
                 ckpt_path=task.get("ckpt_path"),
+                telemetry=task.get("telemetry"),
                 queue=queue_handle,
                 **common,
             )
@@ -160,6 +162,7 @@ def _execute_remote(task_ref, global_rank: int, queue_handle) -> Dict[str, Any]:
                 zero_stage=task["zero_stage"],
                 params_stream=task.get("params_stream"),
                 ckpt_path=task.get("ckpt_path"),
+                telemetry=task.get("telemetry"),
                 **common,
             )
         raise ValueError(f"Unknown stage kind {task['kind']!r}")
@@ -207,6 +210,7 @@ class TpuStrategy:
         max_restarts: int = 0,
         restart_every_n_epochs: int = 1,
         grad_comm=None,
+        telemetry=None,
     ):
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
@@ -234,6 +238,14 @@ class TpuStrategy:
 
             grad_comm = GradCommConfig.coerce(grad_comm)
         self.grad_comm = grad_comm
+        # Telemetry tier/knobs (tier string, dict, or TelemetryConfig;
+        # None = RLT_TELEMETRY env bus / cheap default).  Same eager
+        # validation discipline as grad_comm: a typo'd tier fails here.
+        if telemetry is not None:
+            from ray_lightning_tpu.telemetry import TelemetryConfig
+
+            telemetry = TelemetryConfig.coerce(telemetry)
+        self.telemetry = telemetry
         self.env_per_worker = dict(env_per_worker or {})
         # Persistent XLA compilation cache (RLT_COMPILE_CACHE=dir): the
         # first GPT-2-scale compile costs 20-40s on this platform; a
@@ -258,7 +270,12 @@ class TpuStrategy:
         # driver-side RLT_GRAD_COMM would silently resolve to full-width
         # on exactly the multi-host topology compression targets.
         for var in ("RLT_GRAD_COMM", "RLT_GRAD_BUCKET_MB",
-                    "RLT_GRAD_BLOCK", "RLT_GRAD_DCN_ONLY"):
+                    "RLT_GRAD_BLOCK", "RLT_GRAD_DCN_ONLY",
+                    # Telemetry env bus rides the same bridge: a
+                    # driver-side RLT_TELEMETRY must reach workers
+                    # spawned through node agents too.
+                    "RLT_TELEMETRY", "RLT_TELEMETRY_SAMPLE",
+                    "RLT_TELEMETRY_DIR", "RLT_TELEMETRY_PEAK"):
             val = os.environ.get(var)
             if val is not None:
                 self.env_per_worker.setdefault(var, val)
@@ -490,6 +507,7 @@ class TpuStrategy:
             "mode": self.mode,
             "zero_stage": self.zero_stage,
             "grad_comm": self.grad_comm,
+            "telemetry": self.telemetry,
             "params_stream": params_stream,
             "ckpt_path": ckpt_path,
         }
@@ -531,6 +549,11 @@ class TpuStrategy:
         ≙ ``get_node_and_gpu_ids`` sweep at ``ray_ddp.py:230-274``)."""
         return [w.get_device_info() for w in self._workers]
 
+    def get_worker_host_stats(self) -> List[Dict[str, Any]]:
+        """Per-worker host load/memory — the straggler-context companion
+        to ``trainer.telemetry_report``'s rank-skew view."""
+        return [w.get_host_stats() for w in self._workers]
+
 
 class LocalStrategy(TpuStrategy):
     """In-process execution on the driver's own devices (no actors).
@@ -543,9 +566,10 @@ class LocalStrategy(TpuStrategy):
 
     def __init__(self, mesh_axes: Optional[Dict[str, int]] = None,
                  mode: str = "gspmd", zero_stage: int = 0,
-                 grad_comm=None):
+                 grad_comm=None, telemetry=None):
         super().__init__(
-            num_workers=1, mesh_axes=mesh_axes, grad_comm=grad_comm
+            num_workers=1, mesh_axes=mesh_axes, grad_comm=grad_comm,
+            telemetry=telemetry,
         )
         self.mode = mode
         self.zero_stage = zero_stage
@@ -579,16 +603,19 @@ class LocalStrategy(TpuStrategy):
         if kind == "fit":
             return [run_fit(callbacks=callbacks, mode=self.mode,
                             zero_stage=self.zero_stage,
-                            grad_comm=self.grad_comm, **common)]
+                            grad_comm=self.grad_comm,
+                            telemetry=self.telemetry, **common)]
         if kind in ("validation", "test"):
             return [run_eval(callbacks=callbacks, kind=kind, mode=self.mode,
                              zero_stage=self.zero_stage,
                              params_stream=params_stream,
-                             ckpt_path=ckpt_path, **common)]
+                             ckpt_path=ckpt_path,
+                             telemetry=self.telemetry, **common)]
         if kind == "predict":
             return [run_predict(zero_stage=self.zero_stage,
                                 params_stream=params_stream,
-                                ckpt_path=ckpt_path, **common)]
+                                ckpt_path=ckpt_path,
+                                telemetry=self.telemetry, **common)]
         raise ValueError(f"Unknown stage kind {kind!r}")
 
     def teardown(self) -> None:

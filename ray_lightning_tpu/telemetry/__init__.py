@@ -1,0 +1,57 @@
+"""Unified telemetry: span tracing, step-time breakdown, MFU, fleet views.
+
+The observability subsystem (ISSUE 2).  One import surface:
+
+* :class:`Telemetry` / :class:`TelemetryConfig` — the per-rank runtime
+  and its tier knobs (``off`` / ``cheap`` default / ``full``), coerced
+  from ``telemetry=`` on the strategies or the ``RLT_TELEMETRY`` env bus;
+* :class:`SpanTracer` — phase spans with JSONL + Chrome-trace export;
+* :class:`StepStats` — step-time split, throughput, analytic-FLOPs MFU,
+  recompile counters, device memory stats;
+* :func:`merge_snapshots` / :func:`host_stats` — driver-side fleet
+  aggregation (``trainer.telemetry_report``) and straggler host context;
+* :mod:`.trace_parse` / :mod:`.schema` — Chrome-trace parsing shared by
+  the tools, and the artifact-schema validators ``format.sh`` gates on.
+
+See ``docs/OBSERVABILITY.md`` for the workflow.
+"""
+
+from ray_lightning_tpu.telemetry.aggregate import (
+    format_report,
+    host_stats,
+    merge_snapshots,
+    straggler_ranks,
+)
+from ray_lightning_tpu.telemetry.runtime import (
+    TIERS,
+    Telemetry,
+    TelemetryConfig,
+)
+from ray_lightning_tpu.telemetry.spans import PHASES, Span, SpanTracer
+from ray_lightning_tpu.telemetry.step_stats import (
+    StepStats,
+    compile_event_count,
+    flops_for_module,
+    model_flops_per_token,
+    peak_flops_per_chip,
+    vit_flops_per_example,
+)
+
+__all__ = [
+    "Telemetry",
+    "TelemetryConfig",
+    "TIERS",
+    "SpanTracer",
+    "Span",
+    "PHASES",
+    "StepStats",
+    "model_flops_per_token",
+    "vit_flops_per_example",
+    "flops_for_module",
+    "peak_flops_per_chip",
+    "compile_event_count",
+    "merge_snapshots",
+    "host_stats",
+    "straggler_ranks",
+    "format_report",
+]

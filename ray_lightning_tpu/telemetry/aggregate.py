@@ -1,0 +1,184 @@
+"""Fleet aggregation: merge per-rank telemetry snapshots on the driver.
+
+Every worker's :meth:`Telemetry.snapshot` rides its result package (the
+same channel ``comm_stats`` already uses); the driver merges them into
+``trainer.telemetry_report`` — min/max/mean-across-ranks views whose
+*skew* is the straggler signal (a healthy SPMD fleet is near-uniform:
+one rank with 3x the ``data_wait_ms`` of its peers names the slow host).
+
+Also here: :func:`host_stats`, the host-load/memory probe the node
+agent's ``ping()`` and the actors' ``get_host_stats()`` expose so the
+driver can attach host context to a straggler rank.  jax-free on
+purpose (the driver may be a CPU-only laptop; the agent must not import
+jax at all).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "merge_snapshots",
+    "host_stats",
+    "straggler_ranks",
+    "format_report",
+]
+
+def _summable(name: str) -> bool:
+    """Whether a cross-rank ``sum`` view makes sense for a counter.
+    Every ``grad_sync_*`` stat is an analytic per-device constant
+    (bytes, ratio, buckets, block size, devices) — identical on every
+    rank, so a sum would misread as a fleet total."""
+    return not name.startswith("grad_sync_")
+
+
+def _stat_view(values: List[float]) -> Dict[str, float]:
+    mean = sum(values) / len(values)
+    view = {
+        "min": min(values),
+        "max": max(values),
+        "mean": mean,
+    }
+    if mean:
+        # Relative spread across ranks: the straggler metric.
+        view["skew_pct"] = 100.0 * (view["max"] - view["min"]) / abs(mean)
+    return view
+
+
+def merge_snapshots(snapshots: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge per-rank snapshots into the driver-side telemetry report.
+
+    Numeric ``step_stats`` keys and counters get min/max/mean(+skew)
+    views; non-numeric metadata (tier, modes) is taken from rank 0.
+    ``per_rank`` keeps the raw snapshots — they are small dicts, and the
+    report must let a human drill from "rank skew 40%" to "which rank".
+    """
+    snaps = [s for s in snapshots if s]
+    if not snaps:
+        return {}
+    snaps = sorted(snaps, key=lambda s: s.get("rank", 0))
+    report: Dict[str, Any] = {
+        "world_size": len(snaps),
+        "tier": snaps[0].get("tier"),
+        "per_rank": snaps,
+    }
+
+    def merge_numeric(section: str, pad_missing: bool = False):
+        keys: Dict[str, List[float]] = {}
+        for s in snaps:
+            for k, v in (s.get(section) or {}).items():
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                keys.setdefault(k, []).append(float(v))
+        out = {}
+        for k, vals in keys.items():
+            if len(vals) < len(snaps):
+                if not pad_missing:
+                    continue  # only fleet-complete metrics
+                # Rank-0-only counters (checkpoint_writes — file I/O is
+                # rank-guarded) and subset events (nonfinite_logs on the
+                # one poisoned rank) must SURVIVE the merge: a missing
+                # rank contributed zero, it didn't opt out.
+                vals = vals + [0.0] * (len(snaps) - len(vals))
+            view = _stat_view(vals)
+            if len(keys[k]) < len(snaps):
+                view["ranks_reporting"] = len(keys[k])
+            out[k] = view
+        return out
+
+    report["step_stats"] = merge_numeric("step_stats")
+    counters = merge_numeric("counters", pad_missing=True)
+    for name, view in counters.items():
+        if _summable(name):
+            view["sum"] = view["mean"] * len(snaps)
+    report["counters"] = counters
+    meta = snaps[0].get("meta") or {}
+    if meta:
+        report["meta"] = dict(meta)
+    return report
+
+
+def host_stats() -> Dict[str, Any]:
+    """Best-effort host load/memory for straggler context.
+
+    Linux-first (``/proc/meminfo``); every probe degrades to absence,
+    never to an exception — a telemetry read must not kill a ping.
+    """
+    out: Dict[str, Any] = {}
+    try:
+        la1, la5, la15 = os.getloadavg()
+        out["load_1m"] = round(la1, 2)
+        out["load_5m"] = round(la5, 2)
+        out["load_15m"] = round(la15, 2)
+    except (OSError, AttributeError):
+        pass
+    try:
+        out["cpu_count"] = os.cpu_count()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        with open("/proc/meminfo") as f:
+            mem: Dict[str, int] = {}
+            for line in f:
+                parts = line.split()
+                if parts and parts[0].rstrip(":") in (
+                    "MemTotal", "MemAvailable"
+                ):
+                    mem[parts[0].rstrip(":")] = int(parts[1]) * 1024
+        if "MemTotal" in mem:
+            out["mem_total_bytes"] = mem["MemTotal"]
+        if "MemAvailable" in mem:
+            out["mem_available_bytes"] = mem["MemAvailable"]
+    except (OSError, ValueError, IndexError):
+        pass
+    return out
+
+
+def straggler_ranks(report: Dict[str, Any], metric: str = "step_mean_ms",
+                    threshold_pct: float = 20.0) -> List[int]:
+    """Ranks whose ``metric`` exceeds the fleet mean by more than
+    ``threshold_pct`` — the drill-down the skew view points at."""
+    view = (report.get("step_stats") or {}).get(metric)
+    if not view or not view.get("mean"):
+        return []
+    cut = view["mean"] * (1.0 + threshold_pct / 100.0)
+    out = []
+    for snap in report.get("per_rank", []):
+        v = (snap.get("step_stats") or {}).get(metric)
+        if isinstance(v, (int, float)) and v > cut:
+            out.append(int(snap.get("rank", -1)))
+    return out
+
+
+def _fmt_val(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    if abs(v) >= 1000:
+        return f"{v:,.0f}"
+    return f"{v:.3g}"
+
+
+def format_report(report: Dict[str, Any]) -> str:
+    """Human-readable one-screen rendering of a telemetry report."""
+    if not report:
+        return "telemetry: (empty report)"
+    lines = [
+        f"telemetry report — {report.get('world_size', '?')} rank(s), "
+        f"tier={report.get('tier')}"
+    ]
+    for section in ("step_stats", "counters"):
+        views = report.get(section) or {}
+        if not views:
+            continue
+        lines.append(f"  {section}:")
+        for name in sorted(views):
+            v = views[name]
+            skew = (f"  skew={v['skew_pct']:.1f}%"
+                    if "skew_pct" in v else "")
+            lines.append(
+                f"    {name:<28} mean={_fmt_val(v.get('mean')):>10} "
+                f"min={_fmt_val(v.get('min')):>10} "
+                f"max={_fmt_val(v.get('max')):>10}{skew}"
+            )
+    return "\n".join(lines)

@@ -1,0 +1,146 @@
+"""Telemetry artifact schemas + validators (the drift gate).
+
+Three artifact families leave this subsystem: JSONL span dumps, Chrome
+``trace_event`` documents, and the ``telemetry`` block inside
+``BENCH_*.json``.  Downstream consumers (Perfetto, the trace-summary
+tool, round-over-round bench comparison) parse them long after the
+producing code has moved on — so the schema is written down HERE, and
+``tools/check_telemetry_schema.py`` (wired into ``format.sh``) fails
+fast when a producer drifts.
+
+Validators return a list of problem strings (empty = valid) instead of
+raising, so the CLI can report every problem in one pass.  jax-free.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+__all__ = [
+    "validate_span",
+    "validate_span_jsonl",
+    "validate_chrome_trace",
+    "validate_bench_telemetry",
+]
+
+# JSONL span schema: required key → allowed types.
+_SPAN_REQUIRED = {
+    "name": str,
+    "ts": (int, float),
+    "dur": (int, float),
+    "rank": int,
+    "tid": int,
+    "depth": int,
+}
+_SPAN_OPTIONAL = {"args": dict}
+
+# Chrome complete-event schema (the subset our exporter emits and
+# Perfetto requires).
+_CHROME_X_REQUIRED = {
+    "name": str,
+    "ts": (int, float),
+    "dur": (int, float),
+    "pid": int,
+    "tid": int,
+}
+
+
+def _check_fields(obj: Dict[str, Any], required: dict, optional: dict,
+                  where: str) -> List[str]:
+    problems = []
+    if not isinstance(obj, dict):
+        return [f"{where}: expected object, got {type(obj).__name__}"]
+    for key, types in required.items():
+        if key not in obj:
+            problems.append(f"{where}: missing required key {key!r}")
+        elif not isinstance(obj[key], types) or isinstance(obj[key], bool):
+            problems.append(
+                f"{where}: key {key!r} has type "
+                f"{type(obj[key]).__name__}"
+            )
+    for key, types in optional.items():
+        if key in obj and not isinstance(obj[key], types):
+            problems.append(
+                f"{where}: optional key {key!r} has type "
+                f"{type(obj[key]).__name__}"
+            )
+    unknown = set(obj) - set(required) - set(optional)
+    if unknown:
+        problems.append(f"{where}: unknown keys {sorted(unknown)}")
+    return problems
+
+
+def validate_span(span: Dict[str, Any], where: str = "span") -> List[str]:
+    problems = _check_fields(span, _SPAN_REQUIRED, _SPAN_OPTIONAL, where)
+    if not problems and span["dur"] < 0:
+        problems.append(f"{where}: negative dur {span['dur']}")
+    return problems
+
+
+def validate_span_jsonl(lines: List[str], where: str = "jsonl") -> List[str]:
+    """Validate a span JSONL dump given as decoded lines."""
+    import json
+
+    problems = []
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError as e:
+            problems.append(f"{where}:{i + 1}: not JSON ({e})")
+            continue
+        problems.extend(validate_span(obj, f"{where}:{i + 1}"))
+    return problems
+
+
+def validate_chrome_trace(doc: Any, where: str = "trace") -> List[str]:
+    """Validate a Chrome ``trace_event`` document (our exporter's
+    ``{"traceEvents": [...]}`` form; ``ph=="X"`` events only — other
+    phases pass through, Perfetto tolerates them)."""
+    problems: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"{where}: expected a trace document object"]
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return [f"{where}: missing/invalid traceEvents list"]
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            problems.append(f"{where}[{i}]: event is not an object")
+            continue
+        if ev.get("ph") != "X":
+            continue
+        for key, types in _CHROME_X_REQUIRED.items():
+            if key not in ev:
+                problems.append(f"{where}[{i}]: missing {key!r}")
+            elif (not isinstance(ev[key], types)
+                  or isinstance(ev[key], bool)):
+                problems.append(
+                    f"{where}[{i}]: {key!r} has type "
+                    f"{type(ev[key]).__name__}"
+                )
+        if isinstance(ev.get("dur"), (int, float)) and ev["dur"] < 0:
+            problems.append(f"{where}[{i}]: negative dur")
+    return problems
+
+
+# The bench telemetry block contract: BENCH_*.json rounds become
+# machine-comparable only if every round spells these the same way.
+_BENCH_REQUIRED = {
+    "tier": str,
+}
+_BENCH_OPTIONAL = {
+    "overhead_pct": (int, float, type(None)),
+    "report": dict,
+    "headline": dict,
+    "probe": dict,
+}
+
+
+def validate_bench_telemetry(block: Any,
+                             where: str = "telemetry") -> List[str]:
+    """Validate the ``telemetry`` block of a ``BENCH_*.json`` artifact
+    (absence of the block entirely is the caller's call — pre-telemetry
+    rounds legitimately lack it)."""
+    return _check_fields(block, _BENCH_REQUIRED, _BENCH_OPTIONAL, where)

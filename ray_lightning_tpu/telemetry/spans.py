@@ -1,0 +1,214 @@
+"""Low-overhead span tracer: monotonic-clock phase timing per rank.
+
+The tracing half of the telemetry subsystem (SURVEY §5: the reference
+ships zero observability).  A :class:`SpanTracer` records named phases —
+``compile``, ``data_wait``, ``dispatch``, ``validation``,
+``checkpoint_write``, ``grad_sync``, ``host_transfer`` — into a bounded
+ring buffer, one tracer per rank.  Two export formats:
+
+* **JSONL** — one span object per line (the machine-diffable form the
+  schema checker validates, ``tools/check_telemetry_schema.py``);
+* **Chrome ``trace_event``** — a ``{"traceEvents": [...]}`` document of
+  ``ph == "X"`` complete events, loadable in Perfetto / ``chrome://tracing``
+  next to the ``jax.profiler`` traces ``ProfilerCallback`` captures.
+
+Overhead discipline: the tracer is OFF at the default cheap telemetry
+tier.  A disabled tracer's ``span()`` returns one preallocated no-op
+context manager (no generator, no allocation), so leaving instrumentation
+in the hot loop costs a single attribute check per call.  This module is
+deliberately jax-free — the schema checker imports it from ``format.sh``
+and must not pay (or require) a jax import.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, NamedTuple, Optional
+
+__all__ = ["PHASES", "Span", "SpanTracer"]
+
+#: Canonical phase names the loop instruments.  Free-form names are also
+#: accepted — these exist so dashboards and tests agree on spelling.
+PHASES = (
+    "compile",
+    "data_wait",
+    "dispatch",
+    "validation",
+    "checkpoint_write",
+    "grad_sync",
+    "host_transfer",
+)
+
+
+class Span(NamedTuple):
+    name: str
+    ts: float        # perf_counter seconds at open
+    dur: float       # seconds
+    rank: int
+    tid: int         # python thread id (checkpoint writer ≠ loop thread)
+    depth: int       # nesting depth within its thread (0 = top level)
+    args: Optional[Dict[str, Any]] = None
+
+
+class _NullCtx:
+    """Shared no-op context manager for the disabled tracer."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_CTX = _NullCtx()
+
+
+class _SpanCtx:
+    """One live span: records on exit, tracks per-thread nesting depth."""
+
+    __slots__ = ("_tracer", "_name", "_args", "_t0", "_depth")
+
+    def __init__(self, tracer: "SpanTracer", name: str, args):
+        self._tracer = tracer
+        self._name = name
+        self._args = args
+
+    def __enter__(self):
+        stack = self._tracer._stack()
+        self._depth = len(stack)
+        stack.append(self._name)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        self._tracer._stack().pop()
+        self._tracer.record(
+            self._name, self._t0, t1 - self._t0,
+            depth=self._depth, args=self._args,
+        )
+        return False
+
+
+class SpanTracer:
+    """Bounded ring buffer of :class:`Span` records for one rank.
+
+    ``maxlen`` bounds memory (a week-long fit cannot OOM the host on
+    telemetry); the *newest* spans win, and ``dropped`` counts evictions
+    so exports are honest about truncation.
+    """
+
+    def __init__(self, enabled: bool = False, maxlen: int = 4096,
+                 rank: int = 0):
+        if maxlen < 1:
+            raise ValueError("maxlen must be >= 1")
+        self.enabled = enabled
+        self.rank = rank
+        self.maxlen = maxlen
+        self._buf: collections.deque = collections.deque(maxlen=maxlen)
+        self._recorded = 0
+        self._local = threading.local()
+
+    def _stack(self) -> List[str]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    # -- recording ----------------------------------------------------------
+    def span(self, name: str, **args):
+        """Context manager timing one phase.  No-op when disabled."""
+        if not self.enabled:
+            return _NULL_CTX
+        return _SpanCtx(self, name, args or None)
+
+    def record(self, name: str, ts: float, dur: float, depth: int = 0,
+               args: Optional[Dict[str, Any]] = None) -> None:
+        """Append an already-measured span (the loop measures data-wait
+        and dispatch anyway for step stats; re-timing them would skew)."""
+        if not self.enabled:
+            return
+        self._buf.append(
+            Span(name, ts, dur, self.rank,
+                 threading.get_ident() & 0x7FFFFFFF, depth, args)
+        )
+        self._recorded += 1
+
+    def instant(self, name: str, **args) -> None:
+        """Zero-duration metadata marker (e.g. the grad-sync plan)."""
+        self.record(name, time.perf_counter(), 0.0, args=args or None)
+
+    # -- introspection ------------------------------------------------------
+    def events(self) -> List[Span]:
+        return list(self._buf)
+
+    @property
+    def dropped(self) -> int:
+        return self._recorded - len(self._buf)
+
+    def clear(self) -> None:
+        self._buf.clear()
+        self._recorded = 0
+
+    # -- export -------------------------------------------------------------
+    def _span_dict(self, s: Span) -> Dict[str, Any]:
+        d = {
+            "name": s.name,
+            "ts": s.ts,
+            "dur": s.dur,
+            "rank": s.rank,
+            "tid": s.tid,
+            "depth": s.depth,
+        }
+        if s.args:
+            d["args"] = s.args
+        return d
+
+    def export_jsonl(self, path: str) -> int:
+        """One span per line; returns the number of spans written."""
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        spans = self.events()
+        with open(path, "w") as f:
+            for s in spans:
+                f.write(json.dumps(self._span_dict(s)) + "\n")
+        return len(spans)
+
+    def chrome_trace(self) -> Dict[str, Any]:
+        """Chrome ``trace_event`` document (``ph=="X"`` complete events,
+        microsecond timestamps, pid = rank so a fleet's traces merge into
+        one per-rank-lane Perfetto view)."""
+        events = []
+        for s in self.events():
+            ev = {
+                "ph": "X",
+                "name": s.name,
+                "ts": s.ts * 1e6,
+                "dur": s.dur * 1e6,
+                "pid": s.rank,
+                "tid": s.tid,
+            }
+            if s.args:
+                ev["args"] = s.args
+            events.append(ev)
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "producer": "ray_lightning_tpu.telemetry",
+                "rank": self.rank,
+                "dropped_spans": self.dropped,
+            },
+        }
+
+    def export_chrome(self, path: str) -> int:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        doc = self.chrome_trace()
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return len(doc["traceEvents"])

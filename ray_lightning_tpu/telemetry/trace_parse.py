@@ -1,0 +1,112 @@
+"""Chrome-trace parsing: shared by the profile harness and trace tools.
+
+Factored out of ``tools/profile_step.py`` so ANY run's exported Chrome
+trace — a ``jax.profiler`` capture (``*.trace.json.gz``, written by
+``ProfilerCallback`` or the profile harness) or this framework's own
+span export (``telemetry/spans.py``) — parses through one code path:
+
+* :func:`load_trace_events` — events from a ``.json`` / ``.json.gz``
+  trace file (``{"traceEvents": [...]}`` documents or bare lists);
+* :func:`collect` — aggregate ``ph == "X"`` self-durations by op name
+  from the newest trace under a directory (the profiler layout);
+* :func:`op_bucket` / :func:`bucket_totals` — the coarse phase buckets
+  (matmul / attention / CE / layout / elementwise) the perf notes use.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+from typing import Dict, List
+
+__all__ = [
+    "load_trace_events",
+    "collect",
+    "collect_file",
+    "op_bucket",
+    "bucket_totals",
+    "top_ops",
+]
+
+
+def op_bucket(name: str) -> str:
+    """Coarse cost bucket for one XLA/span event name."""
+    n = name.lower()
+    if "flash" in n or "attention" in n:
+        return "attention-kernel"
+    if "ce_fwd" in n or "ce_bwd" in n or "cross_entropy" in n:
+        return "ce-kernel"
+    if "dot" in n or "conv" in n or "einsum" in n:
+        return "matmul"
+    if "dynamic-update-slice" in n or "dynamic_update" in n:
+        return "residual-save"
+    if "copy" in n or "transpose" in n or "bitcast" in n:
+        return "layout"
+    if "reduce" in n or "add" in n or "multiply" in n or "fused" in n:
+        return "elementwise/fused"
+    return "other"
+
+
+def load_trace_events(path: str) -> List[dict]:
+    """Events from one Chrome-trace file (gzip or plain; document or
+    bare-list form)."""
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        return doc.get("traceEvents", [])
+    if isinstance(doc, list):
+        return doc
+    raise ValueError(f"{path}: not a Chrome trace (got {type(doc).__name__})")
+
+
+def _host_side_noise(name: str) -> bool:
+    """Host-side python/runtime events that dominate CPU traces and
+    double-count wall time; keep device-lane XLA ops only."""
+    return (".py" in name or name.startswith("$")
+            or "ThunkExecutor" in name or "np.asarray" in name)
+
+
+def collect_file(path: str, keep_host: bool = False) -> Dict[str, float]:
+    """Aggregate ``ph=='X'`` durations (µs) by event name from one file."""
+    durs: Dict[str, float] = collections.defaultdict(float)
+    for e in load_trace_events(path):
+        if e.get("ph") != "X" or "dur" not in e:
+            continue
+        name = e.get("name", "?")
+        if not keep_host and _host_side_noise(name):
+            continue
+        durs[name] += e["dur"]
+    return dict(durs)
+
+
+def collect(trace_dir: str, keep_host: bool = False) -> Dict[str, float]:
+    """Aggregate durations from the NEWEST trace under ``trace_dir``
+    (the ``jax.profiler`` directory layout; also finds this framework's
+    ``trace-rank*.json`` span exports)."""
+    patterns = ("**/*.trace.json.gz", "**/*.trace.json",
+                "trace-rank*.json")
+    paths: List[str] = []
+    for pat in patterns:
+        paths.extend(
+            glob.glob(os.path.join(trace_dir, pat), recursive=True)
+        )
+    if not paths:
+        raise FileNotFoundError(f"no Chrome trace under {trace_dir}")
+    newest = max(paths, key=os.path.getmtime)
+    return collect_file(newest, keep_host=keep_host)
+
+
+def bucket_totals(durs: Dict[str, float]) -> Dict[str, float]:
+    buckets: Dict[str, float] = collections.defaultdict(float)
+    for name, d in durs.items():
+        buckets[op_bucket(name)] += d
+    return dict(buckets)
+
+
+def top_ops(durs: Dict[str, float], n: int = 25):
+    """``[(name, total_dur_us)]``, costliest first."""
+    return sorted(durs.items(), key=lambda kv: -kv[1])[:n]

@@ -1,0 +1,114 @@
+"""Schema gate for telemetry artifacts (wired into ``format.sh``).
+
+Two passes, both fast and dependency-free beyond the package itself:
+
+1. **self-test** — build a real ``SpanTracer``, record nested spans,
+   export JSONL + Chrome trace to a temp dir, and validate both through
+   ``telemetry/schema.py``.  If a producer and the written-down schema
+   drift apart, this fails before any artifact ships;
+2. **artifact scan** — validate the ``telemetry`` block of every
+   ``BENCH_*.json`` in the repo root (absent blocks are fine —
+   pre-telemetry rounds legitimately lack them) and any span/trace
+   exports passed as arguments.
+
+Exit code 0 = all schemas hold.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_lightning_tpu.telemetry.schema import (  # noqa: E402
+    validate_bench_telemetry,
+    validate_chrome_trace,
+    validate_span_jsonl,
+)
+from ray_lightning_tpu.telemetry.spans import SpanTracer  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def self_test() -> list:
+    """Exporters must produce what the schema promises."""
+    tracer = SpanTracer(enabled=True, maxlen=64, rank=0)
+    with tracer.span("outer", tag="self-test"):
+        with tracer.span("inner"):
+            pass
+    tracer.instant("marker", detail=1)
+    problems = []
+    with tempfile.TemporaryDirectory(prefix="rlt_schema_") as tmp:
+        jsonl = os.path.join(tmp, "spans.jsonl")
+        chrome = os.path.join(tmp, "trace.json")
+        tracer.export_jsonl(jsonl)
+        tracer.export_chrome(chrome)
+        with open(jsonl) as f:
+            problems += validate_span_jsonl(f.readlines(), "self-test jsonl")
+        with open(chrome) as f:
+            problems += validate_chrome_trace(
+                json.load(f), "self-test chrome"
+            )
+    return problems
+
+
+def scan_bench_files() -> list:
+    problems = []
+    for path in sorted(glob.glob(os.path.join(REPO_ROOT, "BENCH_*.json"))):
+        name = os.path.basename(path)
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except ValueError as e:
+            problems.append(f"{name}: not JSON ({e})")
+            continue
+        block = doc.get("telemetry")
+        if block is None:
+            continue  # pre-telemetry round
+        problems += validate_bench_telemetry(block, f"{name}:telemetry")
+    return problems
+
+
+def scan_paths(paths) -> list:
+    problems = []
+    for path in paths:
+        name = os.path.basename(path)
+        try:
+            if path.endswith(".jsonl"):
+                with open(path) as f:
+                    problems += validate_span_jsonl(f.readlines(), name)
+            else:
+                with open(path) as f:
+                    problems += validate_chrome_trace(json.load(f), name)
+        except (OSError, ValueError) as e:
+            problems.append(f"{name}: unreadable ({e})")
+    return problems
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Validate telemetry artifact schemas "
+        "(span JSONL, Chrome traces, BENCH_*.json telemetry blocks)."
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="extra span .jsonl / chrome .json files to check")
+    args = ap.parse_args(argv)
+
+    problems = self_test() + scan_bench_files() + scan_paths(args.paths)
+    if problems:
+        for p in problems:
+            print(f"check_telemetry_schema: {p}", file=sys.stderr)
+        print(f"check_telemetry_schema: FAILED ({len(problems)} problem(s))",
+              file=sys.stderr)
+        return 1
+    print("check_telemetry_schema: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

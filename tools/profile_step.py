@@ -16,9 +16,6 @@ from __future__ import annotations
 
 import argparse
 import collections
-import glob
-import gzip
-import json
 import os
 import sys
 import tempfile
@@ -30,47 +27,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-
-def _bucket(name: str) -> str:
-    n = name.lower()
-    if "flash" in n or "attention" in n:
-        return "attention-kernel"
-    if "ce_fwd" in n or "ce_bwd" in n or "cross_entropy" in n:
-        return "ce-kernel"
-    if "dot" in n or "conv" in n or "einsum" in n:
-        return "matmul"
-    if "dynamic-update-slice" in n or "dynamic_update" in n:
-        return "residual-save"
-    if "copy" in n or "transpose" in n or "bitcast" in n:
-        return "layout"
-    if "reduce" in n or "add" in n or "multiply" in n or "fused" in n:
-        return "elementwise/fused"
-    return "other"
-
-
-def collect(trace_dir: str):
-    """Aggregate ph=='X' event durations by name from the newest trace."""
-    paths = sorted(
-        glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"),
-                  recursive=True),
-        key=os.path.getmtime,
-    )
-    if not paths:
-        raise FileNotFoundError(f"no trace.json.gz under {trace_dir}")
-    with gzip.open(paths[-1], "rt") as f:
-        events = json.load(f).get("traceEvents", [])
-    durs: dict = collections.defaultdict(float)
-    for e in events:
-        if e.get("ph") != "X" or "dur" not in e:
-            continue
-        name = e.get("name", "?")
-        # Keep device-lane XLA ops; drop host-side python/runtime events
-        # (they dominate CPU traces and double-count wall time).
-        if (".py" in name or name.startswith("$")
-                or "ThunkExecutor" in name or "np.asarray" in name):
-            continue
-        durs[name] += e["dur"]
-    return durs
+# Trace parsing lives in the telemetry subsystem now (shared with
+# tools/trace_summary.py); these aliases keep the harness's historical
+# local names working.
+from ray_lightning_tpu.telemetry.trace_parse import (  # noqa: E402
+    collect,
+    op_bucket as _bucket,
+)
 
 
 def main() -> None:

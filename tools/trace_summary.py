@@ -1,0 +1,81 @@
+"""Summarize ANY run's exported Chrome trace: top ops + phase totals.
+
+Usage::
+
+    python tools/trace_summary.py <trace-dir-or-file> [--top 25]
+        [--keep-host] [--per-step N]
+
+Accepts what the framework's exporters actually produce:
+
+* a ``jax.profiler`` capture directory (``ProfilerCallback`` /
+  ``tools/profile_step.py`` — newest ``*.trace.json.gz`` wins);
+* a single Chrome-trace file, gzipped or plain — including the telemetry
+  span export (``telemetry/trace-rank0.json``).
+
+Where ``profile_step.py`` is the bespoke profile *harness* (it runs the
+model, then summarizes), this tool is the summarize-only half for traces
+somebody else already recorded — a production fit, a ProfilerCallback
+window, a collected artifact from another host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_lightning_tpu.telemetry.trace_parse import (  # noqa: E402
+    bucket_totals,
+    collect,
+    collect_file,
+    top_ops,
+)
+
+
+def summarize(durs: dict, top: int = 25, per_step: int = 1) -> str:
+    total = sum(durs.values())
+    if not total:
+        return "(trace holds no ph=='X' duration events)"
+    lines = ["== buckets (% of op time) =="]
+    for b, d in sorted(bucket_totals(durs).items(), key=lambda kv: -kv[1]):
+        lines.append(
+            f"{100 * d / total:6.2f}%  {d / 1e3 / per_step:10.3f} "
+            f"ms/step  {b}"
+        )
+    lines.append(f"== top {top} ops ==")
+    for name, d in top_ops(durs, top):
+        lines.append(
+            f"{100 * d / total:6.2f}%  {d / 1e3 / per_step:10.3f} "
+            f"ms/step  {name[:88]}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Summarize a Chrome trace (jax.profiler capture dir "
+        "or a single trace file, incl. telemetry span exports)."
+    )
+    ap.add_argument("path", help="trace directory or .json/.json.gz file")
+    ap.add_argument("--top", type=int, default=25)
+    ap.add_argument("--per-step", type=int, default=1,
+                    help="steps captured in the trace (normalizes ms/step)")
+    ap.add_argument("--keep-host", action="store_true",
+                    help="keep host-side python/runtime events too")
+    args = ap.parse_args(argv)
+
+    if os.path.isdir(args.path):
+        durs = collect(args.path, keep_host=args.keep_host)
+    else:
+        durs = collect_file(args.path, keep_host=args.keep_host)
+    if not durs:
+        print("no events matched (try --keep-host for host-only traces)")
+        return 1
+    print(summarize(durs, top=args.top, per_step=max(args.per_step, 1)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
