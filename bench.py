@@ -158,12 +158,13 @@ def _bench_fit(module: GPT, cfg: GPTConfig, batch_size: int):
         for dt in times[:WINDOWS]
     ]
     med, spread = _median_spread(tps)
-    return med, spread, trainer.telemetry_report
+    monitor_events = len(trainer.monitor_report.get("events", []))
+    return med, spread, trainer.telemetry_report, monitor_events
 
 
-def _bench_boring_fit(tier: str, steps: int = 80) -> float:
+def _bench_boring_fit(tier, steps: int = 80) -> float:
     """Steady-state seconds/step of a boring-model fit at one telemetry
-    tier (the overhead probe's measurement arm)."""
+    config — tier string or full dict (the overhead probes' arm)."""
     from ray_lightning_tpu.models.boring import (
         BoringDataModule,
         BoringModel,
@@ -197,6 +198,28 @@ def _telemetry_overhead_pct() -> float:
     off = _bench_boring_fit("off")
     cheap = _bench_boring_fit("cheap")
     return 100.0 * (cheap - off) / off if off else 0.0
+
+
+def _heartbeat_overhead_pct(repeats: int = 3) -> float:
+    """Measured per-step cost of the live heartbeat publisher
+    (telemetry/heartbeat.py) vs the same cheap-tier fit with the
+    publisher disabled.  Probed at 10x the default cadence (0.5s vs
+    5s) so short bench fits see many beats — an upper bound on the
+    production cost, recorded so BENCH_r06+ tracks it.
+
+    Best-of-N per arm: single boring-model fits jitter far more than
+    the publisher costs (observed ±40% run-to-run on the CPU mesh),
+    and min-of-runs is the standard noise-robust floor estimator.
+    """
+    silent = min(
+        _bench_boring_fit({"tier": "cheap", "heartbeat_s": 0})
+        for _ in range(repeats)
+    )
+    beating = min(
+        _bench_boring_fit({"tier": "cheap", "heartbeat_s": 0.5})
+        for _ in range(repeats)
+    )
+    return 100.0 * (beating - silent) / silent if silent else 0.0
 
 
 def _bench_generate(module: GPT, cfg: GPTConfig, on_tpu: bool):
@@ -321,7 +344,7 @@ def main() -> None:
 
     kernel_path = _kernel_paths(cfg, on_tpu)
     raw_tps, raw_spread = _bench_raw_step(make_module(), cfg, batch_size)
-    fit_tps, fit_spread, tel_report = _bench_fit(
+    fit_tps, fit_spread, tel_report, monitor_events = _bench_fit(
         make_module(), cfg, batch_size
     )
     gen_tps, gen_tps_int8 = _bench_generate(make_module(), cfg, on_tpu)
@@ -330,6 +353,11 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - probe must not cost the line
         sys.stderr.write(f"telemetry overhead probe skipped: {e}\n")
         overhead_pct = None
+    try:
+        hb_overhead_pct = round(_heartbeat_overhead_pct(), 3)
+    except Exception as e:  # noqa: BLE001 - same discipline
+        sys.stderr.write(f"heartbeat overhead probe skipped: {e}\n")
+        hb_overhead_pct = None
 
     peak = peak_flops_per_chip() if on_tpu else None
 
@@ -366,6 +394,15 @@ def main() -> None:
             # that never ran would poison round comparisons).
             "tier": tel_report.get("tier") or "off",
             "overhead_pct": overhead_pct,
+            # Live-plane cost + activity (docs/OBSERVABILITY.md "Live
+            # monitoring"): publisher overhead measured at 10x the
+            # default cadence, and the headline fit's monitor event
+            # count.  NOTE: the headline fit runs LocalStrategy, whose
+            # inline path has no RunMonitor — this stays 0 until the
+            # bench fit moves to a remote strategy; it is recorded so
+            # the schema (and any future remote bench) carries it.
+            "heartbeat_overhead_pct": hb_overhead_pct,
+            "monitor_events": monitor_events,
             "report": {
                 "step_stats": tel_report.get("step_stats", {}),
                 "counters": tel_report.get("counters", {}),

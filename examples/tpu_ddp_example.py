@@ -29,6 +29,7 @@ def train_mnist(
     use_tune: bool = False,
     grad_comm: str = "full",
     telemetry: str = "cheap",
+    heartbeat_s: float = 5.0,
 ):
     """≙ reference ``train_mnist`` (``ray_ddp_example.py:18-52``)."""
     callbacks = (
@@ -46,8 +47,13 @@ def train_mnist(
         # throughput into callback_metrics for free; "full" additionally
         # exports span traces (Perfetto-loadable) under
         # rlt_logs/mnist_ddp/telemetry — see docs/OBSERVABILITY.md.
+        # heartbeat_s sets the live-monitor cadence (--heartbeat; watch
+        # the run with `python tools/rlt_top.py rlt_logs/mnist_ddp/
+        # telemetry`); 0 disables the publisher.
         strategy=RayStrategy(num_workers=num_workers, grad_comm=grad_comm,
-                             telemetry=telemetry),
+                             telemetry={"tier": telemetry,
+                                        "heartbeat_s": heartbeat_s}
+                             if telemetry != "off" else "off"),
         max_epochs=num_epochs,
         callbacks=callbacks,
         log_every_n_steps=10,
@@ -103,6 +109,9 @@ if __name__ == "__main__":
                         choices=["full", "int8", "int8_ef"])
     parser.add_argument("--telemetry", default="cheap",
                         choices=["off", "cheap", "full"])
+    parser.add_argument("--heartbeat", type=float, default=5.0,
+                        help="live-monitor heartbeat cadence in seconds "
+                        "(0 disables; see docs/OBSERVABILITY.md)")
     args = parser.parse_args()
 
     epochs = 1 if args.smoke_test else args.num_epochs
@@ -113,7 +122,7 @@ if __name__ == "__main__":
         trainer = train_mnist(
             {}, num_workers=args.num_workers, num_epochs=epochs,
             batch_size=args.batch_size, grad_comm=args.grad_comm,
-            telemetry=args.telemetry,
+            telemetry=args.telemetry, heartbeat_s=args.heartbeat,
         )
         print("final metrics:", {
             k: round(v, 4) for k, v in trainer.callback_metrics.items()

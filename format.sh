@@ -100,8 +100,10 @@ else
 fi
 
 # -- layer 4: telemetry artifact schemas (zero extra deps) -------------------
-# Gates producer/schema drift: exporter self-test + BENCH_*.json telemetry
-# blocks (tools/check_telemetry_schema.py).
+# Gates producer/schema drift: exporter self-test (spans, Chrome traces,
+# heartbeat/event/log stream items, crash flight bundles), the committed
+# flight-bundle fixture (tests/data/flight_bundle.json), and BENCH_*.json
+# telemetry blocks (tools/check_telemetry_schema.py).
 python tools/check_telemetry_schema.py || fail=1
 
 if [ $fail -ne 0 ]; then

@@ -65,7 +65,43 @@ class ActorDiedError(RuntimeError):
     The reference surfaces worker death as a raised Ray error from
     ``ray.get`` inside ``process_results`` (``util.py:55-68``); we do the
     same — failures propagate fast and crash the fit.
+
+    Structured context rides as attributes so death reports can say
+    *when* and *how* the rank died, not just that it did: ``exit_code``
+    (agent/subprocess ``poll()``), ``rank``, ``last_heartbeat_age_s``
+    (from the RunMonitor), ``actor_name``.  Raise sites fill what they
+    know; the strategy layer adds the rest via :meth:`enrich`.
     """
+
+    def __init__(self, message: str, *, actor_name=None, exit_code=None,
+                 rank=None, last_heartbeat_age_s=None):
+        super().__init__(message)
+        self.actor_name = actor_name
+        self.exit_code = exit_code
+        self.rank = rank
+        self.last_heartbeat_age_s = last_heartbeat_age_s
+
+    def enrich(self, **fields) -> "ActorDiedError":
+        """Fill unset context fields and fold them into the message
+        (in place — the exception identity/traceback is preserved)."""
+        notes = []
+        for key in ("actor_name", "exit_code", "rank",
+                    "last_heartbeat_age_s"):
+            if key in fields and getattr(self, key) is None:
+                setattr(self, key, fields[key])
+        if self.rank is not None:
+            notes.append(f"rank={self.rank}")
+        if self.exit_code is not None:
+            notes.append(f"exit_code={self.exit_code}")
+        if self.last_heartbeat_age_s is not None:
+            notes.append(
+                f"last_heartbeat={self.last_heartbeat_age_s}s ago"
+            )
+        extra = fields.get("note")
+        if notes or extra:
+            detail = "; ".join(notes + ([extra] if extra else []))
+            self.args = (f"{self.args[0]} [{detail}]",) + self.args[1:]
+        return self
 
 
 def _apply_env(env: Dict[str, str]) -> None:
@@ -118,8 +154,49 @@ def _remote_get_device_info() -> Dict[str, Any]:
 # Child-side main loop
 # ---------------------------------------------------------------------------
 
+def _remote_dump_stacks() -> Dict[str, Any]:
+    """Out-of-band forensics: py-stacks of every live thread
+    (``sys._current_frames``) + best-effort device memory.
+
+    Served on the child's **control lane**, so it answers even while a
+    ``call`` (the fit) is wedged inside a collective — the whole point:
+    the RunMonitor asks a *hung* worker what it is stuck on.
+    """
+    from ray_lightning_tpu.telemetry.flight_recorder import (
+        format_all_stacks,
+    )
+    from ray_lightning_tpu.telemetry.heartbeat import device_memory_stats
+
+    out: Dict[str, Any] = {
+        "pid": os.getpid(),
+        "ts": time.time(),
+        "stacks": format_all_stacks(),
+    }
+    mem = device_memory_stats()
+    if mem:
+        out["device_memory"] = mem
+    return out
+
+
+_CONTROL_HANDLERS: Dict[str, Callable[..., Any]] = {
+    "dump_stacks": _remote_dump_stacks,
+    "ping": lambda: {"pid": os.getpid(), "ts": time.time()},
+}
+
+
 def _child_main() -> None:
-    """Entry point of the actor subprocess (``python -m ...cluster.actor``)."""
+    """Entry point of the actor subprocess (``python -m ...cluster.actor``).
+
+    Two lanes over one connection:
+
+    * ``call`` — user functions, executed **sequentially** on a single
+      worker thread (the pre-control-lane ordering contract: a queued
+      call never overlaps the one before it);
+    * ``ctl`` — small, jax-light control requests (stack dumps, pings)
+      handled inline on the receive thread, so they answer even while
+      a call is stuck in a collective.  This is what makes driver-side
+      hang diagnosis possible at all.
+    """
     host = sys.argv[1]
     port = int(sys.argv[2])
     authkey = bytes.fromhex(sys.stdin.readline().strip())
@@ -133,16 +210,15 @@ def _child_main() -> None:
         with send_lock:
             rpc.send_frame(sock, rpc.dumps(obj))
 
-    while True:
-        try:
-            msg = rpc.loads(rpc.recv_frame(sock))
-        except (ConnectionError, OSError):
-            break
-        kind = msg[0]
-        if kind == "exit":
-            reply(("bye", None, None))
-            break
-        if kind == "call":
+    import queue as _pyqueue
+
+    calls: "_pyqueue.Queue" = _pyqueue.Queue()
+
+    def call_worker() -> None:
+        while True:
+            msg = calls.get()
+            if msg is None:
+                return
             _, call_id, payload = msg
             try:
                 fn, args, kwargs = payload
@@ -153,7 +229,7 @@ def _child_main() -> None:
             try:
                 reply(out)
             except (ConnectionError, OSError):
-                break
+                return
             except BaseException:
                 # Result not serializable — report that instead of dying.
                 reply(
@@ -161,7 +237,39 @@ def _child_main() -> None:
                      "actor result failed to serialize:\n"
                      + traceback.format_exc())
                 )
+
+    worker = threading.Thread(
+        target=call_worker, name="rlt-actor-calls", daemon=True
+    )
+    worker.start()
+
+    while True:
+        try:
+            msg = rpc.loads(rpc.recv_frame(sock))
+        except (ConnectionError, OSError):
+            break
+        kind = msg[0]
+        if kind == "exit":
+            reply(("bye", None, None))
+            break
+        if kind == "call":
+            calls.put(msg)
+        elif kind == "ctl":
+            _, call_id, (op, kw) = msg
+            handler = _CONTROL_HANDLERS.get(op)
+            try:
+                if handler is None:
+                    raise ValueError(f"unknown control op {op!r}")
+                out = ("ok", call_id, handler(**kw))
+            except BaseException:  # noqa: BLE001
+                out = ("err", call_id, traceback.format_exc())
+            try:
+                reply(out)
+            except (ConnectionError, OSError):
+                break
     sock.close()
+    # The call worker is a daemon: a kill()-initiated exit must not wait
+    # for a wedged fit call (≙ ray.kill's no-grace semantics).
     sys.exit(0)
 
 
@@ -263,14 +371,17 @@ class ProcessActor:
                 server.close()
                 raise ActorDiedError(
                     f"Actor {self.name!r} exited during startup "
-                    f"(exit code {self._proc.returncode})."
+                    f"(exit code {self._proc.returncode}).",
+                    actor_name=self.name,
+                    exit_code=self._proc.returncode,
                 )
             if time.monotonic() > deadline:
                 server.close()
                 self._proc.terminate()
                 raise ActorDiedError(
                     f"Actor {self.name!r} did not connect within "
-                    f"{startup_timeout_s}s."
+                    f"{startup_timeout_s}s.",
+                    actor_name=self.name,
                 )
             try:
                 conn, _ = server.accept()
@@ -282,7 +393,10 @@ class ProcessActor:
         if rpc.recv_frame(conn) != authkey:
             conn.close()
             self._proc.terminate()
-            raise ActorDiedError(f"Actor {self.name!r} failed authentication.")
+            raise ActorDiedError(
+                f"Actor {self.name!r} failed authentication.",
+                actor_name=self.name,
+            )
         self._conn = conn
 
         self._send_lock = threading.Lock()
@@ -321,25 +435,26 @@ class ProcessActor:
         with self._lock:
             self._conn_dead = True
             pending, self._pending = self._pending, {}
+        exit_code = self._proc.poll()
         for fut in pending.values():
             if not fut.done():
                 fut.set_exception(
                     ActorDiedError(
                         f"Actor {self.name!r} died before answering "
-                        f"(exit code {self._proc.poll()})."
+                        f"(exit code {exit_code}).",
+                        actor_name=self.name, exit_code=exit_code,
                     )
                 )
 
     # -- submit path --------------------------------------------------------
-    def submit(self, fn: Callable, *args: Any, **kwargs: Any) -> Future:
-        """Asynchronously run ``fn(*args, **kwargs)`` in the actor.
-
-        ≙ ``RayExecutor.execute.remote`` (reference ``ray_ddp.py:60-62``,
-        submission at ``ray_ddp.py:349-353``).  Returns a standard
-        ``concurrent.futures.Future``.
-        """
+    def _submit_msg(self, lane: str, payload: Any, what: str) -> Future:
+        """Ship one (call_id-tagged) frame; return its pending Future.
+        Shared by the call lane and the control lane."""
         if self._closed or self._conn_dead or self._proc.poll() is not None:
-            raise ActorDiedError(f"Actor {self.name!r} is not alive.")
+            raise ActorDiedError(
+                f"Actor {self.name!r} is not alive.",
+                actor_name=self.name, exit_code=self._proc.poll(),
+            )
         fut: Future = Future()
         call_id = next(self._call_ids)
         with self._lock:
@@ -347,12 +462,15 @@ class ProcessActor:
         try:
             with self._send_lock:
                 rpc.send_frame(
-                    self._conn, rpc.dumps(("call", call_id, (fn, args, kwargs)))
+                    self._conn, rpc.dumps((lane, call_id, payload))
                 )
         except (OSError, ValueError) as e:
             with self._lock:
                 self._pending.pop(call_id, None)
-            raise ActorDiedError(f"Failed to submit to actor {self.name!r}: {e}")
+            raise ActorDiedError(
+                f"Failed to submit {what} to actor {self.name!r}: {e}",
+                actor_name=self.name, exit_code=self._proc.poll(),
+            )
         # Close the race with _fail_all_pending(): if the connection died
         # between our aliveness check and the insert above, the swap may
         # have missed this future — TCP happily buffers bytes into a dying
@@ -361,13 +479,43 @@ class ProcessActor:
             if self._conn_dead and not fut.done():
                 self._pending.pop(call_id, None)
                 fut.set_exception(
-                    ActorDiedError(f"Actor {self.name!r} died during submit.")
+                    ActorDiedError(
+                        f"Actor {self.name!r} died during submit.",
+                        actor_name=self.name, exit_code=self._proc.poll(),
+                    )
                 )
         return fut
+
+    def submit(self, fn: Callable, *args: Any, **kwargs: Any) -> Future:
+        """Asynchronously run ``fn(*args, **kwargs)`` in the actor.
+
+        ≙ ``RayExecutor.execute.remote`` (reference ``ray_ddp.py:60-62``,
+        submission at ``ray_ddp.py:349-353``).  Returns a standard
+        ``concurrent.futures.Future``.
+        """
+        return self._submit_msg("call", (fn, args, kwargs), "call")
 
     def execute(self, fn: Callable, *args: Any, **kwargs: Any) -> Any:
         """Synchronous convenience wrapper around :meth:`submit`."""
         return self.submit(fn, *args, **kwargs).result()
+
+    # -- control lane -------------------------------------------------------
+    def control(self, op: str, timeout: Optional[float] = 10.0,
+                **kwargs: Any) -> Any:
+        """Out-of-band control request (``dump_stacks``, ``ping``).
+
+        Served by the child's receive thread, NOT the call worker — so
+        it answers even while a submitted call is hung.  That is the
+        mechanism behind the RunMonitor's stack dumps of stuck ranks.
+        """
+        return self._submit_msg("ctl", (op, kwargs), f"ctl:{op}").result(
+            timeout
+        )
+
+    def dump_stacks(self, timeout: Optional[float] = 10.0) -> Dict[str, Any]:
+        """Py-stacks of every thread in the actor + device memory
+        (``_remote_dump_stacks``) — works mid-call by design."""
+        return self.control("dump_stacks", timeout=timeout)
 
     # -- RayExecutor-parity conveniences ------------------------------------
     def set_env_vars(self, env: Dict[str, str]) -> None:

@@ -150,6 +150,14 @@ class LoopContext:
         # gradient accumulation).
         self.global_step = 0
         self.micro_step = 0
+        # Live-monitor progress signal (telemetry/heartbeat.py): a
+        # counter that advances on ANY forward motion — train
+        # micro-batches AND validation batches — plus a coarse phase
+        # tag.  The heartbeat publisher reads both from its own thread;
+        # the RunMonitor flags a rank whose progress freezes while its
+        # beats keep flowing (the wedged-collective signature).
+        self.progress = 0
+        self.phase = "init"
         self.should_stop = False
         self.callback_metrics: Dict[str, float] = {}
         self.logged_metrics: Dict[str, float] = {}
@@ -644,6 +652,7 @@ def _run_validation(
         acc.update(
             eval_step(ctx.state.params, _place_batch(batch, ctx.mesh))
         )
+        ctx.progress += 1  # liveness: eval batches count as forward motion
     return acc.result()
 
 
@@ -753,6 +762,26 @@ def run_fit(
     tel_stats = tel.step_stats
     if tel_stats is not None:
         tel_stats.configure_model(module)
+
+    # Live observability plane (docs/OBSERVABILITY.md "Live monitoring"):
+    # a heartbeat publisher thread (queue sink on workers, JSONL sink on
+    # queue-less local fits), a rank-tagged log ring, and the crash
+    # flight recorder — armed here, disarmed on the success path below;
+    # the stage wrappers route uncaught exceptions through
+    # ``flight_recorder.record_active_crash``.  Tier "off" installs
+    # nothing: no thread, no handler, no files.
+    from ray_lightning_tpu.telemetry.flight_recorder import FlightRecorder
+    from ray_lightning_tpu.telemetry.heartbeat import HeartbeatPublisher
+    from ray_lightning_tpu.telemetry.logs import RankLogHandler
+
+    log_handler = (
+        RankLogHandler(global_rank, queue=queue).install()
+        if tel.enabled else None
+    )
+    heartbeat = HeartbeatPublisher.maybe_start(tel, ctx, queue, config)
+    flight_recorder = FlightRecorder.maybe_install(
+        tel, ctx, queue, log_handler=log_handler, heartbeat=heartbeat,
+    )
 
     module.setup("fit")
     datamodule.set_shard(global_rank, world_size)
@@ -903,6 +932,7 @@ def run_fit(
             since_update = ctx.micro_step % accum
     for epoch in range(start_epoch, config.max_epochs):
         ctx.current_epoch = epoch
+        ctx.phase = "train"
         if hasattr(train_loader, "set_epoch"):
             train_loader.set_epoch(epoch)
         module.on_train_epoch_start(epoch)
@@ -970,6 +1000,7 @@ def run_fit(
                 jax.block_until_ready(logs)
             epoch_mean.update(logs)
             ctx.micro_step += 1
+            ctx.progress += 1  # heartbeat liveness counter
             since_update += 1
             if since_update == accum:
                 ctx.global_step += 1  # one optimizer step completed
@@ -1048,11 +1079,13 @@ def run_fit(
             eval_step is not None
             and (epoch + 1) % config.check_val_every_n_epoch == 0
         ):
+            ctx.phase = "validation"
             with tel.span("validation", epoch=epoch):
                 val_metrics = _run_validation(
                     module, eval_step, val_loader, ctx,
                     config.limit_val_batches,
                 )
+            ctx.phase = "train"
             ctx.log_metrics(val_metrics)
             module.on_validation_epoch_end(val_metrics)
             _call_hooks(callbacks, "on_validation_epoch_end", ctx, module)
@@ -1111,9 +1144,14 @@ def run_fit(
         # the driver trainer — extends the reference, which only streamed
         # via Tune callbacks).
         if queue is not None and ctx.is_global_zero:
+            # ``rank`` rides along so the driver can refuse metric
+            # updates from anything but rank 0 (Trainer._on_stream_item
+            # routes by type AND origin — a buggy/rogue worker must not
+            # clobber driver metrics).
             queue.put(
                 {
                     "type": "metrics",
+                    "rank": ctx.global_rank,
                     "epoch": epoch,
                     "metrics": dict(ctx.callback_metrics),
                 }
@@ -1122,6 +1160,10 @@ def run_fit(
         if stop or ctx.should_stop:
             break
 
+    # "closing": no step progress from here on is LEGITIMATE (flush,
+    # final gather, serialization) — the RunMonitor exempts this phase
+    # from stall flagging; the phase change itself counts as progress.
+    ctx.phase = "closing"
     # Every async checkpoint write must be durable (and any failure
     # raised) BEFORE on_fit_end consumers run — the standard
     # load-best-at-fit-end pattern reads best_model_path there.
@@ -1138,6 +1180,15 @@ def run_fit(
     # serializes and ships the bytes.
     gathered = ctx._gathered_state()
     _maybe_export_telemetry(tel, ctx.telemetry_dir)
+    # Retire the live plane on the success path: a final "done" beat so
+    # the monitor reads the coming silence as completion (not a hang),
+    # then disarm the crash recorder and the log ring.
+    if heartbeat is not None:
+        heartbeat.stop(final=True)
+    if flight_recorder is not None:
+        flight_recorder.uninstall()
+    if log_handler is not None:
+        log_handler.uninstall()
     # Snapshots ride EVERY rank's package (small dicts), so the driver
     # can aggregate min/max/mean across the fleet, not just rank 0.
     tel_snapshot = tel.snapshot()

@@ -151,11 +151,41 @@ class Trainer:
         # Fleet-wide telemetry (telemetry/aggregate.py): every worker's
         # snapshot merged into min/max/mean-across-ranks skew views.
         self.telemetry_report: Dict[str, Any] = {}
+        # Live-monitor record (telemetry/monitor.py): heartbeat-derived
+        # per-rank state, stall/straggler/crash events, flight-bundle
+        # paths.  Populated after every monitored fit — the live
+        # companion of ``telemetry_report``.
+        self.monitor_report: Dict[str, Any] = {}
+        self._monitor = None  # the RunMonitor of the fit in flight
         self._state_stream: Optional[bytes] = None
 
-    # -- live metric streaming (driver-side queue pump hook) ----------------
+    # -- live stream routing (driver-side queue pump hook) ------------------
+    def _attach_monitor(self, monitor) -> None:
+        """Called by the strategy when a monitored fit starts."""
+        self._monitor = monitor
+
+    def _adopt_monitor(self, monitor) -> None:
+        """Called by the strategy when the fit ends (either way)."""
+        self.monitor_report = monitor.report()
+        self._monitor = None
+
     def _on_stream_item(self, item: Any) -> None:
-        if isinstance(item, dict) and item.get("type") == "metrics":
+        """Route one worker→driver stream item by ``type``.
+
+        ``heartbeat``/``event``/``log`` feed the RunMonitor; ``metrics``
+        update ``callback_metrics`` — but ONLY from rank 0 (the same
+        rank whose result package wins at post-dispatch).  Before this
+        gate any worker could clobber driver metrics with a forged
+        ``{"type": "metrics"}`` dict.
+        """
+        if not isinstance(item, dict):
+            return
+        if self._monitor is not None:
+            self._monitor.on_item(item)
+        if (
+            item.get("type") == "metrics"
+            and int(item.get("rank", 0)) == 0
+        ):
             self.callback_metrics.update(item["metrics"])
 
     # -- stage entry points --------------------------------------------------

@@ -136,15 +136,28 @@ def _execute_remote(task_ref, global_rank: int, queue_handle) -> Dict[str, Any]:
             mesh=mesh,
         )
         if kind == "fit":
-            return run_fit(
-                callbacks=task["callbacks"],
-                mode=task["mode"],
-                zero_stage=task["zero_stage"],
-                grad_comm=task.get("grad_comm"),
-                telemetry=task.get("telemetry"),
-                queue=queue_handle,
-                **common,
-            )
+            try:
+                return run_fit(
+                    callbacks=task["callbacks"],
+                    mode=task["mode"],
+                    zero_stage=task["zero_stage"],
+                    grad_comm=task.get("grad_comm"),
+                    telemetry=task.get("telemetry"),
+                    queue=queue_handle,
+                    **common,
+                )
+            except BaseException as err:
+                # Crash forensics: persist the flight bundle (spans,
+                # step stats, logs, stacks — telemetry/flight_recorder)
+                # and announce its path on the queue BEFORE the
+                # exception travels back as a bare traceback.  No-op
+                # when telemetry is off or no recorder is armed.
+                from ray_lightning_tpu.telemetry.flight_recorder import (
+                    record_active_crash,
+                )
+
+                record_active_crash(err)
+                raise
         if kind in ("validation", "test"):
             return run_eval(
                 callbacks=task["callbacks"],
@@ -211,6 +224,7 @@ class TpuStrategy:
         restart_every_n_epochs: int = 1,
         grad_comm=None,
         telemetry=None,
+        monitor=None,
     ):
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
@@ -246,6 +260,17 @@ class TpuStrategy:
 
             telemetry = TelemetryConfig.coerce(telemetry)
         self.telemetry = telemetry
+        # Live-monitor knobs (dict or MonitorConfig; None = RLT_MONITOR_*
+        # env bus at fit time).  Validated eagerly like grad_comm, but
+        # the RAW value is kept: a dict without heartbeat_s must inherit
+        # the telemetry cadence at fit time — coercing it to a frozen
+        # MonitorConfig here would bake in the 5s default and make a
+        # fast-heartbeat run watchdog at the slow default budget.
+        if monitor is not None:
+            from ray_lightning_tpu.telemetry import MonitorConfig
+
+            MonitorConfig.coerce(monitor)
+        self.monitor = monitor
         self.env_per_worker = dict(env_per_worker or {})
         # Persistent XLA compilation cache (RLT_COMPILE_CACHE=dir): the
         # first GPT-2-scale compile costs 20-40s on this platform; a
@@ -275,7 +300,12 @@ class TpuStrategy:
                     # driver-side RLT_TELEMETRY must reach workers
                     # spawned through node agents too.
                     "RLT_TELEMETRY", "RLT_TELEMETRY_SAMPLE",
-                    "RLT_TELEMETRY_DIR", "RLT_TELEMETRY_PEAK"):
+                    "RLT_TELEMETRY_DIR", "RLT_TELEMETRY_PEAK",
+                    # Live-plane worker knobs: heartbeat cadence and the
+                    # flight-recorder/log-ring switches are read worker-
+                    # side at fit start.
+                    "RLT_HEARTBEAT_S", "RLT_FLIGHT_RECORDER",
+                    "RLT_LOG_RING"):
             val = os.environ.get(var)
             if val is not None:
                 self.env_per_worker.setdefault(var, val)
@@ -515,19 +545,130 @@ class TpuStrategy:
         # (≙ ray.put(model), ray_ddp.py:339-342).
         task_ref = self._backend.put(task)
         queue = self._backend.create_queue()
+        monitor = self._build_monitor(kind, config, trainer)
+        futures = []
         try:
             futures = [
                 w.submit(_execute_remote, task_ref, rank, queue.handle)
                 for rank, w in enumerate(self._workers)
             ]
             on_item = getattr(trainer, "_on_stream_item", None)
-            results = process_results(futures, queue, on_item=on_item)
+            results = process_results(
+                futures, queue, on_item=on_item,
+                on_tick=monitor.tick if monitor is not None else None,
+            )
+        except (ActorDiedError, RemoteError) as err:
+            self._enrich_failure(err, futures, monitor)
+            raise
         finally:
+            if monitor is not None:
+                monitor.finalize()
+                adopt = getattr(trainer, "_adopt_monitor", None)
+                if adopt is not None:
+                    adopt(monitor)
             queue.shutdown()
             # Segment-backed task payloads are per-fit; without this,
             # repeated fits on one backend (PBT) leak tmpfs ∝ fits × size.
             task_ref.release()
         return results
+
+    # -- live monitoring (telemetry/monitor.py) -----------------------------
+    def _build_monitor(self, kind: str, config: FitConfig, trainer):
+        """A RunMonitor for fit stages at enabled telemetry tiers —
+        ``telemetry="off"`` installs no monitor at all."""
+        if kind != "fit":
+            return None
+        from ray_lightning_tpu.telemetry import (
+            MonitorConfig,
+            RunMonitor,
+            TelemetryConfig,
+        )
+
+        tel_cfg = TelemetryConfig.coerce(self.telemetry)
+        if tel_cfg.tier == "off" or tel_cfg.heartbeat_s <= 0:
+            return None
+        mon_cfg = MonitorConfig.coerce(
+            self.monitor, heartbeat_s=tel_cfg.heartbeat_s
+        )
+        if mon_cfg.out_dir is None:
+            mon_cfg = dataclasses.replace(
+                mon_cfg,
+                out_dir=tel_cfg.export_dir or os.path.join(
+                    config.default_root_dir, "telemetry"
+                ),
+            )
+        monitor = RunMonitor(
+            mon_cfg,
+            world_size=self.num_workers,
+            dump_cb=self._dump_rank_stacks,
+            abort_cb=self._abort_workers,
+        )
+        attach = getattr(trainer, "_attach_monitor", None)
+        if attach is not None:
+            attach(monitor)
+        return monitor
+
+    def _dump_rank_stacks(self, rank: int):
+        """Monitor dump hook: out-of-band py-stack + device-memory dump
+        of one worker (served mid-call via the actor control lane).
+        Backends whose workers lack the lane (the Ray adapter) degrade
+        to a clear error event instead of a puzzling AttributeError."""
+        worker = self._workers[rank]
+        dump = getattr(worker, "dump_stacks", None)
+        if dump is None:
+            raise RuntimeError(
+                f"{type(worker).__name__} has no control lane — "
+                "out-of-band stack dumps need ProcessActor workers "
+                "(use Ray's py-spy tooling on Ray clusters)"
+            )
+        return dump()
+
+    def _abort_workers(self, reason: str) -> None:
+        """Monitor abort hook: kill the worker set so the pump's futures
+        fail instead of waiting on a hung collective forever."""
+        warnings.warn(f"RunMonitor abort: {reason} — killing workers")
+        for w in self._workers:
+            try:
+                w.kill(timeout=1.0)
+            except Exception:  # noqa: BLE001 - some are already dead
+                pass
+
+    def _enrich_failure(self, err, futures, monitor) -> None:
+        """Make a worker-death report say when/how the rank died: rank
+        (from the failed future), exit code (agent/subprocess poll),
+        last-heartbeat age and flight-bundle paths (from the monitor)."""
+        rank = next(
+            (
+                i for i, f in enumerate(futures)
+                if f.done() and f.exception() is err
+            ),
+            None,
+        )
+        bundles = monitor.crash_bundles() if monitor is not None else []
+        note = None
+        if bundles:
+            note = "flight bundle(s): " + ", ".join(bundles)
+        if isinstance(err, ActorDiedError):
+            fields = {"note": note} if note else {}
+            if monitor is not None and monitor.abort_reason:
+                fields["note"] = "; ".join(filter(None, [
+                    note, f"aborted by RunMonitor: {monitor.abort_reason}"
+                ]))
+            if rank is not None:
+                fields["rank"] = rank
+                if rank < len(self._workers):
+                    worker = self._workers[rank]
+                    fields["exit_code"] = worker._proc.poll()
+                if monitor is not None:
+                    fields["last_heartbeat_age_s"] = (
+                        monitor.last_heartbeat_age_s(rank)
+                    )
+            if fields:
+                err.enrich(**fields)
+        elif note:
+            # RemoteError: the bundle path must still be in the message
+            # a user reads first.
+            err.args = (f"{err.args[0]}\n[{note}]",) + err.args[1:]
 
     def teardown(self) -> None:
         """Kill workers (≙ ``post_dispatch`` teardown, ``ray_ddp.py:398-401``)."""
@@ -566,11 +707,19 @@ class LocalStrategy(TpuStrategy):
 
     def __init__(self, mesh_axes: Optional[Dict[str, int]] = None,
                  mode: str = "gspmd", zero_stage: int = 0,
-                 grad_comm=None, telemetry=None):
+                 grad_comm=None, telemetry=None, monitor=None):
         super().__init__(
             num_workers=1, mesh_axes=mesh_axes, grad_comm=grad_comm,
-            telemetry=telemetry,
+            telemetry=telemetry, monitor=monitor,
         )
+        if monitor is not None:
+            warnings.warn(
+                "monitor= has no effect on LocalStrategy: the RunMonitor "
+                "rides the driver's result pump, which inline fits never "
+                "enter.  Local fits still stream heartbeats to "
+                "<root>/telemetry/heartbeats-rank0.jsonl (rlt_top reads "
+                "them); use a remote strategy for watchdog/abort."
+            )
         self.mode = mode
         self.zero_stage = zero_stage
 
@@ -601,10 +750,23 @@ class LocalStrategy(TpuStrategy):
             global_rank=0, world_size=1, mesh=mesh,
         )
         if kind == "fit":
-            return [run_fit(callbacks=callbacks, mode=self.mode,
-                            zero_stage=self.zero_stage,
-                            grad_comm=self.grad_comm,
-                            telemetry=self.telemetry, **common)]
+            try:
+                return [run_fit(callbacks=callbacks, mode=self.mode,
+                                zero_stage=self.zero_stage,
+                                grad_comm=self.grad_comm,
+                                telemetry=self.telemetry, **common)]
+            except BaseException as err:
+                # Inline fits get the same crash forensics as remote
+                # workers; there is no queue, so name the bundle loudly
+                # here instead of on a stream event.
+                from ray_lightning_tpu.telemetry.flight_recorder import (
+                    record_active_crash,
+                )
+
+                bundle = record_active_crash(err)
+                if bundle is not None:
+                    warnings.warn(f"crash flight bundle written: {bundle}")
+                raise
         if kind in ("validation", "test"):
             return [run_eval(callbacks=callbacks, kind=kind, mode=self.mode,
                              zero_stage=self.zero_stage,

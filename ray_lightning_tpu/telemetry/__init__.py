@@ -1,6 +1,6 @@
-"""Unified telemetry: span tracing, step-time breakdown, MFU, fleet views.
+"""Unified telemetry: spans, step stats, fleet views, live monitoring.
 
-The observability subsystem (ISSUE 2).  One import surface:
+The observability subsystem (ISSUEs 2 + 3).  One import surface:
 
 * :class:`Telemetry` / :class:`TelemetryConfig` — the per-rank runtime
   and its tier knobs (``off`` / ``cheap`` default / ``full``), coerced
@@ -10,6 +10,12 @@ The observability subsystem (ISSUE 2).  One import surface:
   recompile counters, device memory stats;
 * :func:`merge_snapshots` / :func:`host_stats` — driver-side fleet
   aggregation (``trainer.telemetry_report``) and straggler host context;
+* the **live plane** (ISSUE 3): :class:`HeartbeatPublisher` (worker
+  liveness/progress beats over the DriverQueue), :class:`RunMonitor` /
+  :class:`MonitorConfig` (driver-side hang/straggler watchdog feeding
+  ``trainer.monitor_report``), :class:`FlightRecorder` (crash bundles),
+  :class:`RankLogHandler` (rank-tagged log ring + forwarding), and
+  :mod:`.export_prom` (OpenMetrics textfile/HTTP export);
 * :mod:`.trace_parse` / :mod:`.schema` — Chrome-trace parsing shared by
   the tools, and the artifact-schema validators ``format.sh`` gates on.
 
@@ -22,6 +28,10 @@ from ray_lightning_tpu.telemetry.aggregate import (
     merge_snapshots,
     straggler_ranks,
 )
+from ray_lightning_tpu.telemetry.flight_recorder import FlightRecorder
+from ray_lightning_tpu.telemetry.heartbeat import HeartbeatPublisher
+from ray_lightning_tpu.telemetry.logs import RankLogHandler
+from ray_lightning_tpu.telemetry.monitor import MonitorConfig, RunMonitor
 from ray_lightning_tpu.telemetry.runtime import (
     TIERS,
     Telemetry,
@@ -45,6 +55,11 @@ __all__ = [
     "Span",
     "PHASES",
     "StepStats",
+    "HeartbeatPublisher",
+    "RunMonitor",
+    "MonitorConfig",
+    "FlightRecorder",
+    "RankLogHandler",
     "model_flops_per_token",
     "vit_flops_per_example",
     "flops_for_module",

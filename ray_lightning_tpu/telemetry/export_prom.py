@@ -1,0 +1,164 @@
+"""OpenMetrics export of the driver's live fleet snapshot.
+
+Two transports, both fed by :class:`~.monitor.RunMonitor`:
+
+* **textfile** (``RLT_PROM_FILE`` / ``MonitorConfig.prom_file``) — the
+  node-exporter textfile-collector pattern: the snapshot is rendered
+  and atomically replaced on every refresh, so any Prometheus scrape
+  infrastructure already on the host picks it up with zero new ports;
+* **localhost HTTP** (``RLT_PROM_PORT`` / ``prom_port``; port 0 =
+  ephemeral) — a daemon-thread ``http.server`` bound to 127.0.0.1
+  serving the latest render at ``/metrics`` for ad-hoc scrapes and
+  ``curl`` during an incident.
+
+The renderer is a pure function (snapshot dict → text) so tests and
+``rlt_top`` can use it without a monitor.  jax-free, stdlib-only.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+__all__ = ["render_openmetrics", "PromExporter"]
+
+_PREFIX = "rlt"
+
+
+def _esc(value: Any) -> str:
+    return str(value).replace("\\", "\\\\").replace('"', '\\"')
+
+
+def render_openmetrics(snapshot: Dict[str, Any],
+                       event_counts: Optional[Dict[str, int]] = None) -> str:
+    """Render a :meth:`RunMonitor.snapshot` as OpenMetrics text."""
+    lines = []
+
+    def gauge(name: str, help_: str, samples) -> None:
+        samples = list(samples)
+        if not samples:
+            return
+        lines.append(f"# TYPE {_PREFIX}_{name} gauge")
+        lines.append(f"# HELP {_PREFIX}_{name} {help_}")
+        for labels, value in samples:
+            label_s = ",".join(
+                f'{k}="{_esc(v)}"' for k, v in sorted(labels.items())
+            )
+            label_s = "{" + label_s + "}" if label_s else ""
+            lines.append(f"{_PREFIX}_{name}{label_s} {value}")
+
+    gauge("fleet_ranks", "ranks that have reported a heartbeat",
+          [({}, snapshot.get("ranks_reporting", 0))])
+    gauge("monitor_aborted", "1 if the monitor aborted the fit",
+          [({}, int(bool(snapshot.get("aborted"))))])
+    ranks = snapshot.get("ranks", {})
+    per_rank = [
+        ("rank_global_step", "optimizer steps completed", "global_step"),
+        ("rank_progress", "loop progress counter", "progress"),
+        ("rank_heartbeat_age_seconds", "seconds since last heartbeat",
+         "age_s"),
+        ("rank_step_time_ms", "mean step wall time", "step_time_ms"),
+        ("rank_data_wait_ms", "mean input-pipeline wait", "data_wait_ms"),
+        ("rank_examples_per_sec", "training throughput",
+         "examples_per_sec"),
+        ("rank_host_load", "1-minute load average of the rank's host",
+         "host_load"),
+    ]
+    for metric, help_, key in per_rank:
+        gauge(metric, help_, (
+            ({"rank": rank}, beat[key])
+            for rank, beat in sorted(ranks.items())
+            if isinstance(beat.get(key), (int, float))
+        ))
+    status_order = ("ok", "stalled", "lost", "crashed", "done")
+    gauge("rank_status", "rank state (one-hot over status label)", (
+        ({"rank": rank, "status": status}, int(beat.get("status") == status))
+        for rank, beat in sorted(ranks.items())
+        for status in status_order
+    ))
+    if event_counts:
+        lines.append(f"# TYPE {_PREFIX}_monitor_events counter")
+        lines.append(
+            f"# HELP {_PREFIX}_monitor_events monitor events by kind"
+        )
+        for kind, n in sorted(event_counts.items()):
+            lines.append(
+                f'{_PREFIX}_monitor_events_total{{kind="{_esc(kind)}"}} {n}'
+            )
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+class PromExporter:
+    """Textfile writer + optional localhost /metrics server."""
+
+    def __init__(self, textfile: Optional[str] = None,
+                 port: Optional[int] = None):
+        self.textfile = textfile
+        self._text = "# EOF\n"
+        self._server = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+        if port is not None:
+            self._start_server(port)
+
+    def _start_server(self, port: int) -> None:
+        import http.server
+
+        exporter = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - stdlib naming
+                if self.path not in ("/metrics", "/"):
+                    self.send_error(404)
+                    return
+                body = exporter._text.encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "application/openmetrics-text; version=1.0.0; "
+                    "charset=utf-8",
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # silence per-scrape stderr
+                pass
+
+        try:
+            self._server = http.server.ThreadingHTTPServer(
+                ("127.0.0.1", port), Handler
+            )
+        except OSError:
+            self._server = None  # port taken: textfile still works
+            return
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="rlt-prom",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def update(self, snapshot: Dict[str, Any],
+               event_counts: Optional[Dict[str, int]] = None) -> None:
+        self._text = render_openmetrics(snapshot, event_counts)
+        if self.textfile:
+            try:
+                parent = os.path.dirname(self.textfile)
+                if parent:
+                    os.makedirs(parent, exist_ok=True)
+                tmp = self.textfile + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(self._text)
+                os.replace(tmp, self.textfile)
+            except OSError:
+                pass  # a full disk must not take the fit down
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        self._thread = None

@@ -1,0 +1,283 @@
+"""Crash flight recorder: worker-side forensics that outlive the worker.
+
+A worker that raises mid-fit loses its spans, step stats and logs —
+the driver gets a traceback and nothing else.  The
+:class:`FlightRecorder` persists a **flight bundle** at crash time
+under the telemetry dir (``<telemetry_dir>/flight/``):
+
+* ``bundle-rank<k>.json`` — schema-pinned post-mortem
+  (``telemetry/schema.py:validate_flight_bundle``): the exception +
+  traceback, step counters and loop phase, last-N spans from the
+  existing ring, the step-stats snapshot, the rank-tagged log ring
+  (``telemetry/logs.py``), all-thread py stacks, device memory, and an
+  env/device fingerprint;
+* ``fatal-rank<k>.log`` — ``faulthandler`` output armed for the whole
+  fit, so a segfault/fatal signal (which Python except blocks never
+  see) still leaves native-level stacks behind.
+
+The recorder registers itself in a module-global slot while a fit is
+live; the stage wrappers (``_execute_remote``, ``LocalStrategy.run``)
+call :func:`record_active_crash` from their except path — no
+re-indentation of the fit loop, and a crash anywhere between setup and
+result packaging is covered.  When a queue is attached, the bundle
+path also travels to the driver as a ``{"type": "event",
+"kind": "crash"}`` item so the raised error can *name* the bundle.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import sys
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+from .schema import FLIGHT_BUNDLE_SCHEMA_ID
+
+__all__ = [
+    "FlightRecorder",
+    "record_active_crash",
+    "format_all_stacks",
+]
+
+_SPAN_TAIL = 256          # last-N spans folded into the bundle
+_STACK_CHAR_CAP = 65536   # bound the stacks blob a bundle may carry
+
+_active_lock = threading.Lock()
+_active: List["FlightRecorder"] = []
+
+
+def format_all_stacks() -> str:
+    """Formatted stacks of every live thread (``sys._current_frames``)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    chunks = []
+    for tid, frame in sorted(sys._current_frames().items()):
+        name = names.get(tid, "?")
+        chunks.append(
+            f"--- thread {tid} ({name}) ---\n"
+            + "".join(traceback.format_stack(frame))
+        )
+    text = "\n".join(chunks)
+    if len(text) > _STACK_CHAR_CAP:
+        text = text[:_STACK_CHAR_CAP] + "\n…[truncated]"
+    return text
+
+
+def _fingerprint() -> Dict[str, Any]:
+    """Env/device identity: enough to answer "what exactly was this
+    process" without the process."""
+    fp: Dict[str, Any] = {
+        "python": sys.version.split()[0],
+        "platform": platform.platform(),
+        "pid": os.getpid(),
+        "argv": " ".join(sys.argv[:4]),
+    }
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        fp["jax"] = getattr(jax, "__version__", "?")
+        try:
+            fp["backend"] = jax.default_backend()
+            fp["device_kind"] = jax.local_devices()[0].device_kind
+        except Exception:  # noqa: BLE001 - backend may be mid-teardown
+            pass
+    env = {
+        k: v for k, v in sorted(os.environ.items())
+        if k.startswith("RLT_") or k in (
+            "JAX_PLATFORMS", "XLA_FLAGS", "TPU_VISIBLE_CHIPS",
+        )
+    }
+    if env:
+        fp["env"] = env
+    return fp
+
+
+class FlightRecorder:
+    """Per-rank crash forensics for one fit (see module docstring)."""
+
+    def __init__(self, rank: int, out_dir: str, ctx: Any,
+                 telemetry: Any = None, queue: Any = None,
+                 log_handler: Any = None,
+                 heartbeat: Any = None,
+                 bundles_enabled: bool = True):
+        self.rank = rank
+        self.out_dir = out_dir
+        self._ctx = ctx
+        self._telemetry = telemetry
+        self._queue = queue
+        self._log_handler = log_handler
+        self._heartbeat = heartbeat
+        self._fatal_file = None
+        # RLT_FLIGHT_RECORDER=off gates the bundle/faulthandler OUTPUT
+        # only — the recorder still arms, because its crash hook is
+        # also what stops the heartbeat thread and removes the log
+        # handler when the fit raises (no bundle must never mean a
+        # leaked publisher).
+        self.bundles_enabled = bundles_enabled
+        self.bundle_path: Optional[str] = None
+
+    # -- lifecycle ----------------------------------------------------------
+    @classmethod
+    def maybe_install(cls, telemetry: Any, ctx: Any, queue: Any,
+                      log_handler: Any = None,
+                      heartbeat: Any = None) -> Optional["FlightRecorder"]:
+        """Arm a recorder for this fit, or ``None`` when telemetry is
+        off.  ``RLT_FLIGHT_RECORDER=off`` keeps the recorder (it owns
+        crash-path plane cleanup) but disables bundle/fatal-log output."""
+        if telemetry is None or not getattr(telemetry, "enabled", False):
+            return None
+        tel_dir = getattr(ctx, "telemetry_dir", None)
+        if tel_dir is None:
+            return None
+        bundles_enabled = os.environ.get(
+            "RLT_FLIGHT_RECORDER", ""
+        ).lower() not in ("0", "off", "false")
+        rec = cls(telemetry.global_rank, os.path.join(tel_dir, "flight"),
+                  ctx, telemetry=telemetry, queue=queue,
+                  log_handler=log_handler, heartbeat=heartbeat,
+                  bundles_enabled=bundles_enabled)
+        rec.install()
+        return rec
+
+    def install(self) -> None:
+        """Arm faulthandler into the fatal log + register as the live
+        recorder of this process (one fit per worker process)."""
+        if self.bundles_enabled:
+            try:
+                import faulthandler
+
+                os.makedirs(self.out_dir, exist_ok=True)
+                self._fatal_file = open(
+                    os.path.join(self.out_dir,
+                                 f"fatal-rank{self.rank}.log"),
+                    "w",
+                )
+                faulthandler.enable(file=self._fatal_file)
+            except (OSError, RuntimeError):
+                self._fatal_file = None
+        with _active_lock:
+            _active.append(self)
+
+    def uninstall(self) -> None:
+        """Disarm on the success path (and after a recorded crash)."""
+        with _active_lock:
+            if self in _active:
+                _active.remove(self)
+        if self._fatal_file is not None:
+            try:
+                import faulthandler
+
+                faulthandler.disable()
+                self._fatal_file.close()
+                # An empty fatal log is noise, not forensics.
+                path = self._fatal_file.name
+                if os.path.exists(path) and os.path.getsize(path) == 0:
+                    os.unlink(path)
+            except (OSError, RuntimeError):
+                pass
+            self._fatal_file = None
+
+    # -- the crash path -----------------------------------------------------
+    def compose_bundle(self, exc: BaseException) -> Dict[str, Any]:
+        ctx, tel = self._ctx, self._telemetry
+        doc: Dict[str, Any] = {
+            "schema": FLIGHT_BUNDLE_SCHEMA_ID,
+            "rank": self.rank,
+            "ts": time.time(),
+            "error": repr(exc),
+            "traceback": "".join(
+                traceback.format_exception(type(exc), exc, exc.__traceback__)
+            ),
+            "global_step": int(getattr(ctx, "global_step", 0)),
+            "micro_step": int(getattr(ctx, "micro_step", 0)),
+            "epoch": int(getattr(ctx, "current_epoch", 0)),
+            "phase": str(getattr(ctx, "phase", "init")),
+            "fingerprint": _fingerprint(),
+            "stacks": format_all_stacks(),
+        }
+        if tel is not None:
+            tracer = getattr(tel, "tracer", None)
+            if tracer is not None and tracer.enabled:
+                doc["spans"] = [
+                    tracer._span_dict(s) for s in tracer.events()[-_SPAN_TAIL:]
+                ]
+            stats = getattr(tel, "step_stats", None)
+            if stats is not None:
+                doc["step_stats"] = stats.summary()
+            counters = dict(getattr(tel, "counters", {}) or {})
+            if counters:
+                doc["counters"] = counters
+        if self._log_handler is not None:
+            doc["logs"] = self._log_handler.records()
+        from .heartbeat import device_memory_stats
+
+        mem = device_memory_stats()
+        if mem:
+            doc["device_memory"] = mem
+        return doc
+
+    def record_crash(self, exc: BaseException) -> Optional[str]:
+        """Persist the bundle, announce it on the queue, disarm.
+        Returns the bundle path (``None`` if even that failed — crash
+        handling must never mask the original exception)."""
+        # Stop the publisher FIRST: a final "done" beat would make the
+        # monitor retire a rank that actually died.
+        if self._heartbeat is not None:
+            try:
+                self._heartbeat.stop(final=False)
+            except Exception:  # noqa: BLE001
+                pass
+        if not self.bundles_enabled:
+            # Output disabled: still tear the plane down cleanly.
+            if self._log_handler is not None:
+                try:
+                    self._log_handler.uninstall()
+                except Exception:  # noqa: BLE001
+                    pass
+            self.uninstall()
+            return None
+        try:
+            doc = self.compose_bundle(exc)
+            os.makedirs(self.out_dir, exist_ok=True)
+            path = os.path.join(
+                self.out_dir, f"bundle-rank{self.rank}.json"
+            )
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, indent=2, sort_keys=True, default=str)
+            os.replace(tmp, path)
+            self.bundle_path = path
+        except Exception:  # noqa: BLE001 - forensics are best-effort
+            self.bundle_path = None
+        if self._queue is not None and self.bundle_path is not None:
+            try:
+                self._queue.put({
+                    "type": "event",
+                    "kind": "crash",
+                    "rank": self.rank,
+                    "ts": time.time(),
+                    "error": repr(exc),
+                    "bundle": self.bundle_path,
+                })
+            except Exception:  # noqa: BLE001 - queue may already be down
+                pass
+        if self._log_handler is not None:
+            try:
+                self._log_handler.uninstall()
+            except Exception:  # noqa: BLE001
+                pass
+        self.uninstall()
+        return self.bundle_path
+
+
+def record_active_crash(exc: BaseException) -> Optional[str]:
+    """Crash hook for the stage wrappers: route ``exc`` to whatever
+    recorder is live in this process.  No-op (returns ``None``) when
+    telemetry is off or no fit is in flight."""
+    with _active_lock:
+        rec = _active[-1] if _active else None
+    if rec is None:
+        return None
+    return rec.record_crash(exc)

@@ -1,0 +1,224 @@
+"""Worker-side heartbeat publisher: the live end of the telemetry plane.
+
+PR 2's snapshots ride the *end-of-run* result package — during the fit
+the fleet is a black box.  This module closes that gap: every
+``heartbeat_s`` seconds (``TelemetryConfig.heartbeat_s`` /
+``RLT_HEARTBEAT_S``, default 5, tier-gated like everything else) a
+background thread composes a compact rank-tagged heartbeat — step
+counters, loop phase, step-time headline, device memory, host load,
+the deepest open span — and ships it to the driver over the existing
+``DriverQueue`` channel, where :class:`~.monitor.RunMonitor` consumes
+it.
+
+Design notes:
+
+* **A thread, not a loop hook.**  Beats must keep flowing while the
+  loop thread is wedged inside a collective — that is exactly when the
+  driver needs them (beats flowing + progress frozen = hang; beats
+  gone = process/network death).  The thread only *reads* loop state
+  (GIL-atomic attribute loads), so its steady-state cost is a few
+  dict builds per interval — unmeasurable against a training step.
+* **Queue-or-file sink.**  Remote workers publish through their
+  ``QueueHandle``; a :class:`~..parallel.strategies.LocalStrategy` fit
+  has no queue, so beats append to
+  ``<telemetry_dir>/heartbeats-rank<k>.jsonl`` instead — the same
+  documents, tail-able by ``tools/rlt_top.py``.
+* jax-free imports: device memory is read only when jax is already
+  loaded in the process, and every probe degrades to absence.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, Optional
+
+__all__ = ["HeartbeatPublisher", "make_beat", "device_memory_stats"]
+
+
+def device_memory_stats() -> Dict[str, float]:
+    """Best-effort device-0 memory stats.  Never imports jax (a probe
+    must not pay PJRT init); never raises."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return {}
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001 - absent on CPU, racy mid-teardown
+        return {}
+    if not stats:
+        return {}
+    out = {}
+    for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+        if key in stats:
+            out[key] = float(stats[key])
+    return out
+
+
+def _host_load() -> Optional[float]:
+    try:
+        return round(os.getloadavg()[0], 2)
+    except (OSError, AttributeError):
+        return None
+
+
+def make_beat(rank: int, seq: int, ctx: Any,
+              telemetry: Any = None, done: bool = False) -> Dict[str, Any]:
+    """Compose one heartbeat document (schema:
+    ``telemetry/schema.py:validate_heartbeat``) from live loop state.
+
+    ``ctx`` is duck-typed (the LoopContext, or any object with the step
+    counters) so the schema self-test can feed a stub without jax."""
+    beat: Dict[str, Any] = {
+        "type": "heartbeat",
+        "rank": rank,
+        "seq": seq,
+        "ts": time.time(),
+        "global_step": int(getattr(ctx, "global_step", 0)),
+        "micro_step": int(getattr(ctx, "micro_step", 0)),
+        "epoch": int(getattr(ctx, "current_epoch", 0)),
+        "progress": int(getattr(ctx, "progress", 0)),
+        "phase": str(getattr(ctx, "phase", "init")),
+    }
+    if done:
+        beat["done"] = True
+    if telemetry is not None:
+        stats = getattr(telemetry, "step_stats", None)
+        if stats is not None:
+            headline = stats.headline()
+            for key in ("step_time_ms", "data_wait_ms", "examples_per_sec"):
+                if key in headline:
+                    beat[key] = round(float(headline[key]), 3)
+        tracer = getattr(telemetry, "tracer", None)
+        open_span = getattr(tracer, "open_span", None)
+        if open_span:
+            beat["open_span"] = open_span
+    mem = device_memory_stats()
+    if mem:
+        beat["device_memory"] = mem
+    load = _host_load()
+    if load is not None:
+        beat["host_load"] = load
+    return beat
+
+
+class _FileSink:
+    """JSONL append sink for queue-less (local) fits."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._f = None
+
+    def put(self, item: Dict[str, Any]) -> None:
+        if self._f is None:
+            os.makedirs(os.path.dirname(self._path) or ".", exist_ok=True)
+            self._f = open(self._path, "a")
+        self._f.write(json.dumps(item) + "\n")
+        self._f.flush()
+
+    def close(self) -> None:
+        if self._f is not None:
+            try:
+                self._f.close()
+            finally:
+                self._f = None
+
+
+class HeartbeatPublisher:
+    """Background publisher of one rank's heartbeat stream."""
+
+    def __init__(self, rank: int, ctx: Any, sink: Any,
+                 interval_s: float, telemetry: Any = None):
+        self.rank = rank
+        self._ctx = ctx
+        self._sink = sink
+        self._interval_s = interval_s
+        self._telemetry = telemetry
+        self._seq = 0
+        self.beats_sent = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @classmethod
+    def maybe_start(cls, telemetry: Any, ctx: Any, queue: Any,
+                    config: Any) -> Optional["HeartbeatPublisher"]:
+        """Build + start a publisher, or ``None`` when the tier is off,
+        the interval is 0, or there is nowhere to publish to."""
+        if telemetry is None or not getattr(telemetry, "enabled", False):
+            return None
+        interval_s = float(
+            getattr(telemetry.config, "heartbeat_s", 0.0) or 0.0
+        )
+        if interval_s <= 0:
+            return None
+        sink = queue
+        if sink is not None and hasattr(sink, "host") and hasattr(
+            sink, "port"
+        ):
+            # Dedicated connection (fresh QueueHandle, own client_id/
+            # seq space): the shared handle serializes puts under one
+            # lock with a size-scaled send budget — a GB-scale
+            # checkpoint thunk would block beats for minutes and read
+            # driver-side as a dead rank.  Liveness needs its own lane.
+            sink = type(sink)(sink.host, sink.port)
+        if sink is None:
+            tel_dir = getattr(ctx, "telemetry_dir", None)
+            if tel_dir is None:
+                return None
+            sink = _FileSink(os.path.join(
+                tel_dir, f"heartbeats-rank{telemetry.global_rank}.jsonl"
+            ))
+        pub = cls(telemetry.global_rank, ctx, sink, interval_s,
+                  telemetry=telemetry)
+        pub.start()
+        return pub
+
+    # -- publishing ---------------------------------------------------------
+    def _publish(self, done: bool = False) -> bool:
+        self._seq += 1
+        beat = make_beat(self.rank, self._seq, self._ctx,
+                         self._telemetry, done=done)
+        try:
+            self._sink.put(beat)
+        except Exception:  # noqa: BLE001 - the queue dies at teardown /
+            # driver restart; heartbeats are diagnostics, never load-bearing.
+            return False
+        self.beats_sent += 1
+        return True
+
+    def _run(self) -> None:
+        # First beat immediately: the monitor learns the rank exists
+        # (and its socket works) before the first full interval.
+        alive = self._publish()
+        while alive and not self._stop.wait(self._interval_s):
+            alive = self._publish()
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name=f"rlt-heartbeat-r{self.rank}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self, final: bool = True, timeout_s: float = 5.0) -> None:
+        """Stop the thread; ``final=True`` sends one last ``done`` beat
+        so the monitor retires the rank instead of flagging the silence
+        that legitimately follows fit completion."""
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=timeout_s)
+        self._thread = None
+        if final:
+            self._publish(done=True)
+        close = getattr(self._sink, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass

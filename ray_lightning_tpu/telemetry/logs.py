@@ -1,0 +1,106 @@
+"""Rank-tagged log capture: the ring buffer behind crash forensics.
+
+A worker that dies takes its process — and everything Python logged in
+the minutes before — with it.  :class:`RankLogHandler` is a
+``logging.Handler`` the fit loop installs on the root logger at enabled
+telemetry tiers: it keeps the last-N formatted records in a bounded
+ring (the flight recorder folds them into the crash bundle) and
+forwards WARNING+ records to the driver as ``{"type": "log", ...}``
+stream items, capped per fit so a log storm cannot flood the queue.
+
+jax-free and allocation-light: format happens at emit (record args may
+not outlive the handler), the ring is a ``deque`` with ``maxlen``.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["RankLogHandler", "DEFAULT_RING_SIZE", "DEFAULT_FORWARD_CAP"]
+
+DEFAULT_RING_SIZE = 200
+#: Max WARNING+ records forwarded to the driver per fit — a crash loop
+#: emitting thousands of warnings must not turn the queue into a DoS.
+DEFAULT_FORWARD_CAP = 50
+_MAX_MESSAGE_CHARS = 2048
+
+
+class RankLogHandler(logging.Handler):
+    """Bounded ring of formatted records + capped driver forwarding."""
+
+    def __init__(self, rank: int, queue: Optional[Any] = None,
+                 ring_size: Optional[int] = None,
+                 forward_cap: int = DEFAULT_FORWARD_CAP):
+        if ring_size is None:
+            import os
+
+            ring_size = int(
+                os.environ.get("RLT_LOG_RING") or DEFAULT_RING_SIZE
+            )
+        super().__init__(level=logging.INFO)
+        self.rank = rank
+        self._queue = queue
+        self._ring: collections.deque = collections.deque(maxlen=ring_size)
+        self._forward_cap = forward_cap
+        self._forwarded = 0
+        self._ring_lock = threading.Lock()
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            message = record.getMessage()
+        except Exception:  # noqa: BLE001 - malformed args must not kill logging
+            message = str(record.msg)
+        if len(message) > _MAX_MESSAGE_CHARS:
+            message = message[:_MAX_MESSAGE_CHARS] + "…[truncated]"
+        entry = {
+            "ts": record.created,
+            "level": record.levelname,
+            "logger": record.name,
+            "message": message,
+        }
+        with self._ring_lock:
+            self._ring.append(entry)
+        if (
+            self._queue is not None
+            and record.levelno >= logging.WARNING
+            and self._forwarded < self._forward_cap
+        ):
+            self._forwarded += 1
+            item: Dict[str, Any] = {
+                "type": "log", "rank": self.rank, **entry,
+            }
+            try:
+                self._queue.put(item)
+            except Exception:  # noqa: BLE001 - the queue may be gone at
+                # teardown; a log record must never crash the loop.
+                self._queue = None
+
+    def records(self) -> List[Dict[str, Any]]:
+        """Ring contents, oldest first (the flight-bundle ``logs`` list)."""
+        with self._ring_lock:
+            return list(self._ring)
+
+    # -- lifecycle ----------------------------------------------------------
+    def install(self) -> "RankLogHandler":
+        logging.getLogger().addHandler(self)
+        return self
+
+    def uninstall(self) -> None:
+        logging.getLogger().removeHandler(self)
+
+
+def make_log_item(rank: int, level: str, logger: str,
+                  message: str) -> Dict[str, Any]:
+    """A schema-shaped log stream item (shared by tests/self-tests)."""
+    return {
+        "type": "log",
+        "rank": rank,
+        "ts": time.time(),
+        "level": level,
+        "logger": logger,
+        "message": message,
+    }

@@ -1,0 +1,478 @@
+"""Driver-side run monitor: live liveness/progress tracking for a fit.
+
+While workers train, the driver sits in ``process_results`` pumping the
+queue.  :class:`RunMonitor` rides that pump (``on_item`` consumes the
+typed stream items, ``tick`` runs between drains) and turns the
+heartbeat stream (``telemetry/heartbeat.py``) into actionable state:
+
+* **liveness** — a rank whose beats stop for ``hang_intervals``
+  heartbeat periods is flagged ``heartbeat_lost`` (process/network
+  death the futures may take much longer to surface);
+* **hang** — beats flowing but the progress counter frozen for
+  ``hang_intervals`` periods flags a ``stall`` (the wedged-collective
+  signature).  The monitor then requests an out-of-band py-stack +
+  device-memory dump from the suspect worker
+  (``ProcessActor.dump_stacks`` — served even while the fit call is
+  running) and records it as a ``stack_dump`` event;
+* **live stragglers** — a rank lagging the fleet median ``global_step``
+  by more than ``straggler_lag_steps`` is flagged while the skew is
+  happening, not post-hoc;
+* **abort** — with ``abort_after_s`` set, a hang persisting past the
+  deadline triggers the abort callback (the strategy kills the worker
+  set; the fit raises instead of waiting forever);
+* **export** — a ``live.json`` snapshot for ``tools/rlt_top.py`` and an
+  optional OpenMetrics textfile / localhost HTTP endpoint
+  (``telemetry/export_prom.py``).
+
+Single-threaded by design: ``on_item``/``tick``/``finalize`` are all
+called from the driver's pump loop.  jax-free.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import os
+import statistics
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["MonitorConfig", "RunMonitor", "make_event"]
+
+_EVENT_CAP = 500
+_RANK_LOG_CAP = 50
+_STACK_EVENT_CHAR_CAP = 32768
+
+
+@dataclasses.dataclass(frozen=True)
+class MonitorConfig:
+    """Driver-side monitor knobs (``monitor=`` on any strategy, or the
+    ``RLT_MONITOR_*`` / ``RLT_PROM_*`` env bus)."""
+
+    heartbeat_s: float = 5.0       # mirrors TelemetryConfig.heartbeat_s
+    hang_intervals: int = 3        # K: silence/stall budget in beats
+    abort_after_s: Optional[float] = None   # None = never abort
+    straggler_lag_steps: int = 200
+    live_every_s: float = 1.0      # live.json / prom refresh cadence
+    out_dir: Optional[str] = None  # live.json home (None = no file)
+    prom_file: Optional[str] = None
+    prom_port: Optional[int] = None
+
+    def __post_init__(self):
+        if self.heartbeat_s <= 0:
+            raise ValueError("heartbeat_s must be > 0")
+        if self.hang_intervals < 1:
+            raise ValueError("hang_intervals must be >= 1")
+        if self.abort_after_s is not None and self.abort_after_s <= 0:
+            raise ValueError("abort_after_s must be > 0 (or None)")
+
+    @classmethod
+    def coerce(cls, value: Any,
+               heartbeat_s: Optional[float] = None) -> "MonitorConfig":
+        """None | dict | MonitorConfig → MonitorConfig, with the
+        ``RLT_MONITOR_*``/``RLT_PROM_*`` env bus filling unset knobs —
+        the same resolution contract as ``TelemetryConfig.coerce``."""
+        if isinstance(value, cls):
+            return value
+        if value is None:
+            kw: Dict[str, Any] = {}
+        elif isinstance(value, dict):
+            kw = dict(value)
+        else:
+            raise TypeError(
+                "monitor must be a dict or MonitorConfig; got "
+                f"{type(value).__name__}"
+            )
+        if heartbeat_s is not None:
+            kw.setdefault("heartbeat_s", heartbeat_s)
+        env_map = {
+            "hang_intervals": ("RLT_MONITOR_HANG_INTERVALS", int),
+            "abort_after_s": ("RLT_MONITOR_ABORT_S", float),
+            "straggler_lag_steps": ("RLT_MONITOR_STRAGGLER_LAG", int),
+            "out_dir": ("RLT_MONITOR_DIR", str),
+            "prom_file": ("RLT_PROM_FILE", str),
+            "prom_port": ("RLT_PROM_PORT", int),
+        }
+        for field, (var, cast) in env_map.items():
+            raw = os.environ.get(var)
+            if raw and field not in kw:
+                kw[field] = cast(raw)
+        return cls(**kw)
+
+
+def make_event(kind: str, rank: int, **fields: Any) -> Dict[str, Any]:
+    """A schema-shaped event document
+    (``telemetry/schema.py:validate_event``); rank -1 = fleet-wide."""
+    return {"type": "event", "kind": kind, "rank": rank,
+            "ts": time.time(), **fields}
+
+
+class _RankState:
+    """Everything the monitor knows about one rank."""
+
+    __slots__ = (
+        "beats", "last_beat", "last_beat_at", "last_progress_at",
+        "progress_seen", "done", "flagged_lost", "flagged_stalled",
+        "flagged_straggler", "logs", "crash_bundle",
+    )
+
+    def __init__(self):
+        self.beats = 0
+        self.last_beat: Dict[str, Any] = {}
+        self.last_beat_at: Optional[float] = None
+        self.last_progress_at: Optional[float] = None
+        self.progress_seen = False  # armed only after real progress
+        self.done = False
+        self.flagged_lost = False
+        self.flagged_stalled = False
+        self.flagged_straggler = False
+        self.logs: collections.deque = collections.deque(
+            maxlen=_RANK_LOG_CAP
+        )
+        self.crash_bundle: Optional[str] = None
+
+    def status(self, now: float, hang_s: float) -> str:
+        if self.crash_bundle:
+            return "crashed"
+        if self.done:
+            return "done"
+        if self.flagged_lost or (
+            self.last_beat_at is not None
+            and now - self.last_beat_at > hang_s
+        ):
+            return "lost"
+        if self.flagged_stalled:
+            return "stalled"
+        return "ok"
+
+
+class RunMonitor:
+    """Consumes one fit's stream items; see module docstring.
+
+    ``dump_cb(rank) -> dict`` asks the strategy for a py-stack dump of
+    one worker; ``abort_cb(reason)`` asks it to kill the worker set.
+    Both optional — the monitor degrades to pure bookkeeping.
+    """
+
+    def __init__(self, config: MonitorConfig, world_size: int,
+                 dump_cb: Optional[Callable[[int], Dict[str, Any]]] = None,
+                 abort_cb: Optional[Callable[[str], None]] = None,
+                 now_fn: Callable[[], float] = time.monotonic):
+        self.config = config
+        self.world_size = world_size
+        self._dump_cb = dump_cb
+        self._abort_cb = abort_cb
+        self._now = now_fn
+        self._ranks: Dict[int, _RankState] = {}
+        self.events: List[Dict[str, Any]] = []
+        self.beats_received = 0
+        self.aborted = False
+        self.abort_reason: Optional[str] = None
+        self._hang_since: Optional[float] = None
+        self._last_check = now_fn()
+        self._last_live_write = 0.0
+        self._exporter = None
+        if config.prom_file or config.prom_port is not None:
+            from .export_prom import PromExporter
+
+            self._exporter = PromExporter(
+                textfile=config.prom_file, port=config.prom_port
+            )
+
+    # -- stream consumption -------------------------------------------------
+    def _state(self, rank: int) -> _RankState:
+        st = self._ranks.get(rank)
+        if st is None:
+            st = self._ranks[rank] = _RankState()
+        return st
+
+    def on_item(self, item: Any) -> None:
+        if not isinstance(item, dict):
+            return
+        kind = item.get("type")
+        if kind == "heartbeat":
+            self._on_beat(item)
+        elif kind == "event":
+            self._record_event(item)
+            if item.get("kind") == "crash":
+                st = self._state(int(item.get("rank", -1)))
+                st.crash_bundle = item.get("bundle")
+        elif kind == "log":
+            self._state(int(item.get("rank", 0))).logs.append(item)
+
+    def _on_beat(self, beat: Dict[str, Any]) -> None:
+        now = self._now()
+        st = self._state(int(beat.get("rank", 0)))
+        prev = st.last_beat
+        st.beats += 1
+        self.beats_received += 1
+        st.last_beat = beat
+        st.last_beat_at = now
+        st.flagged_lost = False
+        prev_progress = prev.get("progress", 0) if prev else 0
+        phase_changed = bool(prev) and (
+            beat.get("phase") != prev.get("phase")
+        )
+        advanced = (
+            not prev
+            or beat.get("progress", 0) > prev_progress
+            or phase_changed
+        )
+        if advanced:
+            st.last_progress_at = now
+            if st.flagged_stalled:
+                self._record_event(make_event(
+                    "resumed", int(beat.get("rank", 0)),
+                    message="progress resumed after stall",
+                ))
+            st.flagged_stalled = False
+        # Stall detection arms per PHASE, after the first progress made
+        # inside it: every phase's first step may hide a 20-40s XLA
+        # compile (train step 0, first validation batch, a shape-change
+        # recompile after a phase flip) that must not read as a hang.
+        # heartbeat_lost still covers outright death during a compile.
+        if phase_changed:
+            st.progress_seen = False
+        elif beat.get("progress", 0) > prev_progress:
+            st.progress_seen = True
+        if beat.get("done"):
+            st.done = True
+
+    def _record_event(self, event: Dict[str, Any]) -> None:
+        if len(self.events) < _EVENT_CAP:
+            self.events.append(event)
+
+    # -- periodic checks (the pump's on_tick) -------------------------------
+    def tick(self) -> None:
+        now = self._now()
+        check_every = max(0.05, min(1.0, self.config.heartbeat_s / 2.0))
+        if now - self._last_check >= check_every:
+            self._last_check = now
+            self._check(now)
+        self._maybe_export(now)
+
+    def _check(self, now: float) -> None:
+        cfg = self.config
+        hang_s = cfg.hang_intervals * cfg.heartbeat_s
+        hang_live = False
+        for rank, st in sorted(self._ranks.items()):
+            if st.done or st.crash_bundle or st.last_beat_at is None:
+                continue
+            # Beats stopped entirely: process/network death.
+            if now - st.last_beat_at > hang_s:
+                hang_live = True
+                if not st.flagged_lost:
+                    st.flagged_lost = True
+                    self._record_event(make_event(
+                        "heartbeat_lost", rank,
+                        age_s=round(now - st.last_beat_at, 3),
+                        message=(
+                            f"rank {rank}: no heartbeat for "
+                            f"{cfg.hang_intervals} intervals"
+                        ),
+                    ))
+                    self._request_dump(rank)
+                continue
+            # Beats flowing, progress frozen: the wedged-collective
+            # signature.  "closing" is exempt (final gather/serialize
+            # legitimately shows no step progress), and detection only
+            # arms after the rank has made real progress once — a long
+            # first compile must not read as a hang.
+            if (
+                st.progress_seen
+                and st.last_beat.get("phase") != "closing"
+                and st.last_progress_at is not None
+                and now - st.last_progress_at > hang_s
+            ):
+                hang_live = True
+                if not st.flagged_stalled:
+                    st.flagged_stalled = True
+                    self._record_event(make_event(
+                        "stall", rank,
+                        age_s=round(now - st.last_progress_at, 3),
+                        message=(
+                            f"rank {rank}: beats flowing but progress "
+                            f"frozen at step "
+                            f"{st.last_beat.get('global_step', 0)}"
+                        ),
+                    ))
+                    self._request_dump(rank)
+        self._check_stragglers()
+        # Abort deadline: measured from the moment a hang was first
+        # detected, cleared when every rank is healthy again.
+        if hang_live:
+            if self._hang_since is None:
+                self._hang_since = now
+            if (
+                cfg.abort_after_s is not None
+                and not self.aborted
+                and now - self._hang_since > cfg.abort_after_s
+            ):
+                self._abort(now)
+        else:
+            self._hang_since = None
+
+    def _check_stragglers(self) -> None:
+        live = [
+            (rank, st) for rank, st in self._ranks.items()
+            if st.last_beat and not st.done and not st.crash_bundle
+        ]
+        if len(live) < 2:
+            return
+        steps = [st.last_beat.get("global_step", 0) for _, st in live]
+        median = statistics.median(steps)
+        for rank, st in live:
+            lag = median - st.last_beat.get("global_step", 0)
+            if lag > self.config.straggler_lag_steps:
+                if not st.flagged_straggler:
+                    st.flagged_straggler = True
+                    self._record_event(make_event(
+                        "straggler", rank, lag_steps=int(lag),
+                        message=(
+                            f"rank {rank} lags the fleet median by "
+                            f"{int(lag)} steps"
+                        ),
+                    ))
+            else:
+                st.flagged_straggler = False
+
+    def _request_dump(self, rank: int) -> None:
+        if self._dump_cb is None:
+            return
+        try:
+            dump = self._dump_cb(rank) or {}
+        except Exception as e:  # noqa: BLE001 - a dead worker cannot dump
+            self._record_event(make_event(
+                "stack_dump", rank, error=f"dump failed: {e!r}",
+            ))
+            return
+        stacks = str(dump.get("stacks", ""))
+        if len(stacks) > _STACK_EVENT_CHAR_CAP:
+            stacks = stacks[:_STACK_EVENT_CHAR_CAP] + "\n…[truncated]"
+        event = make_event("stack_dump", rank, stacks=stacks)
+        mem = dump.get("device_memory")
+        if isinstance(mem, dict) and mem:
+            event["device_memory"] = mem
+        self._record_event(event)
+
+    def _abort(self, now: float) -> None:
+        self.aborted = True
+        suspects = sorted(
+            rank for rank, st in self._ranks.items()
+            if st.flagged_stalled or st.flagged_lost
+        )
+        self.abort_reason = (
+            f"hang persisted past abort_after_s="
+            f"{self.config.abort_after_s}s (suspect rank(s) {suspects})"
+        )
+        self._record_event(make_event(
+            "abort", suspects[0] if len(suspects) == 1 else -1,
+            message=self.abort_reason,
+        ))
+        if self._abort_cb is not None:
+            try:
+                self._abort_cb(self.abort_reason)
+            except Exception as e:  # noqa: BLE001 - the raise path still
+                # surfaces worker death; record that the abort misfired.
+                self._record_event(make_event(
+                    "abort", -1, error=f"abort callback failed: {e!r}",
+                ))
+
+    # -- surfaces -----------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """Picklable live view (rlt_top / prom / live.json)."""
+        now = self._now()
+        hang_s = self.config.hang_intervals * self.config.heartbeat_s
+        ranks = {}
+        for rank, st in sorted(self._ranks.items()):
+            entry = dict(st.last_beat)
+            entry.pop("type", None)
+            if st.last_beat_at is not None:
+                entry["age_s"] = round(now - st.last_beat_at, 3)
+            entry["status"] = st.status(now, hang_s)
+            if st.crash_bundle:
+                entry["bundle"] = st.crash_bundle
+            ranks[str(rank)] = entry
+        return {
+            "ts": time.time(),
+            "world_size": self.world_size,
+            "ranks_reporting": len(self._ranks),
+            "beats": self.beats_received,
+            "aborted": self.aborted,
+            "ranks": ranks,
+            "events": self.events[-50:],
+        }
+
+    def event_counts(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for e in self.events:
+            counts[e["kind"]] = counts.get(e["kind"], 0) + 1
+        return counts
+
+    def crash_bundles(self) -> List[str]:
+        """Flight-bundle paths reported by crashed ranks, rank order."""
+        return [
+            st.crash_bundle
+            for _, st in sorted(self._ranks.items())
+            if st.crash_bundle
+        ]
+
+    def last_heartbeat_age_s(self, rank: int) -> Optional[float]:
+        st = self._ranks.get(rank)
+        if st is None or st.last_beat_at is None:
+            return None
+        return round(self._now() - st.last_beat_at, 3)
+
+    def _maybe_export(self, now: float) -> None:
+        if now - self._last_live_write < self.config.live_every_s:
+            return
+        self._last_live_write = now
+        self._export()
+
+    def _export(self) -> None:
+        snap = None
+        if self.config.out_dir:
+            snap = self.snapshot()
+            try:
+                os.makedirs(self.config.out_dir, exist_ok=True)
+                path = os.path.join(self.config.out_dir, "live.json")
+                tmp = path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(snap, f, indent=2, default=str)
+                os.replace(tmp, path)
+            except OSError:
+                pass
+        if self._exporter is not None:
+            self._exporter.update(
+                snap or self.snapshot(), self.event_counts()
+            )
+
+    def report(self) -> Dict[str, Any]:
+        """The post-fit ``trainer.monitor_report`` payload."""
+        snap = self.snapshot()
+        report = {
+            "events": list(self.events),
+            "event_counts": self.event_counts(),
+            "ranks": snap["ranks"],
+            "beats": self.beats_received,
+            "aborted": self.aborted,
+            "crash_bundles": self.crash_bundles(),
+        }
+        if self.abort_reason:
+            report["abort_reason"] = self.abort_reason
+        logs = {
+            str(rank): list(st.logs)
+            for rank, st in sorted(self._ranks.items()) if st.logs
+        }
+        if logs:
+            report["logs"] = logs
+        return report
+
+    def finalize(self) -> Dict[str, Any]:
+        """Final export + exporter teardown; returns the report."""
+        self._export()
+        if self._exporter is not None:
+            self._exporter.close()
+            self._exporter = None
+        return self.report()

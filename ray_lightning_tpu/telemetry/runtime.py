@@ -44,13 +44,16 @@ class TelemetryConfig:
     ``sample_every`` is the ``block_until_ready`` cadence of the device
     -step sampling window; ``span_buffer`` bounds the span ring buffer;
     ``export_dir`` overrides where the full tier drops its artifacts
-    (default ``<default_root_dir>/telemetry``).
+    (default ``<default_root_dir>/telemetry``); ``heartbeat_s`` is the
+    live-heartbeat publish cadence (``telemetry/heartbeat.py`` — 0
+    disables the publisher, the tier gates it like everything else).
     """
 
     tier: str = "cheap"
     sample_every: int = 32
     span_buffer: int = 4096
     export_dir: Optional[str] = None
+    heartbeat_s: float = 5.0
 
     def __post_init__(self):
         if self.tier not in TIERS:
@@ -61,6 +64,8 @@ class TelemetryConfig:
             raise ValueError("sample_every must be >= 1")
         if self.span_buffer < 1:
             raise ValueError("span_buffer must be >= 1")
+        if self.heartbeat_s < 0:
+            raise ValueError("heartbeat_s must be >= 0 (0 = disabled)")
 
     @classmethod
     def coerce(cls, value: Any) -> "TelemetryConfig":
@@ -90,6 +95,9 @@ class TelemetryConfig:
         env_dir = os.environ.get("RLT_TELEMETRY_DIR")
         if env_dir and "export_dir" not in kw:
             kw["export_dir"] = env_dir
+        env_hb = os.environ.get("RLT_HEARTBEAT_S")
+        if env_hb and "heartbeat_s" not in kw:
+            kw["heartbeat_s"] = float(env_hb)
         return cls(**kw)
 
 

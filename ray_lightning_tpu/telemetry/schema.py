@@ -1,12 +1,15 @@
 """Telemetry artifact schemas + validators (the drift gate).
 
-Three artifact families leave this subsystem: JSONL span dumps, Chrome
-``trace_event`` documents, and the ``telemetry`` block inside
-``BENCH_*.json``.  Downstream consumers (Perfetto, the trace-summary
-tool, round-over-round bench comparison) parse them long after the
-producing code has moved on — so the schema is written down HERE, and
-``tools/check_telemetry_schema.py`` (wired into ``format.sh``) fails
-fast when a producer drifts.
+Artifact families leaving this subsystem: JSONL span dumps, Chrome
+``trace_event`` documents, the ``telemetry`` block inside
+``BENCH_*.json``, and — since the live-monitor round — the stream items
+the worker→driver queue carries (``heartbeat``, ``event``, ``log``,
+``metrics``) plus the crash flight bundle ``flight_recorder.py``
+persists.  Downstream consumers (Perfetto, the trace-summary tool,
+``rlt_top``, round-over-round bench comparison, post-mortem tooling)
+parse them long after the producing code has moved on — so the schema
+is written down HERE, and ``tools/check_telemetry_schema.py`` (wired
+into ``format.sh``) fails fast when a producer drifts.
 
 Validators return a list of problem strings (empty = valid) instead of
 raising, so the CLI can report every problem in one pass.  jax-free.
@@ -21,6 +24,12 @@ __all__ = [
     "validate_span_jsonl",
     "validate_chrome_trace",
     "validate_bench_telemetry",
+    "validate_heartbeat",
+    "validate_event",
+    "validate_log_item",
+    "validate_stream_item",
+    "validate_flight_bundle",
+    "FLIGHT_BUNDLE_SCHEMA_ID",
 ]
 
 # JSONL span schema: required key → allowed types.
@@ -125,6 +134,156 @@ def validate_chrome_trace(doc: Any, where: str = "trace") -> List[str]:
     return problems
 
 
+# ---------------------------------------------------------------------------
+# Live-monitor stream items (the worker→driver queue wire format)
+# ---------------------------------------------------------------------------
+
+# Heartbeat: the compact per-rank liveness/progress record the
+# HeartbeatPublisher enqueues every RLT_HEARTBEAT_S seconds.
+_HEARTBEAT_REQUIRED = {
+    "type": str,          # always "heartbeat"
+    "rank": int,
+    "seq": int,           # per-publisher monotonic counter
+    "ts": (int, float),   # wall-clock (time.time) at compose
+    "global_step": int,
+    "micro_step": int,
+    "epoch": int,
+    "progress": int,      # loop progress counter (train + val batches)
+    "phase": str,         # coarse loop phase: init/train/validation/closing
+}
+_HEARTBEAT_OPTIONAL = {
+    "step_time_ms": (int, float),
+    "data_wait_ms": (int, float),
+    "examples_per_sec": (int, float),
+    "open_span": str,            # deepest open span (full tier only)
+    "device_memory": dict,       # jax memory_stats subset, best-effort
+    "host_load": (int, float),   # 1-minute load average
+    "done": bool,                # final beat before the publisher stops
+}
+
+# Event: structured monitor/worker occurrences (stall, stack_dump,
+# heartbeat_lost, straggler, crash, abort).  rank == -1 means fleet-wide.
+_EVENT_REQUIRED = {
+    "type": str,          # always "event"
+    "kind": str,
+    "rank": int,
+    "ts": (int, float),
+}
+_EVENT_OPTIONAL = {
+    "message": str,
+    "stacks": str,        # formatted py-stack dump (stack_dump events)
+    "bundle": str,        # flight-bundle path (crash events)
+    "error": str,
+    "lag_steps": int,
+    "age_s": (int, float),
+    "device_memory": dict,
+    "detail": dict,
+}
+
+# Log: a rank-tagged forwarded logging record (warning+ severity).
+_LOG_REQUIRED = {
+    "type": str,          # always "log"
+    "rank": int,
+    "ts": (int, float),
+    "level": str,
+    "logger": str,
+    "message": str,
+}
+
+FLIGHT_BUNDLE_SCHEMA_ID = "rlt-flight-bundle-v1"
+
+# Crash flight bundle: the post-mortem document flight_recorder.py
+# persists under the telemetry dir on uncaught worker exceptions.
+_BUNDLE_REQUIRED = {
+    "schema": str,        # FLIGHT_BUNDLE_SCHEMA_ID
+    "rank": int,
+    "ts": (int, float),
+    "error": str,         # repr of the exception
+    "traceback": str,
+    "global_step": int,
+    "micro_step": int,
+    "epoch": int,
+    "phase": str,
+    "fingerprint": dict,  # env/device identity (python, jax, RLT_* knobs)
+}
+_BUNDLE_OPTIONAL = {
+    "spans": list,        # last-N span dicts from the ring
+    "step_stats": dict,
+    "counters": dict,
+    "logs": list,         # ring-buffered rank-tagged log lines
+    "device_memory": dict,
+    "stacks": str,        # all-thread py stacks at crash time
+}
+
+
+def _validate_typed(obj: Any, expect_type: str, required: dict,
+                    optional: dict, where: str) -> List[str]:
+    problems = _check_fields(obj, required, optional, where)
+    if not problems and obj.get("type") != expect_type:
+        problems.append(
+            f"{where}: type is {obj['type']!r}, expected {expect_type!r}"
+        )
+    return problems
+
+
+def validate_heartbeat(item: Any, where: str = "heartbeat") -> List[str]:
+    problems = _validate_typed(
+        item, "heartbeat", _HEARTBEAT_REQUIRED, _HEARTBEAT_OPTIONAL, where
+    )
+    if not problems:
+        for key in ("seq", "global_step", "micro_step", "progress"):
+            if item[key] < 0:
+                problems.append(f"{where}: negative {key} {item[key]}")
+    return problems
+
+
+def validate_event(item: Any, where: str = "event") -> List[str]:
+    problems = _validate_typed(
+        item, "event", _EVENT_REQUIRED, _EVENT_OPTIONAL, where
+    )
+    if not problems and item["rank"] < -1:
+        problems.append(f"{where}: invalid rank {item['rank']}")
+    return problems
+
+
+def validate_log_item(item: Any, where: str = "log") -> List[str]:
+    return _validate_typed(item, "log", _LOG_REQUIRED, {}, where)
+
+
+def validate_stream_item(item: Any, where: str = "item") -> List[str]:
+    """Dispatch on ``item["type"]`` — the one entry point for consumers
+    that see the raw queue stream (``metrics`` items are loop-internal
+    and intentionally not schema-pinned here beyond the type routing)."""
+    if not isinstance(item, dict):
+        return [f"{where}: expected object, got {type(item).__name__}"]
+    kind = item.get("type")
+    if kind == "heartbeat":
+        return validate_heartbeat(item, where)
+    if kind == "event":
+        return validate_event(item, where)
+    if kind == "log":
+        return validate_log_item(item, where)
+    if kind == "metrics":
+        return []
+    return [f"{where}: unknown stream item type {kind!r}"]
+
+
+def validate_flight_bundle(doc: Any, where: str = "bundle") -> List[str]:
+    problems = _check_fields(
+        doc, _BUNDLE_REQUIRED, _BUNDLE_OPTIONAL, where
+    )
+    if problems:
+        return problems
+    if doc["schema"] != FLIGHT_BUNDLE_SCHEMA_ID:
+        problems.append(
+            f"{where}: schema is {doc['schema']!r}, expected "
+            f"{FLIGHT_BUNDLE_SCHEMA_ID!r}"
+        )
+    for i, span in enumerate(doc.get("spans", [])):
+        problems += validate_span(span, f"{where}.spans[{i}]")
+    return problems
+
+
 # The bench telemetry block contract: BENCH_*.json rounds become
 # machine-comparable only if every round spells these the same way.
 _BENCH_REQUIRED = {
@@ -132,6 +291,8 @@ _BENCH_REQUIRED = {
 }
 _BENCH_OPTIONAL = {
     "overhead_pct": (int, float, type(None)),
+    "heartbeat_overhead_pct": (int, float, type(None)),
+    "monitor_events": int,
     "report": dict,
     "headline": dict,
     "probe": dict,

@@ -83,12 +83,15 @@ class _SpanCtx:
         stack = self._tracer._stack()
         self._depth = len(stack)
         stack.append(self._name)
+        self._tracer.open_span = self._name
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
         t1 = time.perf_counter()
-        self._tracer._stack().pop()
+        stack = self._tracer._stack()
+        stack.pop()
+        self._tracer.open_span = stack[-1] if stack else None
         self._tracer.record(
             self._name, self._t0, t1 - self._t0,
             depth=self._depth, args=self._args,
@@ -114,6 +117,11 @@ class SpanTracer:
         self._buf: collections.deque = collections.deque(maxlen=maxlen)
         self._recorded = 0
         self._local = threading.local()
+        # Name of the deepest currently-open span (last writer wins
+        # across threads).  Exists so the heartbeat publisher — a
+        # DIFFERENT thread, which cannot see the thread-local stack —
+        # can report what phase the loop is inside right now.
+        self.open_span: Optional[str] = None
 
     def _stack(self) -> List[str]:
         stack = getattr(self._local, "stack", None)

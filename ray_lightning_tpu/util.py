@@ -9,12 +9,16 @@ While worker actors run the fit loop, the driver sits in
    context* (the Tune-report indirection, reference ``tune.py:130-134``:
    ``tune.report`` only works inside the Tune session process);
 2. poll worker futures so a worker crash surfaces immediately as an
-   exception instead of a hang (reference ``util.py:55-68``).
+   exception instead of a hang (reference ``util.py:55-68``);
+3. run the ``on_tick`` hook between drains — the RunMonitor's watchdog
+   heartbeat-age/stall checks happen here, because a *hung* fleet sends
+   no items to react to (``telemetry/monitor.py``).
 """
 
 from __future__ import annotations
 
 import time
+import warnings
 from typing import Any, Callable, List, Optional, Sequence
 
 from .cluster.queue import DriverQueue
@@ -36,7 +40,25 @@ def _drain_queue(queue: Optional[DriverQueue], on_item: Optional[Callable]) -> N
         item = queue.get_nowait()
         result = handle_queue_item(item)
         if on_item is not None and not callable(item):
-            on_item(result)
+            # A raising observer must not abandon the pump: the futures
+            # (and the fit result riding them) are still out there, and
+            # bailing here would leak the workers AND drop the result.
+            try:
+                on_item(result)
+            except Exception as e:  # noqa: BLE001 - observer, not owner
+                warnings.warn(
+                    f"stream-item callback failed ({e!r}); item dropped"
+                )
+
+
+def _safe_tick(on_tick: Optional[Callable[[], None]]) -> None:
+    if on_tick is None:
+        return
+    try:
+        on_tick()
+    except Exception as e:  # noqa: BLE001 - monitoring must never cost
+        # the fit result.
+        warnings.warn(f"pump tick callback failed ({e!r})")
 
 
 def process_results(
@@ -44,6 +66,7 @@ def process_results(
     queue: Optional[DriverQueue] = None,
     poll_interval_s: float = 0.1,
     on_item: Optional[Callable[[Any], None]] = None,
+    on_tick: Optional[Callable[[], None]] = None,
 ) -> List[Any]:
     """Block until all worker futures resolve, pumping the queue meanwhile.
 
@@ -51,10 +74,15 @@ def process_results(
     reference where ``ray.get`` re-raises worker errors and crashes fit —
     SURVEY §5 "failure detection").  Before raising, the queue is drained a
     final time so late metrics/thunks are not lost.
+
+    ``on_item`` observes non-thunk items; ``on_tick`` runs once per poll
+    iteration.  Both are *observers*: an exception from either is warned
+    about and swallowed — the fit result must survive a broken callback.
     """
     futures = list(futures)
     while True:
         _drain_queue(queue, on_item)
+        _safe_tick(on_tick)
         done = [f for f in futures if f.done()]
         # Fail fast: one dead worker must raise immediately — its peers may
         # be blocked inside a collective waiting for it and will never

@@ -219,6 +219,73 @@ class TestDriverQueue:
 
 
 class TestProcessResults:
+    def test_pump_callback_raising_keeps_fit_result(self):
+        """A raising on_item observer must neither deadlock the pump
+        nor drop the futures' results (satellite: driver resilience)."""
+        q = DriverQueue()
+        a = ProcessActor(name="raising-pump-actor")
+        seen = []
+
+        def bad_observer(item):
+            seen.append(item)
+            raise RuntimeError("observer blew up")
+
+        try:
+            fut = a.submit(_put_through_queue, q.handle, 3)
+            with pytest.warns(UserWarning, match="stream-item callback"):
+                out = process_results([fut], q, on_item=bad_observer)
+            assert out == ["done"]
+            assert seen == [{"step": i} for i in range(3)]
+        finally:
+            a.kill()
+            q.shutdown()
+
+    def test_pump_tick_callback_raising_is_survived(self):
+        q = DriverQueue()
+        a = ProcessActor(name="tick-actor")
+
+        def bad_tick():
+            raise ValueError("tick broke")
+
+        try:
+            fut = a.submit(_add, 2, 2)
+            with pytest.warns(UserWarning, match="tick callback"):
+                assert process_results([fut], q, on_tick=bad_tick) == [4]
+        finally:
+            a.kill()
+            q.shutdown()
+
+    def test_multi_rank_producers_exactly_once_under_pump(self):
+        """3 worker processes streaming concurrently while the driver
+        pumps: every item arrives exactly once, in per-rank order, even
+        with an observer that raises on some items."""
+        q = DriverQueue()
+        actors = [
+            ProcessActor(name=f"mp-producer-{i}") for i in range(3)
+        ]
+        got = []
+
+        def observer(item):
+            got.append(item)
+            if item["step"] % 5 == 0:
+                raise RuntimeError("selective observer failure")
+
+        try:
+            futures = [
+                a.submit(_put_through_queue, q.handle, 20) for a in actors
+            ]
+            out = process_results(futures, q, on_item=observer)
+            assert out == ["done"] * 3
+            assert len(got) == 60
+            # per-producer FIFO survives the concurrency
+            assert sorted(i["step"] for i in got) == sorted(
+                list(range(20)) * 3
+            )
+        finally:
+            for a in actors:
+                a.kill()
+            q.shutdown()
+
     def test_pump_drains_queue_and_returns_results(self):
         q = DriverQueue()
         a = ProcessActor(name="pump-actor")

@@ -2,14 +2,17 @@
 
 Two passes, both fast and dependency-free beyond the package itself:
 
-1. **self-test** — build a real ``SpanTracer``, record nested spans,
-   export JSONL + Chrome trace to a temp dir, and validate both through
-   ``telemetry/schema.py``.  If a producer and the written-down schema
-   drift apart, this fails before any artifact ships;
+1. **self-test** — drive the REAL producers (``SpanTracer`` exports,
+   ``heartbeat.make_beat``, ``monitor.make_event``, a
+   ``FlightRecorder`` crash bundle, ``logs.make_log_item``) and
+   validate their output through ``telemetry/schema.py``.  If a
+   producer and the written-down schema drift apart, this fails before
+   any artifact ships;
 2. **artifact scan** — validate the ``telemetry`` block of every
    ``BENCH_*.json`` in the repo root (absent blocks are fine —
-   pre-telemetry rounds legitimately lack them) and any span/trace
-   exports passed as arguments.
+   pre-telemetry rounds legitimately lack them), the committed flight-
+   bundle fixture (``tests/data/flight_bundle.json``), and any
+   span/trace/bundle files passed as arguments.
 
 Exit code 0 = all schemas hold.
 """
@@ -28,11 +31,28 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from ray_lightning_tpu.telemetry.schema import (  # noqa: E402
     validate_bench_telemetry,
     validate_chrome_trace,
+    validate_flight_bundle,
     validate_span_jsonl,
+    validate_stream_item,
 )
 from ray_lightning_tpu.telemetry.spans import SpanTracer  # noqa: E402
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE_BUNDLE = os.path.join(
+    REPO_ROOT, "tests", "data", "flight_bundle.json"
+)
+
+
+class _StubCtx:
+    """Loop-context stand-in: the live-plane producers are duck-typed
+    over these fields exactly so this gate stays jax-free."""
+
+    global_step = 3
+    micro_step = 7
+    current_epoch = 1
+    progress = 9
+    phase = "train"
+    telemetry_dir = None
 
 
 def self_test() -> list:
@@ -54,7 +74,58 @@ def self_test() -> list:
             problems += validate_chrome_trace(
                 json.load(f), "self-test chrome"
             )
+        problems += _self_test_live_plane(tmp)
     return problems
+
+
+def _self_test_live_plane(tmp: str) -> list:
+    """Heartbeat/event/log producers + a real crash bundle."""
+    from ray_lightning_tpu.telemetry.flight_recorder import FlightRecorder
+    from ray_lightning_tpu.telemetry.heartbeat import make_beat
+    from ray_lightning_tpu.telemetry.logs import make_log_item
+    from ray_lightning_tpu.telemetry.monitor import make_event
+
+    problems = []
+    ctx = _StubCtx()
+    beat = make_beat(rank=0, seq=1, ctx=ctx)
+    problems += validate_stream_item(beat, "self-test heartbeat")
+    final = make_beat(rank=0, seq=2, ctx=ctx, done=True)
+    problems += validate_stream_item(final, "self-test final heartbeat")
+    problems += validate_stream_item(
+        make_event("stall", 2, age_s=1.5, message="self-test"),
+        "self-test event",
+    )
+    problems += validate_stream_item(
+        make_log_item(0, "WARNING", "self.test", "hello"),
+        "self-test log",
+    )
+    rec = FlightRecorder(rank=0, out_dir=tmp, ctx=ctx)
+    try:
+        raise ValueError("self-test crash")
+    except ValueError as err:
+        path = rec.record_crash(err)
+    if path is None:
+        problems.append("self-test bundle: recorder wrote nothing")
+    else:
+        with open(path) as f:
+            problems += validate_flight_bundle(
+                json.load(f), "self-test bundle"
+            )
+    return problems
+
+
+def scan_fixture_bundle() -> list:
+    """The committed fixture keeps the validator honest against a
+    full-featured bundle (spans, logs, counters) without needing a
+    crash to reproduce one."""
+    if not os.path.exists(FIXTURE_BUNDLE):
+        return [f"missing fixture {os.path.relpath(FIXTURE_BUNDLE, REPO_ROOT)}"]
+    try:
+        with open(FIXTURE_BUNDLE) as f:
+            doc = json.load(f)
+    except ValueError as e:
+        return [f"flight_bundle.json: not JSON ({e})"]
+    return validate_flight_bundle(doc, "fixture flight_bundle.json")
 
 
 def scan_bench_files() -> list:
@@ -80,11 +151,27 @@ def scan_paths(paths) -> list:
         name = os.path.basename(path)
         try:
             if path.endswith(".jsonl"):
+                # Span dumps and heartbeat streams are both JSONL;
+                # route on content.
                 with open(path) as f:
-                    problems += validate_span_jsonl(f.readlines(), name)
+                    lines = f.readlines()
+                first = json.loads(lines[0]) if lines else {}
+                if isinstance(first, dict) and "type" in first:
+                    for i, line in enumerate(lines):
+                        line = line.strip()
+                        if line:
+                            problems += validate_stream_item(
+                                json.loads(line), f"{name}:{i + 1}"
+                            )
+                else:
+                    problems += validate_span_jsonl(lines, name)
             else:
                 with open(path) as f:
-                    problems += validate_chrome_trace(json.load(f), name)
+                    doc = json.load(f)
+                if isinstance(doc, dict) and "schema" in doc:
+                    problems += validate_flight_bundle(doc, name)
+                else:
+                    problems += validate_chrome_trace(doc, name)
         except (OSError, ValueError) as e:
             problems.append(f"{name}: unreadable ({e})")
     return problems
@@ -93,13 +180,16 @@ def scan_paths(paths) -> list:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="Validate telemetry artifact schemas "
-        "(span JSONL, Chrome traces, BENCH_*.json telemetry blocks)."
+        "(span/heartbeat JSONL, Chrome traces, flight bundles, "
+        "BENCH_*.json telemetry blocks)."
     )
     ap.add_argument("paths", nargs="*",
-                    help="extra span .jsonl / chrome .json files to check")
+                    help="extra span/heartbeat .jsonl, chrome .json or "
+                    "flight-bundle .json files to check")
     args = ap.parse_args(argv)
 
-    problems = self_test() + scan_bench_files() + scan_paths(args.paths)
+    problems = (self_test() + scan_bench_files() + scan_fixture_bundle()
+                + scan_paths(args.paths))
     if problems:
         for p in problems:
             print(f"check_telemetry_schema: {p}", file=sys.stderr)

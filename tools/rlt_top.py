@@ -1,0 +1,161 @@
+"""rlt_top — curses-free terminal live view of a run's heartbeat stream.
+
+Reads either artifact the live plane produces (docs/OBSERVABILITY.md):
+
+* ``live.json`` — the RunMonitor's driver-side snapshot (remote
+  strategies; refreshed ~1/s under ``<root>/telemetry/``);
+* ``heartbeats-rank<k>.jsonl`` — a worker/local fit's raw beat stream
+  (queue-less LocalStrategy runs; pass the file or the telemetry dir).
+
+Renders a per-rank table (step, progress, step/data-wait ms, heartbeat
+age, phase, status) plus the monitor's recent events, repainted with
+plain ANSI — no curses, works in any terminal or ``watch``-style log.
+
+Usage:
+    python tools/rlt_top.py rlt_logs/telemetry           # auto-detect
+    python tools/rlt_top.py rlt_logs/telemetry/live.json --interval 2
+    python tools/rlt_top.py --once rlt_logs/telemetry    # single frame
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, Optional
+
+_CLEAR = "\x1b[H\x1b[2J"
+
+
+def _load_live_json(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _load_beats_jsonl(paths) -> Optional[Dict[str, Any]]:
+    """Synthesize a live-snapshot-shaped dict from raw beat streams."""
+    ranks: Dict[str, Dict[str, Any]] = {}
+    now = time.time()
+    for path in paths:
+        last = None
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        last = line
+        except OSError:
+            continue
+        if not last:
+            continue
+        try:
+            beat = json.loads(last)
+        except ValueError:
+            continue
+        beat.pop("type", None)
+        beat["age_s"] = round(now - beat.get("ts", now), 1)
+        beat["status"] = "done" if beat.get("done") else "ok"
+        ranks[str(beat.get("rank", 0))] = beat
+    if not ranks:
+        return None
+    return {"ts": now, "ranks_reporting": len(ranks), "ranks": ranks,
+            "events": [], "aborted": False,
+            "beats": sum(r.get("seq", 0) for r in ranks.values())}
+
+
+def load_snapshot(path: str) -> Optional[Dict[str, Any]]:
+    """live.json file, a beats .jsonl, or a directory holding either."""
+    if os.path.isdir(path):
+        live = os.path.join(path, "live.json")
+        if os.path.exists(live):
+            return _load_live_json(live)
+        return _load_beats_jsonl(
+            sorted(glob.glob(os.path.join(path, "heartbeats-rank*.jsonl")))
+        )
+    if path.endswith(".jsonl"):
+        return _load_beats_jsonl([path])
+    return _load_live_json(path)
+
+
+def _fmt(value: Any, width: int) -> str:
+    if value is None:
+        text = "-"
+    elif isinstance(value, float):
+        text = f"{value:.1f}"
+    else:
+        text = str(value)
+    return text[:width].rjust(width)
+
+
+def render(snapshot: Optional[Dict[str, Any]], source: str) -> str:
+    """One text frame (pure function — tested directly)."""
+    stamp = time.strftime("%H:%M:%S")
+    if not snapshot:
+        return f"rlt_top {stamp} — no live data at {source} (yet?)\n"
+    lines = [
+        f"rlt_top {stamp} — {snapshot.get('ranks_reporting', 0)} rank(s), "
+        f"{snapshot.get('beats', 0)} beats"
+        + ("  ** ABORTED **" if snapshot.get("aborted") else ""),
+        "",
+        "rank   step   epoch  progress  step_ms  wait_ms   age_s  "
+        "phase       status",
+    ]
+    for rank in sorted(snapshot.get("ranks", {}), key=int):
+        b = snapshot["ranks"][rank]
+        lines.append(
+            f"{rank:>4}"
+            + _fmt(b.get("global_step"), 7)
+            + _fmt(b.get("epoch"), 7)
+            + _fmt(b.get("progress"), 9)
+            + _fmt(b.get("step_time_ms"), 9)
+            + _fmt(b.get("data_wait_ms"), 9)
+            + _fmt(b.get("age_s"), 8)
+            + "  " + str(b.get("phase", "-"))[:10].ljust(10)
+            + "  " + str(b.get("status", "-"))
+        )
+    events = snapshot.get("events") or []
+    if events:
+        lines += ["", "recent events:"]
+        for ev in events[-8:]:
+            msg = ev.get("message") or ev.get("error") or ev.get("bundle", "")
+            lines.append(
+                f"  [{ev.get('kind', '?'):<14}] rank {ev.get('rank')}: "
+                f"{msg}"[:110]
+            )
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Terminal live view of the rlt heartbeat stream."
+    )
+    ap.add_argument(
+        "path", nargs="?", default="rlt_logs/telemetry",
+        help="live.json, heartbeats-rank*.jsonl, or the telemetry dir",
+    )
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--once", action="store_true",
+                    help="render a single frame and exit")
+    args = ap.parse_args(argv)
+
+    try:
+        while True:
+            frame = render(load_snapshot(args.path), args.path)
+            if args.once:
+                sys.stdout.write(frame)
+                return 0
+            sys.stdout.write(_CLEAR + frame)
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
