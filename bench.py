@@ -222,6 +222,90 @@ def _heartbeat_overhead_pct(repeats: int = 3) -> float:
     return 100.0 * (beating - silent) / silent if silent else 0.0
 
 
+def _bench_fault_block() -> dict:
+    """Recovery-cost probes for the schema-gated ``fault`` block
+    (docs/FAULT_TOLERANCE.md): ``drain_checkpoint_s`` (step-granular
+    drain write on an inline fit), ``time_to_recover_s`` (deterministic
+    injected crash → training resumed, measured end-to-end as the wall
+    delta against the same fit without the crash — respawn, backoff,
+    checkpoint discovery and recompile all included), and ``backoff_s``
+    (the jittered delay the governor actually slept).  Every probe is
+    best-effort: a None field means the probe failed, never that the
+    bench lied."""
+    import tempfile
+
+    from ray_lightning_tpu.core.callbacks import Callback as _CB
+    from ray_lightning_tpu.fault import drain as drain_mod
+    from ray_lightning_tpu.fault.drain import PreemptedError
+    from ray_lightning_tpu.models.boring import (
+        BoringDataModule,
+        BoringModel,
+    )
+    from ray_lightning_tpu.parallel.strategies import RayStrategy
+
+    block: dict = {"drain_checkpoint_s": None, "time_to_recover_s": None,
+                   "backoff_s": None}
+
+    class _DrainAt(_CB):
+        def on_train_batch_end(self, trainer, module, logs, batch_idx):
+            if trainer.micro_step == 5:
+                drain_mod.request_drain("bench")
+
+    try:
+        with tempfile.TemporaryDirectory(prefix="rlt_bench_drain_") as d:
+            trainer = Trainer(
+                strategy=LocalStrategy(), max_epochs=2,
+                default_root_dir=d, limit_train_batches=8,
+                limit_val_batches=0, enable_checkpointing=False,
+                callbacks=[_DrainAt()],
+            )
+            try:
+                trainer.fit(BoringModel(), BoringDataModule(batch_size=16))
+            except PreemptedError as err:
+                if err.drain_s is not None:
+                    block["drain_checkpoint_s"] = round(err.drain_s, 4)
+    except Exception as e:  # noqa: BLE001 - probe must not cost the line
+        sys.stderr.write(f"drain probe skipped: {e}\n")
+
+    def _crash_fit(inject: bool) -> tuple:
+        with tempfile.TemporaryDirectory(prefix="rlt_bench_crash_") as d:
+            if inject:
+                os.environ["RLT_FAULT"] = "crash@step:3,rank:0"
+                os.environ["RLT_FAULT_STATE"] = os.path.join(d, "chaos")
+            try:
+                strategy = RayStrategy(
+                    num_workers=1, max_restarts=1, restart_backoff_s=0.1,
+                )
+                trainer = Trainer(
+                    strategy=strategy, max_epochs=3, default_root_dir=d,
+                    limit_train_batches=2, limit_val_batches=0,
+                    enable_checkpointing=False,
+                )
+                t0 = time.perf_counter()
+                trainer.fit(BoringModel(), BoringDataModule(batch_size=16))
+                wall = time.perf_counter() - t0
+                assert trainer.global_step == 6, trainer.global_step
+                return wall, strategy.recovery_events
+            finally:
+                os.environ.pop("RLT_FAULT", None)
+                os.environ.pop("RLT_FAULT_STATE", None)
+
+    try:
+        clean_wall, _ = _crash_fit(inject=False)
+        crash_wall, events = _crash_fit(inject=True)
+        block["time_to_recover_s"] = round(
+            max(crash_wall - clean_wall, 0.0), 3
+        )
+        backoff = next(
+            (e for e in events if e.get("kind") == "backoff"), None
+        )
+        if backoff is not None:
+            block["backoff_s"] = backoff.get("delay_s")
+    except Exception as e:  # noqa: BLE001
+        sys.stderr.write(f"recovery probe skipped: {e}\n")
+    return block
+
+
 def _bench_generate(module: GPT, cfg: GPTConfig, on_tpu: bool):
     """Greedy decode throughput (new tokens/s, whole batch) through the
     KV-cache generation path — f32/bf16 weights AND the int8-storage
@@ -358,6 +442,11 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - same discipline
         sys.stderr.write(f"heartbeat overhead probe skipped: {e}\n")
         hb_overhead_pct = None
+    try:
+        fault_block = _bench_fault_block()
+    except Exception as e:  # noqa: BLE001 - same discipline
+        sys.stderr.write(f"fault probes skipped: {e}\n")
+        fault_block = None
 
     peak = peak_flops_per_chip() if on_tpu else None
 
@@ -408,6 +497,10 @@ def main() -> None:
                 "counters": tel_report.get("counters", {}),
             },
         },
+        # Recovery cost in the perf trajectory (schema-gated like the
+        # telemetry block): injected-crash recovery wall time, drain-
+        # checkpoint write time, observed backoff delay.
+        "fault": fault_block,
         "windows": WINDOWS,
         "window_steps": WINDOW_STEPS,
         "bottleneck": "attention bwd kernel + scan residual-save HBM "

@@ -14,7 +14,12 @@
 #   3. yapf --diff/--in-place (pinned below, when importable) with the
 #      repo .style.yapf;
 #   4. telemetry artifact schema gate (tools/check_telemetry_schema.py,
-#      no deps beyond the package) — exporter/schema drift fails fast.
+#      no deps beyond the package) — exporter/schema drift fails fast;
+#   5. chaos-plane smoke (tools/chaos_sweep.py --selftest, no
+#      subprocesses/fits) — the RLT_FAULT grammar, deterministic
+#      matching, exactly-once markers and the file corruptors vs the
+#      checkpoint verifier.  The full fault matrix lives in
+#      "python tools/chaos_sweep.py" / "pytest -m chaos".
 # Missing optional tools are reported and skipped; the builtin layer
 # still gates, so "./format.sh --all" is meaningful everywhere.
 set -euo pipefail
@@ -105,6 +110,12 @@ fi
 # flight-bundle fixture (tests/data/flight_bundle.json), and BENCH_*.json
 # telemetry blocks (tools/check_telemetry_schema.py).
 python tools/check_telemetry_schema.py || fail=1
+
+# -- layer 5: chaos-plane smoke (zero extra deps, no subprocess fits) --------
+# Gates the fault-injection grammar + deterministic matching + the
+# corruptor/verifier pair, so a drifted RLT_FAULT parser can't silently
+# turn the recovery acceptance suite into a no-op.
+python tools/chaos_sweep.py --selftest || fail=1
 
 if [ $fail -ne 0 ]; then
   echo "format.sh: FAILED (run ./format.sh --fix after installing tools)"

@@ -58,6 +58,8 @@ __all__ = [
     "Unavailable",
     "to_state_stream",
     "load_state_stream",
+    "PreemptedError",
+    "CorruptCheckpointError",
 ]
 
 
@@ -84,4 +86,14 @@ def __getattr__(name):
         from ray_lightning_tpu.core import trainer as _trainer
 
         return {"Trainer": _trainer.Trainer, "TpuModule": _module.TpuModule}[name]
+    if name == "PreemptedError":
+        from ray_lightning_tpu.fault.drain import PreemptedError
+
+        return PreemptedError
+    if name == "CorruptCheckpointError":
+        from ray_lightning_tpu.utils.state_stream import (
+            CorruptCheckpointError,
+        )
+
+        return CorruptCheckpointError
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

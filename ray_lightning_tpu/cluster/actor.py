@@ -178,10 +178,55 @@ def _remote_dump_stacks() -> Dict[str, Any]:
     return out
 
 
+def _remote_request_drain() -> Dict[str, Any]:
+    """Control-lane drain request: the driver received the preemption
+    notice (or the user asked for a graceful stop) and tells this
+    worker to finish its in-flight step, checkpoint, and exit with
+    ``PreemptedError``.  Served on the control lane so it lands even
+    while the fit call is busy — that is the whole point."""
+    from ray_lightning_tpu.fault import drain
+
+    drain.request_drain("driver-request")
+    return {"pid": os.getpid(), "draining": True}
+
+
 _CONTROL_HANDLERS: Dict[str, Callable[..., Any]] = {
     "dump_stacks": _remote_dump_stacks,
     "ping": lambda: {"pid": os.getpid(), "ts": time.time()},
+    "drain": _remote_request_drain,
 }
+
+
+def _encode_call_error(exc: BaseException) -> Any:
+    """Error payload for the call lane: the formatted traceback, plus —
+    for the fault-plane's typed exceptions — the exception BY VALUE, so
+    the driver can catch ``PreemptedError`` as a type instead of
+    grepping a RemoteError string.  Arbitrary user exceptions stay
+    string-only (their classes may not exist driver-side)."""
+    tb = traceback.format_exc()
+    from ray_lightning_tpu.fault.drain import PreemptedError
+
+    if isinstance(exc, PreemptedError):
+        try:
+            return {"tb": tb, "exc": rpc.dumps(exc)}
+        except Exception:  # noqa: BLE001 - fall back to the string form
+            pass
+    return tb
+
+
+def _decode_call_error(actor_name: str, payload: Any) -> BaseException:
+    """Driver-side inverse of :func:`_encode_call_error`."""
+    if isinstance(payload, dict):
+        blob = payload.get("exc")
+        if blob is not None:
+            try:
+                exc = rpc.loads(blob)
+                exc.remote_traceback = payload.get("tb", "")
+                return exc
+            except Exception:  # noqa: BLE001 - unpicklable: degrade
+                pass
+        payload = payload.get("tb", "")
+    return RemoteError(actor_name, payload)
 
 
 def _child_main() -> None:
@@ -199,6 +244,14 @@ def _child_main() -> None:
     """
     host = sys.argv[1]
     port = int(sys.argv[2])
+    # Preemption-safe drain: SIGTERM/SIGINT during a fit become a drain
+    # request the loop honors at the next step boundary (fault/drain.py)
+    # instead of killing the process mid-collective.  Must happen here —
+    # signal handlers are only installable from the MAIN thread, and the
+    # fit runs on the call-worker thread.
+    from ray_lightning_tpu.fault import drain as _drain
+
+    _drain.install_signal_handlers()
     authkey = bytes.fromhex(sys.stdin.readline().strip())
     sock = socket.create_connection((host, port), timeout=60)
     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -224,8 +277,8 @@ def _child_main() -> None:
                 fn, args, kwargs = payload
                 result = fn(*args, **kwargs)
                 out = ("ok", call_id, result)
-            except BaseException:  # noqa: BLE001 - ship everything back
-                out = ("err", call_id, traceback.format_exc())
+            except BaseException as e:  # noqa: BLE001 - ship it all back
+                out = ("err", call_id, _encode_call_error(e))
             try:
                 reply(out)
             except (ConnectionError, OSError):
@@ -429,7 +482,7 @@ class ProcessActor:
             if status == "ok":
                 fut.set_result(payload)
             else:
-                fut.set_exception(RemoteError(self.name, payload))
+                fut.set_exception(_decode_call_error(self.name, payload))
 
     def _fail_all_pending(self) -> None:
         with self._lock:
@@ -516,6 +569,15 @@ class ProcessActor:
         """Py-stacks of every thread in the actor + device memory
         (``_remote_dump_stacks``) — works mid-call by design."""
         return self.control("dump_stacks", timeout=timeout)
+
+    def request_drain(self, wait: bool = False,
+                      timeout: Optional[float] = 10.0) -> Any:
+        """Ask the worker to gracefully drain its in-flight fit
+        (control lane — lands even mid-call).  ``wait=False`` returns
+        the pending Future so a driver-side preemption handler can fan
+        the request out to every worker without serializing on acks."""
+        fut = self._submit_msg("ctl", ("drain", {}), "ctl:drain")
+        return fut.result(timeout) if wait else fut
 
     # -- RayExecutor-parity conveniences ------------------------------------
     def set_env_vars(self, env: Dict[str, str]) -> None:

@@ -95,6 +95,12 @@ class QueueHandle:
         fatal for thunk items (a ``tune.report``/checkpoint lambda would
         execute twice driver-side).
         """
+        # Chaos injection point: a crash/hang on the queue send path
+        # exercises what a wedged control plane does to the fit (beats
+        # and metrics ride this same lane).
+        from ray_lightning_tpu.fault import inject as _chaos
+
+        _chaos.fire("queue_put")
         with self._lock:
             # Burn the seq up front: if both attempts fail after the server
             # already committed this frame (ack lost, then reconnect

@@ -92,6 +92,7 @@ class ModelCheckpoint(Callback):
         save_top_k: int = 1,
         every_n_epochs: int = 1,
         async_write: bool = False,
+        verify: bool = False,
     ):
         if mode not in ("min", "max"):
             raise ValueError(f"mode must be min|max, got {mode!r}")
@@ -106,6 +107,12 @@ class ModelCheckpoint(Callback):
         # fit joins pending writes at fit end, and pruning flushes
         # before deleting so it never races an in-flight write.
         self.async_write = async_write
+        # verify: read each written checkpoint back and check its crc
+        # frame (utils/state_stream.py) — catches a lying disk at write
+        # time, when the in-memory state still exists to re-save, rather
+        # than at the resume that needed it.  Costs a full file read per
+        # save; sync writes verify immediately, async ones at fit end.
+        self.verify = verify
         self.best_model_path: str = ""
         self.best_model_score: Optional[float] = None
         self._saved: list = []  # [(score, path)]
@@ -149,6 +156,7 @@ class ModelCheckpoint(Callback):
         else:
             # Sync, or a trainer facade without the async machinery.
             trainer.save_checkpoint(path)
+            self._verify_written(trainer, path)
         if score is None:
             # monitor=None ⇒ Lightning semantics: "best" is simply the most
             # recent; rank saves by recency (global_step, mode=max) so
@@ -193,6 +201,28 @@ class ModelCheckpoint(Callback):
                     except FileNotFoundError:
                         pass
         self._saved = [(s, p) for s, p in self._saved if p in keep]
+
+    def _verify_written(self, trainer, path: str) -> None:
+        """Post-write integrity read-back (``verify=True``, rank 0)."""
+        if not self.verify or not trainer.is_global_zero:
+            return
+        from ray_lightning_tpu.utils.sharded_ckpt import verify_checkpoint
+
+        problems = verify_checkpoint(path)
+        if problems:
+            raise RuntimeError(
+                f"checkpoint {path} failed post-write verification: "
+                + "; ".join(str(p) for p in problems)
+            )
+
+    def on_fit_end(self, trainer, module) -> None:
+        # Async writes were flushed by the loop just before this hook;
+        # verify the surviving files now that their bytes are durable.
+        if not (self.verify and self.async_write):
+            return
+        for _, path in self._saved:
+            if os.path.exists(path):
+                self._verify_written(trainer, path)
 
     def state_dict(self) -> Dict[str, Any]:
         return {

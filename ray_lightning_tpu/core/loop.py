@@ -27,6 +27,9 @@ import numpy as np
 from ray_lightning_tpu.core.callbacks import Callback, ModelCheckpoint
 from ray_lightning_tpu.core.data import TpuDataModule
 from ray_lightning_tpu.core.module import TpuModule, TrainState
+from ray_lightning_tpu.fault import drain as drain_mod
+from ray_lightning_tpu.fault import inject as chaos
+from ray_lightning_tpu.fault.drain import PreemptedError
 from ray_lightning_tpu.parallel import sharding as shardlib
 from ray_lightning_tpu.parallel import step_fns
 from ray_lightning_tpu.telemetry import Telemetry
@@ -383,6 +386,71 @@ def _mesh_barrier(mesh) -> None:
     assert int(jax.device_get(total)) == n
 
 
+def _make_drain_poll(mesh, world_size: int):
+    """Mesh-coordinated drain agreement (the Orbax-style preemption
+    sync point): every process contributes its local drain flag to a
+    tiny all-reduce, so ALL ranks decide to drain at the SAME step —
+    a rank draining alone would tear the sharded drain checkpoint and
+    deadlock its peers' next collective.
+
+    Single-process fits return ``None`` (the local flag IS the global
+    flag — zero overhead on the bench path).  The jitted reduction is
+    built once and reused every step; per-step cost is one scalar-ish
+    collective dispatch.
+    """
+    if mesh is None or world_size <= 1:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = len(mesh.devices.flat)
+    sharded = NamedSharding(mesh, P(mesh.axis_names))
+    total = jax.jit(
+        jnp.sum, in_shardings=(sharded,),
+        out_shardings=NamedSharding(mesh, P()),
+    )
+
+    def _shard_block(index) -> np.ndarray:
+        s = index[0]
+        start = 0 if s.start is None else s.start
+        stop = n if s.stop is None else s.stop
+        return _flag_box[0][: stop - start]
+
+    _flag_box = [np.zeros((n,), np.int32)]
+
+    def poll(local: bool) -> bool:
+        _flag_box[0] = np.full((n,), 1 if local else 0, np.int32)
+        arr = jax.make_array_from_callback((n,), sharded, _shard_block)
+        return int(jax.device_get(total(arr))) > 0
+
+    return poll
+
+
+def _prune_restart_dir(restart_dir: str, keep: int = 2) -> None:
+    """Keep the ``keep`` newest COMPLETE restart/drain checkpoints.
+
+    Two, not one: previous-good fallback (restart discovery walks back
+    over a corrupt newest checkpoint) is only possible if the previous
+    checkpoint still exists — keeping exactly the newest would convert
+    one bit flip into a from-scratch restart.  Candidate enumeration
+    and ordering are SHARED with restart discovery
+    (``sharded_ckpt.list_restart_candidates``) so pruning can never
+    delete what discovery would have resumed from.
+    """
+    from ray_lightning_tpu.utils.sharded_ckpt import (
+        list_restart_candidates,
+    )
+
+    import shutil
+
+    for _, _, _, stale in list_restart_candidates(restart_dir)[keep:]:
+        shutil.rmtree(stale, ignore_errors=True)
+        if os.path.isfile(stale):  # legacy single-file
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
+
+
 def _build_accum_flush(inner_tx, mesh, state_shardings):
     """Compile the partial-accumulation flush: one optimizer update from
     ``MultiStepsState.acc_grads`` (the running MEAN of the window's
@@ -722,8 +790,50 @@ def run_fit(
     happened remotely).  Every rank's package additionally carries its
     telemetry snapshot, so the driver can build the fleet-wide skew view
     (``trainer.telemetry_report``) — not just rank-0's numbers.
+
+    Preemption (SIGTERM/SIGINT, a driver drain request, or the chaos
+    plane's ``sigterm`` fault) does not crash the fit: the loop finishes
+    the in-flight step, writes a step-granular drain checkpoint
+    (``drain-step-*.ckpt``, sharded) and raises :class:`PreemptedError`
+    — which the strategy converts into a budget-free elastic restart or
+    a clean resumable raise (docs/FAULT_TOLERANCE.md).
     """
     _enable_compile_cache()
+    # Graceful-drain arming: clear any previous fit's flag (inline
+    # strategies run many fits per process), mark a fit as in flight so
+    # SIGTERM means "drain" rather than "exit", and — on the driver's
+    # main thread only; worker children install theirs in _child_main —
+    # take over the signal handlers for the duration of the fit.
+    drain_mod.reset_drain()
+    drain_mod.set_fit_active(True)
+    _signals_installed = drain_mod.install_signal_handlers()
+    chaos.set_rank(global_rank)
+    try:
+        return _run_fit_inner(
+            module, datamodule, config, callbacks, global_rank,
+            world_size, mesh, mode, zero_stage, grad_comm, telemetry,
+            queue,
+        )
+    finally:
+        drain_mod.set_fit_active(False)
+        if _signals_installed:
+            drain_mod.uninstall_signal_handlers()
+
+
+def _run_fit_inner(
+    module: TpuModule,
+    datamodule: TpuDataModule,
+    config: FitConfig,
+    callbacks: List[Callback],
+    global_rank: int,
+    world_size: int,
+    mesh,
+    mode: str,
+    zero_stage: int,
+    grad_comm,
+    telemetry,
+    queue,
+) -> Dict[str, Any]:
     tx = module.configure_optimizers()
     # configure_optimizers may return (tx, lr_schedule); careful — a bare
     # optax.GradientTransformation is itself a NamedTuple, so test for the
@@ -827,6 +937,7 @@ def run_fit(
             state, state_shardings
         )
     start_epoch = 0
+    resume_skip_batches = 0
     if config.resume_from_checkpoint:
         from ray_lightning_tpu.utils import sharded_ckpt
 
@@ -874,7 +985,15 @@ def run_fit(
             state = jax.device_put(host_state)
         else:
             state = jax.device_put(host_state, state_shardings)
-        start_epoch = payload["epoch"] + 1
+        if payload.get("mid_epoch"):
+            # Step-granular drain checkpoint: resume INSIDE the epoch it
+            # was written in, skipping the micro-batches already trained
+            # (loaders are epoch-seeded, so the order replays exactly).
+            start_epoch = payload["epoch"]
+            resume_skip_batches = int(payload.get("batch_in_epoch", 0))
+        else:
+            start_epoch = payload["epoch"] + 1
+            resume_skip_batches = 0
         # If the checkpoint already covers max_epochs the loop body never
         # runs; current_epoch must still report the work as done.
         ctx.current_epoch = max(start_epoch - 1, 0)
@@ -919,6 +1038,131 @@ def run_fit(
     train_loader = datamodule.train_dataloader()
     stop = False
     flush_step = None  # built lazily on the first partial-window flush
+    # Preemption plumbing: the coordinated drain-agreement collective
+    # (multi-process meshes only — None is the zero-overhead local path)
+    # and the drain finish-line itself.
+    drain_poll = _make_drain_poll(mesh, world_size)
+
+    def _graceful_drain(mid_epoch: bool, batch_in_epoch: int):
+        """Preemption finish-line: write the step-granular sharded
+        drain checkpoint, retire the live plane with an orderly final
+        beat, and exit with the distinguished PreemptedError the
+        strategy converts into a budget-free restart or a clean raise.
+        COLLECTIVE on multi-host meshes (save_shard + barrier) — only
+        reached after every rank agreed to drain at this same step."""
+        from ray_lightning_tpu.utils import sharded_ckpt
+
+        ctx.phase = "draining"
+        reason = drain_mod.drain_reason() or "requested"
+        drain_dir = config.restart_dir or os.path.join(
+            config.default_root_dir, "preempt"
+        )
+        tag = os.path.join(
+            drain_dir, f"drain-step-{ctx.micro_step:08d}.ckpt"
+        )
+        t0 = time.perf_counter()
+        ckpt_path = None
+        write_err = None
+        try:
+            ctx.flush_checkpoints()
+            sharded_ckpt.save_shard(
+                ctx.state, tag, global_rank, world_size
+            )
+        except Exception as e:  # noqa: BLE001 - the checkpoint is
+            # sacrificed, never the drain itself
+            write_err = e
+        # EVERY rank reaches the barrier, write success or not: a rank
+        # skipping it (its disk filled, say) would strand its peers in
+        # the collective for the whole grace window.  A failed shard
+        # write still yields a META'd-but-incomplete checkpoint, which
+        # restart discovery's verification walks past by design.
+        try:
+            _mesh_barrier(mesh)
+        except Exception as e:  # noqa: BLE001 - a peer died mid-drain
+            write_err = write_err or e
+        if write_err is None:
+            try:
+                if ctx.is_global_zero:
+                    sharded_ckpt.save_meta(
+                        ctx.state, tag, world_size,
+                        extra={
+                            "epoch": ctx.current_epoch,
+                            "global_step": ctx.global_step,
+                            "micro_step": ctx.micro_step,
+                            "mid_epoch": mid_epoch,
+                            "batch_in_epoch": batch_in_epoch,
+                            "drain_reason": reason,
+                            "callback_metrics": dict(
+                                ctx.callback_metrics
+                            ),
+                            "callback_states": [
+                                cb.state_dict() for cb in callbacks
+                            ],
+                        },
+                    )
+                ckpt_path = tag
+            except Exception as e:  # noqa: BLE001
+                write_err = e
+        if write_err is not None:
+            import warnings
+
+            warnings.warn(f"drain checkpoint write failed ({write_err!r})")
+        drain_s = round(time.perf_counter() - t0, 4)
+        tel.set_counter("drain_checkpoint_s", drain_s)
+        if queue is not None:
+            try:
+                queue.put({
+                    "type": "event", "kind": "drain",
+                    "rank": global_rank, "ts": time.time(),
+                    "message": (
+                        f"rank {global_rank} drained on {reason} at "
+                        f"micro_step {ctx.micro_step}"
+                    ),
+                    "ckpt": ckpt_path or "",
+                })
+            except Exception:  # noqa: BLE001 - queue may be mid-teardown
+                pass
+        # Final "done" beat: the monitor must read the coming silence
+        # as an orderly exit, not flag a lost rank.
+        if heartbeat is not None:
+            heartbeat.stop(final=True)
+        if flight_recorder is not None:
+            flight_recorder.uninstall()
+        if log_handler is not None:
+            log_handler.uninstall()
+        raise PreemptedError(
+            f"fit preempted ({reason}) at micro_step {ctx.micro_step}; "
+            + (f"drain checkpoint: {ckpt_path}" if ckpt_path
+               else "no drain checkpoint could be written"),
+            checkpoint=ckpt_path, step=ctx.micro_step,
+            epoch=ctx.current_epoch, rank=global_rank, reason=reason,
+            drain_s=drain_s,
+        )
+
+    # Agreement cadence: the multi-process poll is a collective whose
+    # device_get would serialize host and device if run per step (the
+    # overhead the telemetry sampler explicitly refuses to add), so it
+    # runs every K micro-steps — K is a pure function of the shared
+    # step counter, keeping every rank's collective call count aligned.
+    # Worst-case drain latency is K steps, trivially inside any real
+    # preemption grace window.  Single-process fits check the local
+    # flag every step for free.
+    drain_sync_every = max(
+        int(os.environ.get("RLT_DRAIN_SYNC_EVERY", "8") or 8), 1
+    )
+
+    def _drain_agreed(local_wanted: bool = True,
+                      sync_round: bool = True) -> bool:
+        """One coordinated drain-agreement round.  Called at identical
+        loop positions on every rank (the collective inside must line
+        up across processes — ``sync_round`` must be identical fleet-
+        wide at each call site)."""
+        local = drain_mod.drain_requested() and local_wanted
+        if drain_poll is not None:
+            if not sync_round:
+                return False  # off-cadence: no collective, no drain
+            return drain_poll(local)
+        return local
     # Host-side mirror of MultiSteps' window position: micro-batches since
     # the last optimizer update.  `micro_step % accum` is NOT equivalent
     # once a partial-window flush has reset the window mid-cycle.
@@ -939,12 +1183,17 @@ def run_fit(
         _call_hooks(callbacks, "on_train_epoch_start", ctx, module)
 
         epoch_mean = _RunningMeanLogs()
+        # Mid-epoch drain resume: skip the micro-batches the drained run
+        # already trained this epoch (the loader is epoch-seeded, so the
+        # order replays identically); batch_idx stays ABSOLUTE within
+        # the epoch so the limit checks below keep their meaning.
+        skip = resume_skip_batches if epoch == start_epoch else 0
         # Cap the source BEFORE prefetching so the producer thread never
         # device-places batches past the limit/max_steps boundary.  The
         # +1 keeps one sentinel batch flowing so the in-loop checks (which
         # own the stop semantics) still observe the boundary crossing.
         cap = (
-            config.limit_train_batches
+            max(config.limit_train_batches - skip, 0)
             if config.limit_train_batches >= 0 else None
         )
         if config.max_steps >= 0:
@@ -956,10 +1205,10 @@ def run_fit(
                 0,
             )
             cap = remaining if cap is None else min(cap, remaining)
-        source = (
-            train_loader if cap is None
-            else itertools.islice(iter(train_loader), cap + 1)
-        )
+        src = iter(train_loader)
+        if skip:
+            src = itertools.islice(src, skip, None)
+        source = src if cap is None else itertools.islice(src, cap + 1)
         last_logs: Dict[str, Any] = {}
         last_batch_idx = -1
         # Telemetry marks: ``t_mark`` is set at the end of each loop body,
@@ -971,7 +1220,8 @@ def run_fit(
             _prefetched(
                 source, lambda b: _place_batch(b, mesh),
                 telemetry=tel if tel.enabled else None,
-            )
+            ),
+            start=skip,
         ):
             t_ready = time.perf_counter()
             if (
@@ -986,6 +1236,11 @@ def run_fit(
             ):
                 stop = True
                 break
+            # Chaos injection point: crash/hang/slow/sigterm pinned to
+            # (micro_step, epoch, rank) — near-zero cost unless RLT_FAULT
+            # is set (docs/FAULT_TOLERANCE.md).
+            chaos.fire("step", step=ctx.micro_step, epoch=epoch,
+                       rank=global_rank)
             rng = jax.random.fold_in(base_rng, ctx.micro_step)
             t_disp = time.perf_counter()
             ctx.state, logs = train_step(ctx.state, gbatch, rng)
@@ -1030,6 +1285,17 @@ def run_fit(
                     t_disp, t_disp_end - t_disp,
                 )
             t_mark = t_end
+            # Drain agreement (mesh-coordinated): a SIGTERM on ANY rank
+            # drains every rank at the same step boundary.  The multi-
+            # process collective runs on the K-step cadence (micro_step
+            # is identical across ranks); single-process fits poll the
+            # local flag every step.
+            if _drain_agreed(
+                sync_round=ctx.micro_step % drain_sync_every == 0
+            ):
+                _graceful_drain(
+                    mid_epoch=True, batch_in_epoch=batch_idx + 1
+                )
 
         # Flush a partial accumulation window (Lightning semantics: the
         # last incomplete window of an epoch still steps, from the mean
@@ -1124,21 +1390,11 @@ def run_fit(
                         ],
                     },
                 )
-                # The newest COMPLETE checkpoint is always loadable —
-                # superseded ones are pure disk growth.
-                for name in os.listdir(config.restart_dir):
-                    stale = os.path.join(config.restart_dir, name)
-                    if (name.startswith("restart-epoch-")
-                            and name.endswith(".ckpt")
-                            and name < os.path.basename(tag)):
-                        import shutil
-
-                        shutil.rmtree(stale, ignore_errors=True)
-                        if os.path.isfile(stale):  # legacy single-file
-                            try:
-                                os.unlink(stale)
-                            except OSError:
-                                pass
+                # Keep the newest TWO complete checkpoints (this one +
+                # its predecessor): previous-good fallback needs a
+                # predecessor to fall back TO when the newest turns out
+                # corrupt at resume time.  Anything older is disk growth.
+                _prune_restart_dir(config.restart_dir, keep=2)
 
         # Stream per-epoch metrics to the driver (live callback_metrics on
         # the driver trainer — extends the reference, which only streamed
@@ -1156,6 +1412,18 @@ def run_fit(
                     "metrics": dict(ctx.callback_metrics),
                 }
             )
+
+        # Epoch-boundary drain point: a request that landed during
+        # validation (or between epochs) is honored here — unless the
+        # fit is finishing anyway, in which case completing IS the
+        # cleanest drain.  `more_epochs` is identical on every rank
+        # (config + mesh-global should_stop), keeping the agreement
+        # collective aligned.
+        more_epochs = (epoch + 1) < config.max_epochs and not (
+            stop or ctx.should_stop
+        )
+        if _drain_agreed(local_wanted=more_epochs):
+            _graceful_drain(mid_epoch=False, batch_in_epoch=0)
 
         if stop or ctx.should_stop:
             break

@@ -208,6 +208,11 @@ class Trainer:
         datamodule: Optional[TpuDataModule] = None,
     ) -> "Trainer":
         dm = self._resolve_datamodule(module, datamodule)
+        # Fresh monitor record per fit: each elastic attempt's monitor is
+        # seeded with the prior attempts' events by the strategy, so the
+        # LAST adopted report (success or failure) narrates the whole
+        # fit — but it must not inherit a previous fit's.
+        self.monitor_report = {}
         self.strategy.setup(self)
         try:
             results = self.strategy.run(

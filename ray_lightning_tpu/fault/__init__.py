@@ -1,0 +1,53 @@
+"""Fault plane: graceful drain + deterministic fault injection.
+
+Two halves of the recovery story live here:
+
+* :mod:`.drain` — preemption-safe **graceful drain**: SIGTERM/SIGINT
+  (or an out-of-band driver request over the actor control lane) sets a
+  process-wide drain flag; the fit loop finishes the in-flight step,
+  writes a step-granular drain checkpoint and exits with
+  :class:`PreemptedError` — which the strategy converts into either a
+  clean resumable raise or an elastic restart that does NOT consume the
+  failure budget (Podracer-style: preemption is the normal case, not an
+  error).
+* :mod:`.inject` — the deterministic **chaos plane**: ``RLT_FAULT``
+  describes crash/hang/slow/sigterm/torn-write/bit-flip faults pinned
+  to exact (point, rank, step, nth) coordinates; injection points are
+  threaded through actor spawn, the fit loop, queue sends and
+  checkpoint writes, so every recovery path is provable end-to-end in
+  CI (``tests/test_fault_tolerance.py``, ``tools/chaos_sweep.py``).
+"""
+
+from ray_lightning_tpu.fault.drain import (
+    PreemptedError,
+    drain_reason,
+    drain_requested,
+    install_signal_handlers,
+    request_drain,
+    reset_drain,
+    set_fit_active,
+    uninstall_signal_handlers,
+)
+from ray_lightning_tpu.fault.inject import (
+    FaultInjected,
+    FaultSpec,
+    fire,
+    parse_faults,
+    set_rank,
+)
+
+__all__ = [
+    "PreemptedError",
+    "request_drain",
+    "drain_requested",
+    "drain_reason",
+    "reset_drain",
+    "set_fit_active",
+    "install_signal_handlers",
+    "uninstall_signal_handlers",
+    "FaultSpec",
+    "FaultInjected",
+    "parse_faults",
+    "fire",
+    "set_rank",
+]

@@ -1,0 +1,222 @@
+"""Graceful-drain coordination: the preemption-safe half of the fault plane.
+
+TPU fleets preempt with a SIGTERM and a short grace window (Podracer,
+arXiv:2104.06272, treats this as the NORMAL worker lifecycle).  The seed
+framework converted that signal into a hard worker death — losing up to
+``restart_every_n_epochs`` epochs and burning an elastic-restart budget
+slot on an event that is not a failure.  This module is the process-wide
+drain switchboard:
+
+* a signal handler (installed in the actor child's main thread at
+  startup, and on the driver's main thread around inline fits) converts
+  the FIRST SIGTERM/SIGINT into a **drain request**; a second signal
+  escalates to the old hard-exit behavior, so a stuck drain can still
+  be killed;
+* the fit loop polls :func:`drain_requested` once per step (collectively
+  agreed across a multi-process mesh — every rank must drain at the SAME
+  step or the sharded drain checkpoint would tear), writes a
+  step-granular drain checkpoint, and raises :class:`PreemptedError`;
+* the driver can request a drain out-of-band over the actor control
+  lane (``ProcessActor.request_drain`` → the ``drain`` control op →
+  :func:`request_drain` in the worker) — e.g. when the DRIVER received
+  the preemption notice.
+
+Signal handlers are process-global and only installable from the main
+thread, hence the module-level state (exactly the constraint that makes
+this a module, not a loop-local object).  jax-free.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+from typing import Any, Dict, Optional
+
+__all__ = [
+    "PreemptedError",
+    "request_drain",
+    "drain_requested",
+    "drain_reason",
+    "reset_drain",
+    "set_fit_active",
+    "fit_active",
+    "install_signal_handlers",
+    "uninstall_signal_handlers",
+]
+
+log = logging.getLogger(__name__)
+
+_DRAIN_EXIT_CODE = 143  # 128 + SIGTERM: the no-fit/second-signal hard exit
+
+
+class PreemptedError(RuntimeError):
+    """The fit drained on a preemption request instead of completing.
+
+    Distinguished from a crash on purpose: the strategy converts it into
+    an elastic restart that does NOT consume the failure budget, or (no
+    elastic recovery configured) re-raises it to the caller with the
+    drain checkpoint named — a clean resumable exit, not a failure.
+
+    Attributes: ``checkpoint`` (drain-checkpoint path, ``None`` if none
+    could be written), ``step``/``epoch`` (loop position at drain),
+    ``rank``, ``reason`` (what requested the drain), ``drain_s``
+    (seconds the drain checkpoint write took).
+    """
+
+    def __init__(self, message: str = "fit preempted", *,
+                 checkpoint: Optional[str] = None, step: int = 0,
+                 epoch: int = 0, rank: int = 0,
+                 reason: Optional[str] = None,
+                 drain_s: Optional[float] = None):
+        super().__init__(message)
+        self.checkpoint = checkpoint
+        self.step = step
+        self.epoch = epoch
+        self.rank = rank
+        self.reason = reason
+        self.drain_s = drain_s
+
+    # The exception crosses the actor RPC boundary by value (cloudpickle
+    # of the instance) — make reconstruction explicit and stable.
+    def __reduce__(self):
+        return (
+            _rebuild_preempted,
+            (self.args[0] if self.args else "fit preempted", {
+                "checkpoint": self.checkpoint,
+                "step": self.step,
+                "epoch": self.epoch,
+                "rank": self.rank,
+                "reason": self.reason,
+                "drain_s": self.drain_s,
+            }),
+        )
+
+
+def _rebuild_preempted(message: str, fields: Dict[str, Any]):
+    return PreemptedError(message, **fields)
+
+
+# ---------------------------------------------------------------------------
+# Process-wide drain state
+# ---------------------------------------------------------------------------
+
+_drain_event = threading.Event()
+_state_lock = threading.Lock()
+_reason: Optional[str] = None
+_fit_active = False
+_installed = False
+_prev_handlers: Dict[int, Any] = {}
+
+
+def request_drain(reason: str = "requested") -> None:
+    """Flip the process-wide drain flag (idempotent; first reason wins).
+    Safe from signal handlers and any thread."""
+    global _reason
+    if not _drain_event.is_set():
+        # No lock here: callable from a signal handler, where a lock the
+        # interrupted main thread holds would deadlock.  A racy double
+        # write of _reason is harmless (both are true reasons).
+        if _reason is None:
+            _reason = reason
+        _drain_event.set()
+
+
+def drain_requested() -> bool:
+    return _drain_event.is_set()
+
+
+def drain_reason() -> Optional[str]:
+    return _reason
+
+
+def reset_drain() -> None:
+    """Clear drain state at fit start: a drained fit followed by a
+    resumed fit in the SAME process (inline strategies, tests) must not
+    instantly re-drain."""
+    global _reason
+    with _state_lock:
+        _drain_event.clear()
+        _reason = None
+
+
+def set_fit_active(active: bool) -> None:
+    """Fit-in-flight marker: a SIGTERM with no fit running keeps its
+    plain meaning (exit) — only a live fit converts it into a drain."""
+    global _fit_active
+    _fit_active = active
+
+
+def fit_active() -> bool:
+    return _fit_active
+
+
+# ---------------------------------------------------------------------------
+# Signal plumbing
+# ---------------------------------------------------------------------------
+
+def _handle(signum, frame) -> None:
+    name = signal.Signals(signum).name
+    if not _fit_active:
+        # No fit to drain: preserve plain semantics.  SIGINT falls
+        # through to the previous handler (KeyboardInterrupt in the
+        # default case); SIGTERM exits with the conventional code.
+        if signum == signal.SIGINT:
+            prev = _prev_handlers.get(signum)
+            if callable(prev):
+                prev(signum, frame)
+                return
+            raise KeyboardInterrupt
+        os._exit(_DRAIN_EXIT_CODE)
+    if _drain_event.is_set():
+        # Second signal while already draining: escalate — a wedged
+        # drain must still be stoppable.  SIGINT escalates to a
+        # CATCHABLE KeyboardInterrupt (the driver may be a notebook
+        # kernel or pytest process whose finally/atexit must run);
+        # only SIGTERM (the preemptor's kill path) hard-exits.
+        log.warning("second %s during drain: escalating", name)
+        if signum == signal.SIGINT:
+            raise KeyboardInterrupt
+        os._exit(_DRAIN_EXIT_CODE)
+    request_drain(f"signal:{name}")
+
+
+def install_signal_handlers() -> bool:
+    """Install the SIGTERM/SIGINT drain handlers.  Returns ``True`` when
+    installed; ``False`` when not possible (non-main thread — Python
+    only allows signal handler changes from the main thread — or the
+    handlers are already in place)."""
+    global _installed
+    if _installed:
+        return False
+    if threading.current_thread() is not threading.main_thread():
+        return False
+    try:
+        for signum in (signal.SIGTERM, signal.SIGINT):
+            _prev_handlers[signum] = signal.signal(signum, _handle)
+    except (ValueError, OSError):  # non-main thread race / exotic host
+        return False
+    _installed = True
+    return True
+
+
+def uninstall_signal_handlers() -> None:
+    """Restore whatever handlers were in place before :func:`install_
+    signal_handlers` (driver-side inline fits must not permanently
+    steal pytest's/user code's SIGINT)."""
+    global _installed
+    if not _installed:
+        return
+    for signum, prev in list(_prev_handlers.items()):
+        try:
+            # getsignal() returns None for handlers installed from C
+            # (embedded interpreters); signal() rejects None — restore
+            # the default disposition instead.
+            signal.signal(
+                signum, prev if prev is not None else signal.SIG_DFL
+            )
+        except (ValueError, OSError, TypeError):
+            pass
+    _prev_handlers.clear()
+    _installed = False

@@ -1,0 +1,326 @@
+"""Deterministic fault injection (the chaos plane).
+
+Recovery machinery is only trustworthy when its failure modes can be
+reproduced on demand (the MPMD-pipeline lesson, arXiv:2412.14374): a
+recovery path that has never fired in CI is a recovery path that does
+not work.  This module turns the ``RLT_FAULT`` env knob into faults
+fired at exact, deterministic coordinates inside the framework.
+
+Grammar (``RLT_FAULT``)::
+
+    RLT_FAULT  = spec (";" spec)*
+    spec       = kind "@" cond ("," cond)*
+    cond       = key ":" value
+
+    kinds: crash   — os._exit(13): hard process death (OOM/preemption
+                     without grace)
+           exc     — raise FaultInjected (the deterministic-user-bug
+                     path: must fail fast, never burn restart budget)
+           hang    — sleep ``secs`` (default 3600) on the calling
+                     thread: the wedged-collective signature (beats
+                     keep flowing, progress freezes)
+           slow    — sleep ``secs`` (default 1.0): a straggler rank
+           sigterm — deliver SIGTERM to this process: the graceful-
+                     drain / preemption path (fault/drain.py)
+           torn    — truncate the file at the injection point's
+                     ``path`` to half: a torn checkpoint write
+           bitflip — XOR one byte mid-file: silent media corruption a
+                     checksum must catch
+
+    keys:  point — injection point name (default "step"):
+                   spawn | step | queue_put | ckpt_write | meta_write
+           rank  — only this global rank (default: any)
+           step  — only this micro-step (``step`` point only)
+           epoch — only this epoch
+           nth   — only the Nth matching occurrence (1-based; counted
+                   per process — combine with the fired-marker state
+                   dir for exactly-once across restarts)
+           secs  — hang/slow duration
+           once  — 1 (default): fire at most once, recorded in the
+                   ``RLT_FAULT_STATE`` marker dir so a respawned
+                   worker does not re-fire it; 0: fire on every match
+
+Examples::
+
+    RLT_FAULT="crash@step:7,rank:1"
+    RLT_FAULT="hang@step:5,rank:0,secs:120"
+    RLT_FAULT="sigterm@step:3,rank:0"
+    RLT_FAULT="bitflip@point:ckpt_write,nth:2;crash@step:9"
+
+Determinism across elastic restarts: set ``RLT_FAULT_STATE=<dir>`` (a
+directory shared by all workers); each fired ``once`` spec drops a
+``fault-<index>.fired`` marker there, so the respawned worker set
+trains through instead of re-dying forever.  Both env vars ride the
+strategy env bus (like ``RLT_GRAD_COMM``), so driver-side settings
+reach remote workers.
+
+Cost discipline: :func:`fire` is called on hot paths (every step, every
+queue put).  With ``RLT_FAULT`` unset it is one dict lookup + one
+``is None`` check — nothing is parsed, no state dir is touched.
+jax-free.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "FaultSpec",
+    "FaultInjected",
+    "parse_faults",
+    "fire",
+    "set_rank",
+    "POINTS",
+    "KINDS",
+]
+
+log = logging.getLogger(__name__)
+
+KINDS = ("crash", "exc", "hang", "slow", "sigterm", "torn", "bitflip")
+POINTS = ("spawn", "step", "queue_put", "ckpt_write", "meta_write")
+
+_CRASH_EXIT_CODE = 13
+
+
+class FaultInjected(RuntimeError):
+    """The exception the ``exc`` fault kind raises."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One parsed fault: a kind pinned to match coordinates."""
+
+    kind: str
+    point: str = "step"
+    rank: Optional[int] = None
+    step: Optional[int] = None
+    epoch: Optional[int] = None
+    nth: Optional[int] = None
+    secs: Optional[float] = None
+    once: bool = True
+    index: int = 0  # position in the RLT_FAULT list (marker identity)
+
+    def matches(self, point: str, rank: Optional[int],
+                step: Optional[int], epoch: Optional[int]) -> bool:
+        """Coordinate match — everything except the nth/once gates,
+        which are stateful and live on the plan."""
+        if self.point != point:
+            return False
+        if self.rank is not None and rank != self.rank:
+            return False
+        if self.step is not None and step != self.step:
+            return False
+        if self.epoch is not None and epoch != self.epoch:
+            return False
+        return True
+
+
+def parse_faults(value: str) -> List[FaultSpec]:
+    """Parse an ``RLT_FAULT`` string; raises ``ValueError`` on any typo
+    (a chaos spec that silently matches nothing would "prove" recovery
+    paths that never actually fired)."""
+    specs: List[FaultSpec] = []
+    for index, raw in enumerate(s for s in value.split(";") if s.strip()):
+        raw = raw.strip()
+        kind, sep, conds = raw.partition("@")
+        kind = kind.strip()
+        if kind not in KINDS:
+            raise ValueError(
+                f"RLT_FAULT spec {raw!r}: unknown kind {kind!r} "
+                f"(expected one of {KINDS})"
+            )
+        kw: Dict[str, Any] = {"kind": kind, "index": index}
+        if sep:
+            for cond in conds.split(","):
+                key, csep, val = cond.partition(":")
+                key, val = key.strip(), val.strip()
+                if not csep or not val:
+                    raise ValueError(
+                        f"RLT_FAULT spec {raw!r}: condition {cond!r} is "
+                        "not key:value"
+                    )
+                if key == "point":
+                    if val not in POINTS:
+                        raise ValueError(
+                            f"RLT_FAULT spec {raw!r}: unknown point "
+                            f"{val!r} (expected one of {POINTS})"
+                        )
+                    kw["point"] = val
+                elif key in ("rank", "step", "epoch", "nth"):
+                    kw[key] = int(val)
+                elif key == "secs":
+                    kw[key] = float(val)
+                elif key == "once":
+                    kw["once"] = val not in ("0", "false", "off")
+                else:
+                    raise ValueError(
+                        f"RLT_FAULT spec {raw!r}: unknown key {key!r}"
+                    )
+        specs.append(FaultSpec(**kw))
+    return specs
+
+
+class FaultPlan:
+    """Parsed specs + per-process occurrence counters + the shared
+    fired-marker directory."""
+
+    def __init__(self, specs: List[FaultSpec], state_dir: Optional[str]):
+        self.specs = specs
+        self.state_dir = state_dir
+        self._counts: Dict[int, int] = {}
+
+    def _marker(self, spec: FaultSpec) -> Optional[str]:
+        if self.state_dir is None:
+            return None
+        return os.path.join(self.state_dir, f"fault-{spec.index}.fired")
+
+    def already_fired(self, spec: FaultSpec) -> bool:
+        marker = self._marker(spec)
+        return marker is not None and os.path.exists(marker)
+
+    def mark_fired(self, spec: FaultSpec) -> None:
+        marker = self._marker(spec)
+        if marker is None:
+            return
+        try:
+            os.makedirs(self.state_dir, exist_ok=True)
+            with open(marker, "w") as f:
+                f.write(f"{time.time()}\n")
+        except OSError:
+            log.warning("fault marker %s could not be written", marker)
+
+    def due(self, point: str, rank: Optional[int], step: Optional[int],
+            epoch: Optional[int]) -> List[FaultSpec]:
+        due = []
+        for spec in self.specs:
+            if not spec.matches(point, rank, step, epoch):
+                continue
+            if spec.nth is not None:
+                # Occurrence counting happens on COORDINATE matches, so
+                # nth stays deterministic regardless of fired state.
+                n = self._counts.get(spec.index, 0) + 1
+                self._counts[spec.index] = n
+                if n != spec.nth:
+                    continue
+            if spec.once and self.already_fired(spec):
+                continue
+            due.append(spec)
+        return due
+
+
+# Cache keyed by the (RLT_FAULT, RLT_FAULT_STATE) values so env changes
+# between fits (tests) re-parse, while the hot path stays two dict
+# lookups when faults are configured and one when they are not.
+_plan_key: Optional[Tuple[str, Optional[str]]] = None
+_plan: Optional[FaultPlan] = None
+
+_ctx_rank: Optional[int] = None
+
+
+def set_rank(rank: Optional[int]) -> None:
+    """Record this process's global rank so injection points that don't
+    naturally know it (queue sends, checkpoint writers) still honor
+    ``rank:`` conditions."""
+    global _ctx_rank
+    _ctx_rank = rank
+
+
+def _current_plan() -> Optional[FaultPlan]:
+    global _plan_key, _plan
+    value = os.environ.get("RLT_FAULT")
+    if not value:
+        if _plan is not None:
+            _plan_key, _plan = None, None
+        return None
+    key = (value, os.environ.get("RLT_FAULT_STATE") or None)
+    if key != _plan_key:
+        _plan = FaultPlan(parse_faults(value), key[1])
+        _plan_key = key
+    return _plan
+
+
+# ---------------------------------------------------------------------------
+# Fault actions
+# ---------------------------------------------------------------------------
+
+def _corrupt_torn(path: str) -> None:
+    """Truncate ``path`` to half: the classic torn write (writer died
+    mid-flush after the rename — or a filesystem that lied about
+    durability)."""
+    try:
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            f.truncate(max(size // 2, 1))
+    except OSError as e:
+        log.warning("torn fault on %s failed: %r", path, e)
+
+
+def _corrupt_bitflip(path: str) -> None:
+    """XOR one bit mid-file: silent media corruption only a checksum
+    catches (the payload still parses more often than not)."""
+    try:
+        size = os.path.getsize(path)
+        pos = size // 2
+        with open(path, "r+b") as f:
+            f.seek(pos)
+            byte = f.read(1)
+            f.seek(pos)
+            f.write(bytes([(byte[0] if byte else 0) ^ 0x01]))
+    except OSError as e:
+        log.warning("bitflip fault on %s failed: %r", path, e)
+
+
+def _execute(spec: FaultSpec, point: str, path: Optional[str]) -> None:
+    log.warning("chaos: firing %s@%s (spec #%d)", spec.kind, point,
+                spec.index)
+    if spec.kind == "crash":
+        os._exit(_CRASH_EXIT_CODE)
+    if spec.kind == "exc":
+        raise FaultInjected(
+            f"injected exception at {point} (spec #{spec.index})"
+        )
+    if spec.kind == "hang":
+        time.sleep(spec.secs if spec.secs is not None else 3600.0)
+        return
+    if spec.kind == "slow":
+        time.sleep(spec.secs if spec.secs is not None else 1.0)
+        return
+    if spec.kind == "sigterm":
+        import signal
+
+        os.kill(os.getpid(), signal.SIGTERM)
+        return
+    if spec.kind in ("torn", "bitflip"):
+        if path is None:
+            log.warning(
+                "chaos: %s fault at %s has no file path — skipped",
+                spec.kind, point,
+            )
+            return
+        (_corrupt_torn if spec.kind == "torn" else _corrupt_bitflip)(path)
+        return
+
+
+def fire(point: str, *, step: Optional[int] = None,
+         epoch: Optional[int] = None, rank: Optional[int] = None,
+         path: Optional[str] = None) -> None:
+    """An injection point: fire every due fault for these coordinates.
+
+    Near-zero cost when ``RLT_FAULT`` is unset.  ``rank`` defaults to
+    the process context set by :func:`set_rank`.
+    """
+    plan = _current_plan()
+    if plan is None:
+        return
+    if rank is None:
+        rank = _ctx_rank
+    for spec in plan.due(point, rank, step, epoch):
+        # Mark BEFORE executing: crash/sigterm never return, and the
+        # whole contract is that the respawned worker trains through.
+        if spec.once:
+            plan.mark_fired(spec)
+        _execute(spec, point, path)

@@ -30,8 +30,11 @@ Flavor map (≙ the reference's three plugins):
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
+import random
 import shutil
+import time
 import uuid
 import warnings
 from typing import Any, Callable, Dict, List, Optional
@@ -46,7 +49,11 @@ from ray_lightning_tpu.core.loop import (
     run_fit,
     run_predict,
 )
+from ray_lightning_tpu.fault import drain as drain_mod
+from ray_lightning_tpu.fault.drain import PreemptedError
 from ray_lightning_tpu.util import process_results
+
+log = logging.getLogger(__name__)
 
 __all__ = [
     "TpuStrategy",
@@ -65,24 +72,33 @@ __all__ = [
 # Worker-side entry (top-level: importable in actor children)
 # ---------------------------------------------------------------------------
 
-def _remote_latest_restart_checkpoint(restart_dir: str):
-    """Runs on worker 0: newest COMPLETE elastic-restart checkpoint on
-    its node.  Sharded checkpoints (directories) count only once their
-    META marker exists — a crash mid-write must never be resumed from."""
-    from ray_lightning_tpu.utils.sharded_ckpt import is_sharded_ckpt
+def _remote_latest_restart_checkpoint(restart_dir: str) -> Dict[str, Any]:
+    """Runs on worker 0 (or driver-side on a shared filesystem): newest
+    COMPLETE **and verified** restart/drain checkpoint on its node.
 
-    try:
-        names = sorted(
-            n for n in os.listdir(restart_dir)
-            if n.startswith("restart-epoch-") and n.endswith(".ckpt")
-        )
-    except OSError:
-        return None
-    for name in reversed(names):
-        path = os.path.join(restart_dir, name)
-        if os.path.isfile(path) or is_sharded_ckpt(path):
-            return path
-    return None
+    Sharded checkpoints (directories) count only once their META marker
+    exists — a crash mid-write must never be resumed from.  Candidates
+    are ordered newest-first by completion time (META mtime — drain and
+    epoch checkpoints interleave, so name order alone cannot rank them)
+    and each is integrity-verified (``sharded_ckpt.verify_checkpoint``):
+    a torn or bit-flipped newest checkpoint is WALKED PAST to the
+    previous good one instead of bricking every restart attempt.
+
+    Returns ``{"path": newest_verified_or_None, "corrupt": [...]}`` —
+    the corrupt list feeds the driver's ``ckpt_corrupt`` telemetry.
+    """
+    from ray_lightning_tpu.utils.sharded_ckpt import (
+        list_restart_candidates,
+        verify_checkpoint,
+    )
+
+    corrupt: List[Dict[str, Any]] = []
+    for _, _, _, path in list_restart_candidates(restart_dir):
+        problems = verify_checkpoint(path)
+        if not problems:
+            return {"path": path, "corrupt": corrupt}
+        corrupt.append({"path": path, "problems": problems[:3]})
+    return {"path": None, "corrupt": corrupt}
 
 
 def _remote_find_free_port() -> int:
@@ -135,6 +151,12 @@ def _execute_remote(task_ref, global_rank: int, queue_handle) -> Dict[str, Any]:
             world_size=world_size,
             mesh=mesh,
         )
+        # Chaos injection point: a crash/hang at actor-spawn/task-start
+        # exercises the startup half of elastic recovery.
+        from ray_lightning_tpu.fault import inject as _chaos
+
+        _chaos.set_rank(global_rank)
+        _chaos.fire("spawn", rank=global_rank)
         if kind == "fit":
             try:
                 return run_fit(
@@ -146,6 +168,11 @@ def _execute_remote(task_ref, global_rank: int, queue_handle) -> Dict[str, Any]:
                     queue=queue_handle,
                     **common,
                 )
+            except PreemptedError:
+                # A drain is an orderly exit, not a crash: the loop
+                # already wrote its drain checkpoint and retired the
+                # live plane — no flight bundle.
+                raise
             except BaseException as err:
                 # Crash forensics: persist the flight bundle (spans,
                 # step stats, logs, stacks — telemetry/flight_recorder)
@@ -222,6 +249,9 @@ class TpuStrategy:
         env_per_worker: Optional[Dict[str, str]] = None,
         max_restarts: int = 0,
         restart_every_n_epochs: int = 1,
+        restart_window_s: float = 3600.0,
+        restart_backoff_s: float = 1.0,
+        restart_backoff_max_s: float = 60.0,
         grad_comm=None,
         telemetry=None,
         monitor=None,
@@ -305,7 +335,14 @@ class TpuStrategy:
                     # flight-recorder/log-ring switches are read worker-
                     # side at fit start.
                     "RLT_HEARTBEAT_S", "RLT_FLIGHT_RECORDER",
-                    "RLT_LOG_RING"):
+                    "RLT_LOG_RING",
+                    # Chaos plane (fault/inject.py): faults and their
+                    # exactly-once marker dir must reach remote workers,
+                    # or a driver-side RLT_FAULT would only ever test
+                    # the inline path.  The drain-agreement cadence
+                    # rides along (loop-side knob).
+                    "RLT_FAULT", "RLT_FAULT_STATE",
+                    "RLT_DRAIN_SYNC_EVERY"):
             val = os.environ.get(var)
             if val is not None:
                 self.env_per_worker.setdefault(var, val)
@@ -317,9 +354,37 @@ class TpuStrategy:
             raise ValueError("max_restarts must be >= 0")
         if restart_every_n_epochs < 1:
             raise ValueError("restart_every_n_epochs must be >= 1")
+        if restart_window_s <= 0:
+            raise ValueError("restart_window_s must be > 0")
+        if restart_backoff_s < 0 or restart_backoff_max_s < 0:
+            raise ValueError("restart backoff times must be >= 0")
         self.max_restarts = max_restarts
         self.restart_every_n_epochs = restart_every_n_epochs
+        # Restart governance (docs/FAULT_TOLERANCE.md): the failure
+        # budget is a SLIDING WINDOW (max_restarts per restart_window_s),
+        # not a per-fit lifetime count — a week-long fit may absorb many
+        # spread-out failures, while a flapping host still exhausts the
+        # budget within the hour it flaps.  Respawns back off
+        # exponentially with jitter so a correlated outage doesn't
+        # hammer the scheduler in lockstep.
+        self.restart_window_s = restart_window_s
+        self.restart_backoff_s = restart_backoff_s
+        self.restart_backoff_max_s = restart_backoff_max_s
         self.restarts_used = 0
+        # Preemption drains recover WITHOUT consuming the failure budget
+        # (they are the normal case, not an error — Podracer); counted
+        # separately so dashboards can tell churn from failure.
+        self.preempt_restarts_used = 0
+        # Recovery events of the fit in flight (backoff delays, restart
+        # attempts, checkpoint-corruption fallbacks, preempt restarts):
+        # seeded into each attempt's RunMonitor so the final
+        # ``trainer.monitor_report`` tells the whole story across
+        # respawns, not just the last attempt's.
+        self.recovery_events: List[Dict[str, Any]] = []
+        self._carried_events: List[Dict[str, Any]] = []
+        self._last_monitor = None
+        self._drain_broadcast = False
+        self._drain_broadcast_at = 0.0
 
         self._backend: Optional[backend_mod.ClusterBackend] = None
         self._workers: list = []
@@ -403,14 +468,27 @@ class TpuStrategy:
             if chips is not None:
                 worker.set_env_vars({"TPU_VISIBLE_CHIPS": chips})
 
+    def _kill_workers(self, timeout: Optional[float] = None,
+                      why: str = "teardown") -> None:
+        """Kill every current worker.  Failures are expected (some are
+        already dead) but never SILENT: an unkillable worker is a zombie
+        holding TPU chips, and the debug log must say which rank."""
+        for rank, w in enumerate(self._workers):
+            try:
+                if timeout is None:
+                    w.kill()
+                else:
+                    w.kill(timeout=timeout)
+            except Exception as e:  # noqa: BLE001 - already-dead is fine
+                log.debug(
+                    "%s: kill of worker rank %d (%s) failed: %r",
+                    why, rank, getattr(w, "name", "?"), e,
+                )
+
     def _respawn_workers(self) -> None:
         """Kill every current worker (peers of a dead one may be stuck in
         a collective forever) and start a fresh set."""
-        for w in self._workers:
-            try:
-                w.kill()
-            except Exception:  # noqa: BLE001 - some are already dead
-                pass
+        self._kill_workers(why="respawn")
         self._workers = []
         self._spawn_workers()
 
@@ -443,9 +521,16 @@ class TpuStrategy:
         reference ``ray_ddp.py:317-360``): ship → submit → pump → collect.
 
         With ``max_restarts > 0`` and ``kind="fit"``, worker death does not
-        crash the fit: the whole worker set is respawned and training
-        resumes from the newest elastic-restart checkpoint (at most
-        ``restart_every_n_epochs`` epochs of work are lost).
+        crash the fit: the whole worker set is respawned — after an
+        exponential, jittered backoff, within a sliding per-
+        ``restart_window_s`` failure budget — and training resumes from
+        the newest VERIFIED restart checkpoint (corrupt ones are walked
+        past; at most ``restart_every_n_epochs`` epochs of work are
+        lost).  A preemption drain (:class:`PreemptedError`) restarts
+        from its step-granular drain checkpoint WITHOUT consuming the
+        failure budget — unless the drain request came from the driver
+        itself (the driver is being preempted too), in which case it
+        re-raises cleanly with the checkpoint named.
         """
         assert self._backend is not None, "setup() must run first"
         elastic = self.max_restarts > 0 and kind == "fit"
@@ -463,7 +548,26 @@ class TpuStrategy:
                 f".rlt-restart-{uuid.uuid4().hex[:8]}",
             )
             config = dataclasses.replace(config, restart_dir=restart_dir)
-        attempt = 0
+        fail_times: List[float] = []   # budget-consuming failures
+        last_preempt_step = -1
+        preempt_streak = 0
+        # Driver-side preemption: SIGTERM/SIGINT on the DRIVER while it
+        # pumps results is forwarded to every worker over the control
+        # lane (see _pump_tick), so the fleet drains as one.
+        drain_installed = False
+        preserve_scratch = False  # a raised PreemptedError names its
+        # drain checkpoint — deleting the scratch dir would orphan it
+        if kind == "fit":
+            # Per-FIT recovery state: an eval/predict after a recovered
+            # fit must not wipe the fit's recovery record.
+            self.recovery_events = []
+            self._carried_events = []
+            self._last_monitor = None
+            self._drain_broadcast = False
+            self._drain_broadcast_at = 0.0
+            drain_mod.reset_drain()
+            drain_mod.set_fit_active(True)
+            drain_installed = drain_mod.install_signal_handlers()
         try:
             while True:
                 try:
@@ -472,21 +576,111 @@ class TpuStrategy:
                         trainer=trainer, params_stream=params_stream,
                         ckpt_path=ckpt_path,
                     )
+                except PreemptedError as err:
+                    self._capture_attempt_events()
+                    if (not elastic or self._drain_broadcast
+                            or drain_mod.drain_requested()):
+                        # No elastic recovery, or the DRIVER itself is
+                        # being preempted: a clean resumable raise — the
+                        # error names the drain checkpoint.
+                        preserve_scratch = err.checkpoint is not None
+                        raise
+                    # Flap guard: consecutive preemption recoveries that
+                    # make no forward progress mean the host/quota is
+                    # flapping — budget-free must not mean infinite.
+                    step = int(getattr(err, "step", 0) or 0)
+                    preempt_streak = (
+                        preempt_streak + 1 if step <= last_preempt_step
+                        else 0
+                    )
+                    last_preempt_step = step
+                    if preempt_streak >= 2:
+                        preserve_scratch = err.checkpoint is not None
+                        raise
+                    self.preempt_restarts_used += 1
+                    # Elastic fits always have restart_dir set, and the
+                    # drain checkpoint lands inside it — so verified
+                    # discovery alone decides the resume point (the
+                    # error's own checkpoint claim is the same path,
+                    # already verified or rejected by discovery).
+                    info = self._discover_resume(config)
+                    resume = info["path"]
+                    self._record_recovery(
+                        "preempt_restart",
+                        message=(
+                            f"preemption drain at micro_step {step} "
+                            f"({err.reason or 'requested'}); respawning "
+                            f"without consuming the restart budget"
+                        ),
+                        ckpt=resume or "",
+                    )
+                    warnings.warn(
+                        f"Preemption drain ({err}); elastic respawn "
+                        f"(budget untouched), resuming from "
+                        f"{resume or 'scratch'}."
+                    )
+                    self._respawn_workers()
+                    if resume is not None:
+                        config = dataclasses.replace(
+                            config, resume_from_checkpoint=resume
+                        )
                 # Retry ONLY process death (≙ preemption/OOM).  A Python
                 # exception in user code (RemoteError) is deterministic —
                 # respawning would retrain epochs just to re-raise it.
                 except ActorDiedError as err:
-                    if not elastic or attempt >= self.max_restarts:
+                    self._capture_attempt_events()
+                    if not elastic:
                         raise
-                    attempt += 1
+                    now = time.monotonic()
+                    fail_times[:] = [
+                        t for t in fail_times
+                        if now - t <= self.restart_window_s
+                    ]
+                    if len(fail_times) >= self.max_restarts:
+                        err.enrich(note=(
+                            f"restart budget exhausted: "
+                            f"{self.max_restarts} failure(s) within "
+                            f"{self.restart_window_s:.0f}s"
+                        ))
+                        raise
+                    fail_times.append(now)
                     self.restarts_used += 1
+                    # Backoff exponent = failures currently IN the
+                    # window (same clock as the budget): two deaths a
+                    # day apart each wait the base delay; a flapping
+                    # host doubles up within its hour.
+                    fail_streak = len(fail_times)
+                    delay = self._backoff_delay(fail_streak)
+                    if delay > 0:
+                        self._record_recovery(
+                            "backoff", delay_s=round(delay, 3),
+                            attempt=fail_streak,
+                            message=(
+                                f"waiting {delay:.2f}s before respawn "
+                                f"#{fail_streak} (exponential backoff "
+                                f"with jitter)"
+                            ),
+                        )
+                        time.sleep(delay)
+                    t_recover = time.monotonic()
                     self._respawn_workers()
-                    resume = self._latest_restart_checkpoint(
-                        config.restart_dir
+                    info = self._discover_resume(config)
+                    resume = info["path"]
+                    self._record_recovery(
+                        "elastic_restart", attempt=fail_streak,
+                        recover_s=round(time.monotonic() - t_recover, 3),
+                        ckpt=resume or "",
+                        message=(
+                            f"worker failure; elastic restart "
+                            f"{len(fail_times)}/{self.max_restarts} in "
+                            f"window, resuming from "
+                            f"{resume or 'scratch'}"
+                        ),
                     )
                     warnings.warn(
                         f"Worker failure ({err}); elastic restart "
-                        f"{attempt}/{self.max_restarts}, resuming from "
+                        f"{len(fail_times)}/{self.max_restarts} (window "
+                        f"{self.restart_window_s:.0f}s), resuming from "
                         f"{resume or 'scratch'}."
                     )
                     if resume is not None:
@@ -494,24 +688,123 @@ class TpuStrategy:
                             config, resume_from_checkpoint=resume
                         )
         finally:
+            if drain_installed:
+                drain_mod.uninstall_signal_handlers()
+            if kind == "fit":
+                drain_mod.set_fit_active(False)
             # The scratch dir is uuid-named and unreachable for manual
-            # resume; reclaim it on failure too, not just success.
-            if restart_dir is not None:
+            # resume; reclaim it on failure too, not just success —
+            # EXCEPT when a raised PreemptedError names a drain
+            # checkpoint inside it (the resumable exit's whole value).
+            if restart_dir is not None and not preserve_scratch:
                 shutil.rmtree(restart_dir, ignore_errors=True)
 
-    def _latest_restart_checkpoint(self, restart_dir) -> Optional[str]:
-        """Newest restart checkpoint, looked up ON WORKER 0's node — the
-        writer's filesystem (restart_dir must be shared storage for
-        multi-node elastic recovery, the same assumption the reference
-        makes for ModelCheckpoint files, ``ray_ddp.py:496-499``)."""
-        if restart_dir is None or not self._workers:
-            return None
-        try:
-            return self._workers[0].execute(
-                _remote_latest_restart_checkpoint, restart_dir
+    def _latest_restart_checkpoint(self, restart_dir) -> Dict[str, Any]:
+        """Newest VERIFIED restart/drain checkpoint, looked up ON WORKER
+        0's node — the writer's filesystem (restart_dir must be shared
+        storage for multi-node elastic recovery, the same assumption the
+        reference makes for ModelCheckpoint files, ``ray_ddp.py:
+        496-499``).  Falls back to a driver-local scan (valid on shared
+        storage and the single-host backend) when worker 0 cannot
+        answer."""
+        if restart_dir is None:
+            return {"path": None, "corrupt": []}
+        if self._workers:
+            try:
+                return self._workers[0].execute(
+                    _remote_latest_restart_checkpoint, restart_dir
+                )
+            except (ActorDiedError, RemoteError):
+                pass
+        return _remote_latest_restart_checkpoint(restart_dir)
+
+    def _discover_resume(self, config: FitConfig) -> Dict[str, Any]:
+        """Restart discovery + the ``ckpt_corrupt`` telemetry promise:
+        every checkpoint the walk-back skipped becomes a loud event (and
+        a warning) — silent fallback would hide data-eating storage."""
+        info = self._latest_restart_checkpoint(config.restart_dir)
+        for item in info.get("corrupt", []):
+            problems = "; ".join(str(p) for p in item.get("problems", []))
+            self._record_recovery(
+                "ckpt_corrupt", ckpt=item.get("path", ""),
+                message=(
+                    f"checkpoint failed verification, falling back to "
+                    f"an older one: {problems}"
+                ),
             )
-        except (ActorDiedError, RemoteError):
-            return None
+            warnings.warn(
+                f"corrupt restart checkpoint skipped: "
+                f"{item.get('path')} ({problems})"
+            )
+        return info
+
+    # -- recovery bookkeeping ------------------------------------------------
+    def _record_recovery(self, kind: str, **fields: Any) -> None:
+        """A schema-shaped recovery event, kept on the strategy AND
+        seeded into the next attempt's RunMonitor, so the final
+        ``trainer.monitor_report`` narrates the whole fit across
+        respawns (backoff delays included — the acceptance criterion)."""
+        from ray_lightning_tpu.telemetry.monitor import make_event
+
+        ev = make_event(kind, -1, **fields)
+        self.recovery_events.append(ev)
+        self._carried_events.append(ev)
+
+    def _capture_attempt_events(self) -> None:
+        """Fold the failed attempt's monitor record (stalls, dumps,
+        aborts, crashes) into the carried history so the NEXT attempt's
+        monitor — and thus the final report — keeps it."""
+        if self._last_monitor is not None:
+            self._carried_events = list(self._last_monitor.events)
+            self._last_monitor = None
+
+    def _backoff_delay(self, streak: int) -> float:
+        """Exponential backoff with jitter: base × 2^(streak-1), capped,
+        plus up to +25% jitter so a correlated fleet outage doesn't
+        respawn every strategy in lockstep."""
+        if self.restart_backoff_s <= 0:
+            return 0.0
+        base = min(
+            self.restart_backoff_s * (2 ** max(streak - 1, 0)),
+            self.restart_backoff_max_s,
+        )
+        return base * (1.0 + 0.25 * random.random())
+
+    def _maybe_broadcast_drain(self) -> None:
+        """Driver-side preemption fan-out: the signal handler only sets
+        a flag (no I/O in handlers); the pump tick turns it into one
+        control-lane drain request per worker, fire-and-forget.
+
+        RE-SENT every couple of seconds while the drain is pending: a
+        worker still inside fit setup when the first request lands
+        clears its process-wide flag at ``run_fit`` start (the inline-
+        reuse reset), so a one-shot broadcast could be silently
+        swallowed and the fleet would train through its grace window.
+        ``request_drain`` is idempotent worker-side, so repeats are
+        free."""
+        if not drain_mod.drain_requested():
+            return
+        now = time.monotonic()
+        if (self._drain_broadcast
+                and now - self._drain_broadcast_at < 2.0):
+            return
+        if not self._drain_broadcast:
+            warnings.warn(
+                "drain requested on the driver — forwarding to workers"
+            )
+        self._drain_broadcast = True
+        self._drain_broadcast_at = now
+        for rank, w in enumerate(self._workers):
+            request = getattr(w, "request_drain", None)
+            if request is None:
+                continue
+            try:
+                request(wait=False)
+            except Exception as e:  # noqa: BLE001 - a dead worker can't
+                # drain; its death surfaces through the pump anyway.
+                log.debug(
+                    "drain forward to rank %d failed: %r", rank, e
+                )
 
     def _run_once(
         self,
@@ -553,9 +846,21 @@ class TpuStrategy:
                 for rank, w in enumerate(self._workers)
             ]
             on_item = getattr(trainer, "_on_stream_item", None)
+
+            def _tick() -> None:
+                # Driver-preemption fan-out rides the pump (signal
+                # handlers must not do socket I/O), then the watchdog.
+                if kind == "fit":
+                    self._maybe_broadcast_drain()
+                if monitor is not None:
+                    monitor.tick()
+
             results = process_results(
                 futures, queue, on_item=on_item,
-                on_tick=monitor.tick if monitor is not None else None,
+                on_tick=(
+                    _tick if (monitor is not None or kind == "fit")
+                    else None
+                ),
             )
         except (ActorDiedError, RemoteError) as err:
             self._enrich_failure(err, futures, monitor)
@@ -603,6 +908,14 @@ class TpuStrategy:
             dump_cb=self._dump_rank_stacks,
             abort_cb=self._abort_workers,
         )
+        # Seed the attempt's monitor with the recovery history so far
+        # (previous attempts' stalls/aborts/crashes + the strategy's
+        # backoff/restart/ckpt_corrupt events): the LAST adopted report
+        # is what lands in trainer.monitor_report, and it must narrate
+        # the whole fit, not just the surviving attempt.
+        for ev in self._carried_events:
+            monitor._record_event(ev)
+        self._last_monitor = monitor
         attach = getattr(trainer, "_attach_monitor", None)
         if attach is not None:
             attach(monitor)
@@ -625,13 +938,12 @@ class TpuStrategy:
 
     def _abort_workers(self, reason: str) -> None:
         """Monitor abort hook: kill the worker set so the pump's futures
-        fail instead of waiting on a hung collective forever."""
+        fail instead of waiting on a hung collective forever.  With
+        ``max_restarts`` set, the resulting ActorDiedError feeds the
+        ELASTIC path — a wedged collective becomes a restart, not a
+        dead fit."""
         warnings.warn(f"RunMonitor abort: {reason} — killing workers")
-        for w in self._workers:
-            try:
-                w.kill(timeout=1.0)
-            except Exception:  # noqa: BLE001 - some are already dead
-                pass
+        self._kill_workers(timeout=1.0, why="monitor-abort")
 
     def _enrich_failure(self, err, futures, monitor) -> None:
         """Make a worker-death report say when/how the rank died: rank
@@ -645,9 +957,19 @@ class TpuStrategy:
             None,
         )
         bundles = monitor.crash_bundles() if monitor is not None else []
-        note = None
+        notes = []
         if bundles:
-            note = "flight bundle(s): " + ", ".join(bundles)
+            notes.append("flight bundle(s): " + ", ".join(bundles))
+        # A death DURING the drain window must say a drain checkpoint
+        # exists and where — the operator's next move is resuming from
+        # it, not spelunking the scratch dir (mirrors how crash errors
+        # name their flight bundles).
+        drains = (
+            monitor.drain_checkpoints() if monitor is not None else []
+        )
+        if drains:
+            notes.append("drain checkpoint(s): " + ", ".join(drains))
+        note = "; ".join(notes) or None
         if isinstance(err, ActorDiedError):
             fields = {"note": note} if note else {}
             if monitor is not None and monitor.abort_reason:
@@ -676,11 +998,7 @@ class TpuStrategy:
             if getattr(self, "_owns_backend", True):
                 self._backend.shutdown()
             else:
-                for w in self._workers:
-                    try:
-                        w.kill()
-                    except Exception:  # noqa: BLE001 - best-effort teardown
-                        pass
+                self._kill_workers(why="teardown")
         self._workers = []
         self._backend = None
 
@@ -755,6 +1073,10 @@ class LocalStrategy(TpuStrategy):
                                 zero_stage=self.zero_stage,
                                 grad_comm=self.grad_comm,
                                 telemetry=self.telemetry, **common)]
+            except PreemptedError:
+                # An inline drain is an orderly exit with its checkpoint
+                # already written and named — not a crash to record.
+                raise
             except BaseException as err:
                 # Inline fits get the same crash forensics as remote
                 # workers; there is no queue, so name the bundle loudly

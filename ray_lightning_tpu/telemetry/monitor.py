@@ -114,7 +114,7 @@ class _RankState:
     __slots__ = (
         "beats", "last_beat", "last_beat_at", "last_progress_at",
         "progress_seen", "done", "flagged_lost", "flagged_stalled",
-        "flagged_straggler", "logs", "crash_bundle",
+        "flagged_straggler", "logs", "crash_bundle", "drain_ckpt",
     )
 
     def __init__(self):
@@ -131,6 +131,7 @@ class _RankState:
             maxlen=_RANK_LOG_CAP
         )
         self.crash_bundle: Optional[str] = None
+        self.drain_ckpt: Optional[str] = None  # drain event's checkpoint
 
     def status(self, now: float, hang_s: float) -> str:
         if self.crash_bundle:
@@ -198,6 +199,11 @@ class RunMonitor:
             if item.get("kind") == "crash":
                 st = self._state(int(item.get("rank", -1)))
                 st.crash_bundle = item.get("bundle")
+            elif item.get("kind") == "drain":
+                # A preemption drain in flight: remember the checkpoint
+                # so a death in the drain window can NAME it.
+                st = self._state(int(item.get("rank", -1)))
+                st.drain_ckpt = item.get("ckpt") or st.drain_ckpt
         elif kind == "log":
             self._state(int(item.get("rank", 0))).logs.append(item)
 
@@ -417,6 +423,16 @@ class RunMonitor:
             for _, st in sorted(self._ranks.items())
             if st.crash_bundle
         ]
+
+    def drain_checkpoints(self) -> List[str]:
+        """Drain-checkpoint paths reported by draining ranks, rank
+        order, deduped (on a multi-rank mesh every rank names the same
+        sharded checkpoint directory)."""
+        seen: List[str] = []
+        for _, st in sorted(self._ranks.items()):
+            if st.drain_ckpt and st.drain_ckpt not in seen:
+                seen.append(st.drain_ckpt)
+        return seen
 
     def last_heartbeat_age_s(self, rank: int) -> Optional[float]:
         st = self._ranks.get(rank)

@@ -24,6 +24,7 @@ __all__ = [
     "validate_span_jsonl",
     "validate_chrome_trace",
     "validate_bench_telemetry",
+    "validate_bench_fault",
     "validate_heartbeat",
     "validate_event",
     "validate_log_item",
@@ -162,7 +163,9 @@ _HEARTBEAT_OPTIONAL = {
 }
 
 # Event: structured monitor/worker occurrences (stall, stack_dump,
-# heartbeat_lost, straggler, crash, abort).  rank == -1 means fleet-wide.
+# heartbeat_lost, straggler, crash, abort — and, since the recovery-
+# plane round: drain, preempt_restart, backoff, elastic_restart,
+# ckpt_corrupt).  rank == -1 means fleet-wide.
 _EVENT_REQUIRED = {
     "type": str,          # always "event"
     "kind": str,
@@ -178,6 +181,10 @@ _EVENT_OPTIONAL = {
     "age_s": (int, float),
     "device_memory": dict,
     "detail": dict,
+    "ckpt": str,          # drain / restart / ckpt_corrupt checkpoint path
+    "delay_s": (int, float),    # backoff events: the observed delay
+    "attempt": int,             # backoff / elastic_restart ordinal
+    "recover_s": (int, float),  # elastic_restart: respawn+discovery time
 }
 
 # Log: a rank-tagged forwarded logging record (warning+ severity).
@@ -305,3 +312,19 @@ def validate_bench_telemetry(block: Any,
     (absence of the block entirely is the caller's call — pre-telemetry
     rounds legitimately lack it)."""
     return _check_fields(block, _BENCH_REQUIRED, _BENCH_OPTIONAL, where)
+
+
+# The bench fault block: recovery cost lands in the perf trajectory
+# (crash → resumed wall time, drain checkpoint write time, the backoff
+# actually slept).  Every key is nullable — each probe is best-effort.
+_BENCH_FAULT_OPTIONAL = {
+    "time_to_recover_s": (int, float, type(None)),
+    "drain_checkpoint_s": (int, float, type(None)),
+    "backoff_s": (int, float, type(None)),
+}
+
+
+def validate_bench_fault(block: Any, where: str = "fault") -> List[str]:
+    """Validate the ``fault`` block of a ``BENCH_*.json`` artifact
+    (absent on pre-recovery-plane rounds)."""
+    return _check_fields(block, {}, _BENCH_FAULT_OPTIONAL, where)

@@ -32,15 +32,30 @@ from __future__ import annotations
 
 import os
 import pickle
+import zlib
 from typing import Any, Dict, List, Optional
 
 import jax
 import msgpack
 import numpy as np
 
-__all__ = ["save_shard", "save_meta", "load_sharded", "is_sharded_ckpt"]
+from ray_lightning_tpu.utils.state_stream import (
+    CorruptCheckpointError,
+    verify_stream_file,
+)
+
+__all__ = [
+    "save_shard",
+    "save_meta",
+    "load_sharded",
+    "is_sharded_ckpt",
+    "verify_sharded",
+    "verify_checkpoint",
+    "CorruptCheckpointError",
+]
 
 _META = "META.ckpt"
+_CRC_SUFFIX = ".crc32"
 
 
 def _shard_name(rank: int, world: int) -> str:
@@ -91,7 +106,13 @@ def _dtype_of(name: str) -> np.dtype:
 
 
 def save_shard(tree: Any, dirpath: str, rank: int, world: int) -> str:
-    """Write this process's addressable shards of ``tree`` (atomic)."""
+    """Write this process's addressable shards of ``tree`` (atomic).
+
+    A ``<shard>.crc32`` sidecar records the blob's checksum; rank 0
+    folds every sidecar into META (the authoritative, post-barrier
+    record) so loads can verify each shard without trusting the shard
+    file's own bytes.
+    """
     os.makedirs(dirpath, exist_ok=True)
     leaves, _ = jax.tree_util.tree_flatten(tree)
     blob = msgpack.packb(
@@ -104,18 +125,51 @@ def save_shard(tree: Any, dirpath: str, rank: int, world: int) -> str:
     with open(tmp, "wb") as f:
         f.write(blob)
     os.replace(tmp, path)
+    crc_tmp = f"{path}{_CRC_SUFFIX}.tmp{os.getpid()}"
+    with open(crc_tmp, "w") as f:
+        f.write(str(zlib.crc32(blob)))
+    os.replace(crc_tmp, f"{path}{_CRC_SUFFIX}")
+    from ray_lightning_tpu.fault import inject as _chaos
+
+    _chaos.fire("ckpt_write", path=path, rank=rank)
     return path
+
+
+def _collect_shard_crcs(dirpath: str, world: int) -> Dict[str, int]:
+    """Rank-0, post-barrier: gather every shard's sidecar checksum."""
+    crcs: Dict[str, int] = {}
+    for r in range(world):
+        sidecar = os.path.join(
+            dirpath, _shard_name(r, world) + _CRC_SUFFIX
+        )
+        try:
+            with open(sidecar) as f:
+                crcs[str(r)] = int(f.read().strip())
+        except (OSError, ValueError):
+            continue  # written by an older save_shard — verification
+            # simply skips this rank
+    return crcs
 
 
 def save_meta(tree: Any, dirpath: str, world: int,
               extra: Optional[Dict[str, Any]] = None) -> str:
     """Rank-0 completeness marker.  Callers MUST barrier after
-    ``save_shard`` and before this — META asserts every shard is durable."""
+    ``save_shard`` and before this — META asserts every shard is durable.
+
+    v2 format: the body (treedef + extra + per-shard checksums) is
+    wrapped in a self-checksummed envelope, so a torn/corrupted META is
+    DETECTED rather than unpickled into garbage.  v1 METAs still load.
+    """
     _, treedef = jax.tree_util.tree_flatten(tree)
-    blob = msgpack.packb(
+    body = msgpack.packb(
         {"world": world,
          "treedef": pickle.dumps(treedef),
-         "extra": pickle.dumps(extra or {})},
+         "extra": pickle.dumps(extra or {}),
+         "shard_crcs": _collect_shard_crcs(dirpath, world)},
+        use_bin_type=True,
+    )
+    blob = msgpack.packb(
+        {"v": 2, "crc": zlib.crc32(body), "body": body},
         use_bin_type=True,
     )
     path = os.path.join(dirpath, _META)
@@ -123,7 +177,43 @@ def save_meta(tree: Any, dirpath: str, world: int,
     with open(tmp, "wb") as f:
         f.write(blob)
     os.replace(tmp, path)
+    from ray_lightning_tpu.fault import inject as _chaos
+
+    _chaos.fire("meta_write", path=path)
     return path
+
+
+def _load_meta(dirpath: str) -> Dict[str, Any]:
+    """Read + verify META (v2 envelope or v1 raw body)."""
+    path = os.path.join(dirpath, _META)
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError as e:
+        raise CorruptCheckpointError(f"{path}: unreadable ({e})") from e
+    try:
+        doc = msgpack.unpackb(raw, raw=False)
+    except Exception as e:  # noqa: BLE001 - any parse failure = corrupt
+        raise CorruptCheckpointError(
+            f"{path}: unparsable META ({e})"
+        ) from e
+    if isinstance(doc, dict) and "body" in doc and "crc" in doc:
+        body = doc["body"]
+        actual = zlib.crc32(body)
+        if actual != doc["crc"]:
+            raise CorruptCheckpointError(
+                f"{path}: META checksum mismatch (stored "
+                f"{doc['crc']:#010x}, computed {actual:#010x})"
+            )
+        try:
+            doc = msgpack.unpackb(body, raw=False)
+        except Exception as e:  # noqa: BLE001
+            raise CorruptCheckpointError(
+                f"{path}: unparsable META body ({e})"
+            ) from e
+    if not isinstance(doc, dict) or "world" not in doc:
+        raise CorruptCheckpointError(f"{path}: META has no world size")
+    return doc
 
 
 def is_sharded_ckpt(path: str) -> bool:
@@ -133,12 +223,18 @@ def is_sharded_ckpt(path: str) -> bool:
 
 
 def load_sharded(dirpath: str) -> Dict[str, Any]:
-    """Reassemble a payload dict: ``{"state": host_tree, **extra}``."""
-    with open(os.path.join(dirpath, _META), "rb") as f:
-        meta = msgpack.unpackb(f.read(), raw=False)
+    """Reassemble a payload dict: ``{"state": host_tree, **extra}``.
+
+    Verify-on-load: every shard file's bytes are checked against the
+    META-recorded checksum before anything is deserialized — a
+    bit-flipped or torn shard raises :class:`CorruptCheckpointError`
+    instead of silently resuming garbage into the params.
+    """
+    meta = _load_meta(dirpath)
     world = meta["world"]
     treedef = pickle.loads(meta["treedef"])
     extra = pickle.loads(meta["extra"])
+    shard_crcs = meta.get("shard_crcs") or {}
 
     shard_files = [
         os.path.join(dirpath, _shard_name(r, world)) for r in range(world)
@@ -154,7 +250,21 @@ def load_sharded(dirpath: str) -> Dict[str, Any]:
     covered: List[Optional[np.ndarray]] = []
     for rank, path in enumerate(shard_files):
         with open(path, "rb") as f:
-            payload = msgpack.unpackb(f.read(), raw=False)
+            raw = f.read()
+        expected = shard_crcs.get(str(rank))
+        if expected is not None and zlib.crc32(raw) != expected:
+            raise CorruptCheckpointError(
+                f"sharded checkpoint {dirpath}: "
+                f"{os.path.basename(path)} checksum mismatch — torn "
+                "write or bit corruption"
+            )
+        try:
+            payload = msgpack.unpackb(raw, raw=False)
+        except Exception as e:  # noqa: BLE001 - corrupt ≠ crash-on-load
+            raise CorruptCheckpointError(
+                f"sharded checkpoint {dirpath}: "
+                f"{os.path.basename(path)} is unparsable ({e})"
+            ) from e
         # Guard against rank mixups / stale copies: the file must agree
         # with its own name about who wrote it for which world size.
         if payload.get("rank") != rank or payload.get("world") != world:
@@ -205,3 +315,88 @@ def load_sharded(dirpath: str) -> Dict[str, Any]:
 
     tree = jax.tree_util.tree_unflatten(treedef, leaves)
     return {"state": tree, **extra}
+
+
+def verify_sharded(dirpath: str) -> List[str]:
+    """Integrity problems of a sharded checkpoint (empty = valid):
+    META parse + self-checksum, every shard present, every shard's
+    bytes matching its META-recorded checksum.  Reads shard bytes but
+    deserializes nothing — restart discovery calls this on every
+    candidate while walking back to the newest verified one."""
+    problems: List[str] = []
+    try:
+        meta = _load_meta(dirpath)
+    except CorruptCheckpointError as e:
+        return [str(e)]
+    world = meta["world"]
+    shard_crcs = meta.get("shard_crcs") or {}
+    for r in range(world):
+        path = os.path.join(dirpath, _shard_name(r, world))
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError as e:
+            problems.append(f"{path}: unreadable ({e})")
+            continue
+        expected = shard_crcs.get(str(r))
+        if expected is None:
+            continue  # v1 writer: no checksum recorded for this rank
+        if zlib.crc32(raw) != expected:
+            problems.append(
+                f"{path}: checksum mismatch — torn write or bit "
+                "corruption"
+            )
+    return problems
+
+
+def verify_checkpoint(path: str) -> List[str]:
+    """Integrity problems of ANY checkpoint — sharded directory or
+    single-file state stream (empty = valid)."""
+    if os.path.isdir(path):
+        if not os.path.exists(os.path.join(path, _META)):
+            return [f"{path}: incomplete sharded checkpoint (no META)"]
+        return verify_sharded(path)
+    if os.path.isfile(path):
+        return verify_stream_file(path)
+    return [f"{path}: no such checkpoint"]
+
+
+RESTART_CKPT_PREFIXES = ("restart-epoch-", "drain-step-")
+
+
+def list_restart_candidates(restart_dir: str) -> List[tuple]:
+    """COMPLETE restart/drain checkpoints in ``restart_dir``, newest
+    first — the one enumeration both restart discovery and retention
+    pruning share (diverging filters would let pruning delete a kind
+    discovery still resumes from).
+
+    Ordering: completion mtime (META for directories), then — for the
+    1-second-granularity filesystems shared restart dirs often live on
+    (NFS) — drain checkpoints rank ABOVE epoch checkpoints at equal
+    mtime: a drain is written after the epoch checkpoint it follows in
+    program order, and resuming the older one would replay steps the
+    drain already covered.  Name order breaks remaining ties (both
+    prefixes zero-pad their counters).
+    """
+    entries = []
+    try:
+        names = os.listdir(restart_dir)
+    except OSError:
+        return []
+    for name in names:
+        if not (name.endswith(".ckpt")
+                and name.startswith(RESTART_CKPT_PREFIXES)):
+            continue
+        path = os.path.join(restart_dir, name)
+        if os.path.isdir(path):
+            meta = os.path.join(path, _META)
+            if not os.path.exists(meta):
+                continue  # incomplete write — never a candidate
+            mtime = os.path.getmtime(meta)
+        elif os.path.isfile(path):
+            mtime = os.path.getmtime(path)
+        else:
+            continue
+        drain_rank = 1 if name.startswith("drain-step-") else 0
+        entries.append((mtime, drain_rank, name, path))
+    return sorted(entries, reverse=True)

@@ -1,0 +1,360 @@
+"""Recovery-plane unit tests: the chaos grammar/plan, checkpoint
+integrity (crc frames, per-shard checksums, verified walk-back
+discovery), drain coordination, and restart-governance arithmetic.
+
+The end-to-end acceptance matrix (real worker actors + injected
+faults) lives in ``tests/test_fault_tolerance.py`` / ``tools/
+chaos_sweep.py``; everything here is in-process and fast.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_lightning_tpu.fault import drain as drain_mod
+from ray_lightning_tpu.fault import inject
+from ray_lightning_tpu.fault.drain import PreemptedError
+from ray_lightning_tpu.utils import sharded_ckpt, state_stream
+
+
+# ---------------------------------------------------------------------------
+# RLT_FAULT grammar + plan semantics
+# ---------------------------------------------------------------------------
+
+def test_grammar_parses_full_spec():
+    specs = inject.parse_faults(
+        "crash@step:7,rank:1;hang@step:5,secs:2.5;"
+        "bitflip@point:ckpt_write,nth:2;sigterm@epoch:1,once:0"
+    )
+    assert [s.kind for s in specs] == ["crash", "hang", "bitflip",
+                                      "sigterm"]
+    assert specs[0].step == 7 and specs[0].rank == 1
+    assert specs[1].secs == 2.5
+    assert specs[2].point == "ckpt_write" and specs[2].nth == 2
+    assert specs[3].epoch == 1 and specs[3].once is False
+    assert [s.index for s in specs] == [0, 1, 2, 3]
+
+
+@pytest.mark.parametrize("bad", [
+    "explode@step:1",        # unknown kind
+    "crash@step",            # not key:value
+    "crash@wat:1",           # unknown key
+    "crash@point:nowhere",   # unknown point
+])
+def test_grammar_rejects_typos_loudly(bad):
+    with pytest.raises(ValueError):
+        inject.parse_faults(bad)
+
+
+def test_plan_matches_exact_coordinates_only():
+    plan = inject.FaultPlan(inject.parse_faults("exc@step:2,rank:0"), None)
+    assert not plan.due("step", rank=0, step=1, epoch=0)
+    assert not plan.due("step", rank=1, step=2, epoch=0)
+    assert not plan.due("queue_put", rank=0, step=2, epoch=0)
+    assert len(plan.due("step", rank=0, step=2, epoch=0)) == 1
+
+
+def test_plan_nth_counts_matching_occurrences():
+    plan = inject.FaultPlan(
+        inject.parse_faults("torn@point:ckpt_write,nth:3"), None
+    )
+    assert not plan.due("ckpt_write", None, None, None)
+    assert not plan.due("ckpt_write", None, None, None)
+    assert len(plan.due("ckpt_write", None, None, None)) == 1
+
+
+def test_plan_once_markers_survive_process_restart(tmp_path):
+    state = str(tmp_path / "chaos")
+    plan = inject.FaultPlan(inject.parse_faults("exc@step:2"), state)
+    (spec,) = plan.due("step", None, 2, None)
+    plan.mark_fired(spec)
+    # Same plan: marker blocks a refire.
+    assert not plan.due("step", None, 2, None)
+    # A FRESH plan (= the respawned worker process) sees the marker too.
+    fresh = inject.FaultPlan(inject.parse_faults("exc@step:2"), state)
+    assert not fresh.due("step", None, 2, None)
+
+
+def test_fire_reads_env_and_raises(monkeypatch, tmp_path):
+    monkeypatch.setenv("RLT_FAULT", "exc@step:4,rank:0")
+    monkeypatch.setenv("RLT_FAULT_STATE", str(tmp_path / "chaos"))
+    inject.set_rank(0)
+    try:
+        inject.fire("step", step=3, epoch=0)  # no match
+        with pytest.raises(inject.FaultInjected):
+            inject.fire("step", step=4, epoch=0)
+        # once=1: the marker blocks a second firing.
+        inject.fire("step", step=4, epoch=0)
+    finally:
+        inject.set_rank(None)
+
+
+def test_fire_is_inert_without_env(monkeypatch):
+    monkeypatch.delenv("RLT_FAULT", raising=False)
+    inject.fire("step", step=0, epoch=0, rank=0)  # must be a no-op
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint integrity: crc frames, shard checksums, verified discovery
+# ---------------------------------------------------------------------------
+
+def _write_stream_ckpt(path, value=5):
+    stream = state_stream.to_state_stream(
+        {"w": np.arange(value, dtype=np.float32)}
+    )
+    state_stream.state_stream_to_file(stream, str(path))
+    return str(path)
+
+
+def test_stream_file_crc_roundtrip_and_corruption(tmp_path):
+    path = _write_stream_ckpt(tmp_path / "m.ckpt")
+    assert state_stream.verify_stream_file(path) == []
+    back = state_stream.load_state_stream(
+        state_stream.state_stream_from_file(path)
+    )
+    np.testing.assert_array_equal(back["w"], np.arange(5, dtype=np.float32))
+    # Raw-bytes path (open().read()) accepts the framed file too.
+    back2 = state_stream.load_state_stream(open(path, "rb").read())
+    np.testing.assert_array_equal(back2["w"], back["w"])
+    inject._corrupt_bitflip(path)
+    assert state_stream.verify_stream_file(path)
+    with pytest.raises(state_stream.CorruptCheckpointError):
+        state_stream.state_stream_from_file(path)
+
+
+def test_stream_file_legacy_unframed_still_loads(tmp_path):
+    path = str(tmp_path / "legacy.ckpt")
+    stream = state_stream.to_state_stream({"w": np.ones(3, np.float32)})
+    with open(path, "wb") as f:  # pre-crc writer: raw msgpack bytes
+        f.write(stream)
+    assert state_stream.verify_stream_file(path) == []
+    back = state_stream.load_state_stream(
+        state_stream.state_stream_from_file(path)
+    )
+    np.testing.assert_array_equal(back["w"], np.ones(3, np.float32))
+
+
+def _write_sharded(tmp_path, name, epoch):
+    tree = {"w": jnp.arange(16.0) + epoch, "step": jnp.int32(epoch)}
+    tag = str(tmp_path / name)
+    sharded_ckpt.save_shard(tree, tag, 0, 1)
+    sharded_ckpt.save_meta(tree, tag, 1, extra={"epoch": epoch})
+    return tag
+
+
+def test_sharded_checksums_catch_bitflip_and_torn(tmp_path):
+    tag = _write_sharded(tmp_path, "restart-epoch-000000.ckpt", 0)
+    assert sharded_ckpt.verify_sharded(tag) == []
+    shard = os.path.join(tag, "shard-00000-of-00001.ckpt")
+    inject._corrupt_bitflip(shard)
+    assert sharded_ckpt.verify_sharded(tag)
+    with pytest.raises(sharded_ckpt.CorruptCheckpointError):
+        sharded_ckpt.load_sharded(tag)
+    tag2 = _write_sharded(tmp_path, "restart-epoch-000001.ckpt", 1)
+    inject._corrupt_torn(os.path.join(tag2, "shard-00000-of-00001.ckpt"))
+    assert sharded_ckpt.verify_sharded(tag2)
+
+
+def test_meta_self_checksum_catches_corruption(tmp_path):
+    tag = _write_sharded(tmp_path, "restart-epoch-000000.ckpt", 0)
+    inject._corrupt_bitflip(os.path.join(tag, "META.ckpt"))
+    problems = sharded_ckpt.verify_sharded(tag)
+    assert problems, "corrupted META passed verification"
+
+
+def test_discovery_walks_back_to_newest_verified(tmp_path):
+    from ray_lightning_tpu.parallel.strategies import (
+        _remote_latest_restart_checkpoint,
+    )
+
+    good = _write_sharded(tmp_path, "restart-epoch-000000.ckpt", 0)
+    bad = _write_sharded(tmp_path, "restart-epoch-000001.ckpt", 1)
+    # Make mtime ordering deterministic: the corrupt one is newest.
+    os.utime(os.path.join(good, "META.ckpt"), (1_000_000, 1_000_000))
+    inject._corrupt_bitflip(os.path.join(bad, "shard-00000-of-00001.ckpt"))
+    info = _remote_latest_restart_checkpoint(str(tmp_path))
+    assert info["path"] == good
+    assert [c["path"] for c in info["corrupt"]] == [bad]
+    # With the newest intact it wins outright.
+    good2 = _write_sharded(tmp_path, "drain-step-00000042.ckpt", 2)
+    info2 = _remote_latest_restart_checkpoint(str(tmp_path))
+    assert info2["path"] == good2 and info2["corrupt"] == []
+
+
+def test_discovery_ignores_incomplete_and_empty(tmp_path):
+    from ray_lightning_tpu.parallel.strategies import (
+        _remote_latest_restart_checkpoint,
+    )
+
+    assert _remote_latest_restart_checkpoint(str(tmp_path)) == {
+        "path": None, "corrupt": []
+    }
+    os.makedirs(tmp_path / "restart-epoch-000000.ckpt")  # no META
+    assert _remote_latest_restart_checkpoint(
+        str(tmp_path)
+    )["path"] is None
+
+
+# ---------------------------------------------------------------------------
+# Drain coordination + PreemptedError transport
+# ---------------------------------------------------------------------------
+
+def test_drain_request_reset_cycle():
+    drain_mod.reset_drain()
+    assert not drain_mod.drain_requested()
+    drain_mod.request_drain("unit-test")
+    assert drain_mod.drain_requested()
+    assert drain_mod.drain_reason() == "unit-test"
+    drain_mod.request_drain("second")  # first reason wins
+    assert drain_mod.drain_reason() == "unit-test"
+    drain_mod.reset_drain()
+    assert not drain_mod.drain_requested()
+    assert drain_mod.drain_reason() is None
+
+
+def test_preempted_error_pickles_with_fields():
+    from ray_lightning_tpu.cluster import rpc
+
+    err = PreemptedError(
+        "fit preempted (test)", checkpoint="/tmp/d.ckpt", step=7,
+        epoch=2, rank=1, reason="signal:SIGTERM", drain_s=0.25,
+    )
+    back = rpc.loads(rpc.dumps(err))
+    assert isinstance(back, PreemptedError)
+    assert back.checkpoint == "/tmp/d.ckpt"
+    assert back.step == 7 and back.epoch == 2 and back.rank == 1
+    assert back.reason == "signal:SIGTERM" and back.drain_s == 0.25
+    assert "fit preempted" in str(back)
+
+
+def test_drain_poll_reduces_across_mesh(cpu_mesh_devices):
+    """The drain-agreement collective: any process's flag drains all.
+    Exercised on a single-process 8-device mesh (the multi-process
+    topology is environment-gated), where the reduction semantics are
+    identical."""
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    from ray_lightning_tpu.core.loop import _make_drain_poll
+
+    mesh = Mesh(mesh_utils.create_device_mesh((8,)), ("data",))
+    poll = _make_drain_poll(mesh, world_size=8)
+    assert poll is not None
+    assert poll(False) is False
+    assert poll(True) is True
+    # world_size 1 / no mesh: the zero-overhead local path.
+    assert _make_drain_poll(mesh, 1) is None
+    assert _make_drain_poll(None, 8) is None
+
+
+def test_inline_drain_writes_checkpoint_and_resumes(tmp_path):
+    """LocalStrategy drain end-to-end: PreemptedError names a
+    step-granular checkpoint; resuming from it completes the fit with
+    no lost or repeated steps."""
+    from ray_lightning_tpu.core.callbacks import Callback
+    from ray_lightning_tpu.core.trainer import Trainer
+    from ray_lightning_tpu.models.boring import (
+        BoringDataModule,
+        BoringModel,
+    )
+    from ray_lightning_tpu.parallel.strategies import LocalStrategy
+
+    class DrainAt(Callback):
+        def on_train_batch_end(self, trainer, module, logs, batch_idx):
+            if trainer.micro_step == 3:
+                drain_mod.request_drain("unit-test")
+
+    trainer = Trainer(
+        strategy=LocalStrategy(), max_epochs=3,
+        default_root_dir=str(tmp_path), enable_checkpointing=False,
+        limit_train_batches=2, limit_val_batches=1,
+        callbacks=[DrainAt()],
+    )
+    with pytest.raises(PreemptedError) as err:
+        trainer.fit(BoringModel(), BoringDataModule(batch_size=16))
+    ckpt = err.value.checkpoint
+    assert ckpt and os.path.exists(ckpt)
+    assert err.value.step == 3 and err.value.drain_s is not None
+
+    resumed = Trainer(
+        strategy=LocalStrategy(), max_epochs=3,
+        default_root_dir=str(tmp_path), enable_checkpointing=False,
+        limit_train_batches=2, limit_val_batches=1,
+        resume_from_checkpoint=ckpt,
+    )
+    resumed.fit(BoringModel(), BoringDataModule(batch_size=16))
+    assert resumed.epochs_run == 3
+    assert resumed.micro_step == 6  # 3 pre-drain + 3 post-resume
+
+
+def test_drain_checkpoint_prefers_restart_dir(tmp_path):
+    """With a caller-provided restart_dir, drain checkpoints land there
+    (one place to look for ALL recovery state)."""
+    from ray_lightning_tpu.core.callbacks import Callback
+    from ray_lightning_tpu.core.trainer import Trainer
+    from ray_lightning_tpu.models.boring import (
+        BoringDataModule,
+        BoringModel,
+    )
+    from ray_lightning_tpu.parallel.strategies import LocalStrategy
+
+    class DrainNow(Callback):
+        def on_train_batch_end(self, trainer, module, logs, batch_idx):
+            drain_mod.request_drain("unit-test")
+
+    restart_dir = str(tmp_path / "recovery")
+    trainer = Trainer(
+        strategy=LocalStrategy(), max_epochs=2,
+        default_root_dir=str(tmp_path), enable_checkpointing=False,
+        limit_train_batches=2, restart_dir=restart_dir,
+        callbacks=[DrainNow()],
+    )
+    with pytest.raises(PreemptedError) as err:
+        trainer.fit(BoringModel(), BoringDataModule(batch_size=16))
+    assert err.value.checkpoint.startswith(restart_dir)
+
+
+# ---------------------------------------------------------------------------
+# Restart governance arithmetic
+# ---------------------------------------------------------------------------
+
+def test_backoff_schedule_grows_caps_and_jitters():
+    from ray_lightning_tpu.parallel.strategies import RayStrategy
+
+    s = RayStrategy(num_workers=1, max_restarts=3, restart_backoff_s=1.0,
+                    restart_backoff_max_s=8.0)
+    for streak, base in ((1, 1.0), (2, 2.0), (3, 4.0), (4, 8.0),
+                         (10, 8.0)):  # capped
+        for _ in range(5):
+            d = s._backoff_delay(streak)
+            assert base <= d <= base * 1.25, (streak, d)
+    off = RayStrategy(num_workers=1, max_restarts=1,
+                      restart_backoff_s=0.0)
+    assert off._backoff_delay(1) == 0.0
+
+
+def test_recovery_events_are_schema_valid():
+    from ray_lightning_tpu.parallel.strategies import RayStrategy
+    from ray_lightning_tpu.telemetry.schema import validate_stream_item
+
+    s = RayStrategy(num_workers=1, max_restarts=1)
+    s._record_recovery("backoff", delay_s=1.5, attempt=1, message="t")
+    s._record_recovery("elastic_restart", attempt=1, recover_s=0.4,
+                       ckpt="/tmp/x.ckpt", message="t")
+    s._record_recovery("ckpt_corrupt", ckpt="/tmp/y.ckpt", message="t")
+    s._record_recovery("preempt_restart", ckpt="/tmp/z.ckpt", message="t")
+    for ev in s.recovery_events:
+        assert validate_stream_item(ev, ev["kind"]) == []
+
+
+def test_restart_knob_validation():
+    from ray_lightning_tpu.parallel.strategies import RayStrategy
+
+    with pytest.raises(ValueError):
+        RayStrategy(num_workers=1, restart_window_s=0)
+    with pytest.raises(ValueError):
+        RayStrategy(num_workers=1, restart_backoff_s=-1)

@@ -145,9 +145,15 @@ def test_incomplete_checkpoint_is_ignored(tmp_path):
     sharded_ckpt.save_shard(tree, tag, rank=0, world=2)
     # no META, only 1/2 shards
     assert not sharded_ckpt.is_sharded_ckpt(tag)
-    assert _remote_latest_restart_checkpoint(str(rdir)) is None
+    assert _remote_latest_restart_checkpoint(str(rdir))["path"] is None
     sharded_ckpt.save_meta(tree, tag, world=2)
-    assert _remote_latest_restart_checkpoint(str(rdir)) == tag
+    # META present but a shard file is gone: discovery VERIFIES and
+    # walks past it (previous-good fallback) instead of handing the
+    # resume a checkpoint that cannot load...
+    info = _remote_latest_restart_checkpoint(str(rdir))
+    assert info["path"] is None
+    assert [c["path"] for c in info["corrupt"]] == [tag]
+    # ...and a direct load of the broken checkpoint stays loud.
     with pytest.raises(FileNotFoundError, match="missing"):
         sharded_ckpt.load_sharded(tag)
 
@@ -168,8 +174,12 @@ def test_resume_from_sharded_checkpoint(tmp_path):
         restart_dir=rs, restart_every_n_epochs=1,
     )
     res1 = run_fit(BoringModel(), dm(), cfg1, callbacks=[])
-    tag = _remote_latest_restart_checkpoint(rs)
+    # Discovery returns the newest VERIFIED checkpoint plus any
+    # corrupt ones it walked past (the previous-good fallback).
+    info = _remote_latest_restart_checkpoint(rs)
+    tag = info["path"]
     assert tag is not None and sharded_ckpt.is_sharded_ckpt(tag)
+    assert info["corrupt"] == []
 
     cfg2 = FitConfig(
         max_epochs=4, seed=0, default_root_dir=str(tmp_path),

@@ -1,0 +1,248 @@
+"""Chaos sweep: run the fault matrix end-to-end and print a recovery
+scorecard.
+
+Two modes:
+
+* ``--selftest`` (wired into ``format.sh`` layer 5): fast,
+  subprocess-free checks of the chaos plane itself — the ``RLT_FAULT``
+  grammar, deterministic (point, rank, step, nth) matching,
+  exactly-once markers, the torn/bit-flip file corruptors, and the
+  checkpoint verifier catching what they break.  Seconds, zero
+  accelerator work.
+* default: the full acceptance matrix — for each fault kind a real
+  multi-process fit (worker actors on the CPU-simulated mesh) with the
+  fault injected deterministically, asserting the fit completes with
+  the correct final step count and the right recovery events.  This is
+  the same matrix ``tests/test_fault_tolerance.py`` runs under pytest
+  (``-m chaos``); the tool form prints a scorecard and exits non-zero
+  on any unrecovered scenario.
+
+Usage::
+
+    python tools/chaos_sweep.py --selftest
+    python tools/chaos_sweep.py                  # full matrix, 1 worker
+    python tools/chaos_sweep.py --workers 2      # multi-process mesh
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------------------
+# --selftest: the chaos plane itself (no subprocesses, no jax fits)
+# ---------------------------------------------------------------------------
+
+def _selftest() -> list:
+    problems: list = []
+
+    def check(cond: bool, what: str) -> None:
+        if not cond:
+            problems.append(what)
+
+    from ray_lightning_tpu.fault import inject
+
+    # Grammar round-trip.
+    specs = inject.parse_faults(
+        "crash@step:7,rank:1;hang@step:5,secs:120;"
+        "bitflip@point:ckpt_write,nth:2;sigterm@step:3,once:0"
+    )
+    check(len(specs) == 4, "grammar: expected 4 specs")
+    check(specs[0].kind == "crash" and specs[0].step == 7
+          and specs[0].rank == 1, "grammar: crash spec fields")
+    check(specs[1].secs == 120.0, "grammar: secs parse")
+    check(specs[2].point == "ckpt_write" and specs[2].nth == 2,
+          "grammar: point/nth parse")
+    check(specs[3].once is False, "grammar: once:0 parse")
+    for bad in ("explode@step:1", "crash@step", "crash@wat:1",
+                "crash@point:nowhere"):
+        try:
+            inject.parse_faults(bad)
+            problems.append(f"grammar: {bad!r} should not parse")
+        except ValueError:
+            pass
+
+    # Deterministic matching + exactly-once markers.
+    with tempfile.TemporaryDirectory(prefix="rlt_chaos_") as tmp:
+        plan = inject.FaultPlan(
+            inject.parse_faults("exc@step:2,rank:0"), tmp
+        )
+        check(not plan.due("step", rank=0, step=1, epoch=0),
+              "match: wrong step fired")
+        check(not plan.due("step", rank=1, step=2, epoch=0),
+              "match: wrong rank fired")
+        due = plan.due("step", rank=0, step=2, epoch=0)
+        check(len(due) == 1, "match: exact coordinates did not fire")
+        plan.mark_fired(due[0])
+        check(not plan.due("step", rank=0, step=2, epoch=0),
+              "once: refired after marker")
+        fresh = inject.FaultPlan(
+            inject.parse_faults("exc@step:2,rank:0"), tmp
+        )
+        check(not fresh.due("step", rank=0, step=2, epoch=0),
+              "once: marker did not survive a new plan (restart)")
+
+        # nth occurrence counting.
+        plan2 = inject.FaultPlan(
+            inject.parse_faults("torn@point:ckpt_write,nth:2"), None
+        )
+        check(not plan2.due("ckpt_write", None, None, None),
+              "nth: first occurrence fired")
+        check(len(plan2.due("ckpt_write", None, None, None)) == 1,
+              "nth: second occurrence did not fire")
+
+        # Corruptors vs the checkpoint verifier.
+        from ray_lightning_tpu.utils.state_stream import (
+            state_stream_to_file,
+            to_state_stream,
+            verify_stream_file,
+        )
+
+        import numpy as np
+
+        path = os.path.join(tmp, "ck.ckpt")
+        state_stream_to_file(
+            to_state_stream({"w": np.arange(64, dtype=np.float32)}), path
+        )
+        check(verify_stream_file(path) == [], "verify: pristine flagged")
+        inject._corrupt_bitflip(path)
+        check(bool(verify_stream_file(path)), "verify: bitflip missed")
+        state_stream_to_file(
+            to_state_stream({"w": np.arange(64, dtype=np.float32)}), path
+        )
+        inject._corrupt_torn(path)
+        check(bool(verify_stream_file(path)), "verify: torn missed")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# Full matrix: real fits with injected faults
+# ---------------------------------------------------------------------------
+
+# (name, RLT_FAULT value, strategy overrides) — each scenario trains
+# 3 epochs x 2 batches on the boring model and must complete with
+# global_step == 6 after recovering.
+_MATRIX = [
+    ("crash", "crash@step:3,rank:0", {}),
+    ("spawn-crash", "crash@point:spawn,rank:0", {}),
+    ("sigterm-preempt", "sigterm@step:3,rank:0", {}),
+    ("hang-abort", "hang@step:3,rank:0,secs:120", {
+        "telemetry": {"tier": "cheap", "heartbeat_s": 0.2},
+        "monitor": {"hang_intervals": 2, "abort_after_s": 0.5},
+    }),
+    ("torn-ckpt", "torn@point:ckpt_write,nth:2,rank:0;crash@step:5,rank:0",
+     {}),
+    ("bitflip-ckpt",
+     "bitflip@point:ckpt_write,nth:2,rank:0;crash@step:5,rank:0", {}),
+]
+
+
+def _run_scenario(name: str, fault: str, overrides: dict,
+                  workers: int) -> dict:
+    from ray_lightning_tpu.core.trainer import Trainer
+    from ray_lightning_tpu.models.boring import (
+        BoringDataModule,
+        BoringModel,
+    )
+    from ray_lightning_tpu.parallel.strategies import RayStrategy
+
+    out = {"name": name, "ok": False, "error": "", "events": [],
+           "restarts": 0, "preempts": 0, "wall_s": 0.0}
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory(prefix=f"rlt_chaos_{name}_") as tmp:
+        os.environ["RLT_FAULT"] = fault
+        os.environ["RLT_FAULT_STATE"] = os.path.join(tmp, "chaos-state")
+        try:
+            strategy = RayStrategy(
+                num_workers=workers, max_restarts=1,
+                restart_backoff_s=0.05, **overrides,
+            )
+            trainer = Trainer(
+                strategy=strategy, max_epochs=3, default_root_dir=tmp,
+                limit_train_batches=2, limit_val_batches=1,
+                enable_checkpointing=False,
+            )
+            trainer.fit(BoringModel(), BoringDataModule(batch_size=16))
+            out["events"] = sorted({
+                e["kind"] for e in trainer.monitor_report.get("events", [])
+            })
+            out["restarts"] = strategy.restarts_used
+            out["preempts"] = strategy.preempt_restarts_used
+            if trainer.global_step != 6:
+                out["error"] = (
+                    f"global_step {trainer.global_step} != 6"
+                )
+            elif name == "sigterm-preempt" and strategy.restarts_used:
+                out["error"] = "preemption consumed the restart budget"
+            else:
+                out["ok"] = True
+        except Exception as e:  # noqa: BLE001 - scorecard, not traceback
+            out["error"] = f"{type(e).__name__}: {e}"
+        finally:
+            os.environ.pop("RLT_FAULT", None)
+            os.environ.pop("RLT_FAULT_STATE", None)
+    out["wall_s"] = round(time.monotonic() - t0, 1)
+    return out
+
+
+def _print_scorecard(rows: list) -> None:
+    width = max(len(r["name"]) for r in rows) + 2
+    print(f"\n{'scenario':<{width}}{'result':<10}{'wall':<8}"
+          f"{'restarts':<10}{'preempts':<10}events")
+    for r in rows:
+        verdict = "RECOVERED" if r["ok"] else "FAILED"
+        extra = ",".join(r["events"]) or "-"
+        print(f"{r['name']:<{width}}{verdict:<10}{r['wall_s']:<8}"
+              f"{r['restarts']:<10}{r['preempts']:<10}{extra}")
+        if r["error"]:
+            print(f"{'':<{width}}  {r['error']}")
+    good = sum(r["ok"] for r in rows)
+    print(f"\nchaos_sweep: {good}/{len(rows)} scenarios recovered")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Deterministic fault-injection sweep "
+        "(docs/FAULT_TOLERANCE.md)."
+    )
+    ap.add_argument("--selftest", action="store_true",
+                    help="fast chaos-plane self-checks only (no fits)")
+    ap.add_argument("--workers", type=int, default=1,
+                    help="worker actors per scenario (default 1; >1 "
+                    "needs a backend whose mesh spans processes)")
+    ap.add_argument("--only", default=None,
+                    help="run a single scenario by name")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        problems = _selftest()
+        for p in problems:
+            print(f"chaos_sweep selftest: {p}", file=sys.stderr)
+        print("chaos_sweep selftest: "
+              + ("FAILED" if problems else "OK"))
+        return 1 if problems else 0
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    rows = []
+    for name, fault, overrides in _MATRIX:
+        if args.only and name != args.only:
+            continue
+        print(f"chaos_sweep: running {name} ({fault}) ...", flush=True)
+        rows.append(_run_scenario(name, fault, overrides, args.workers))
+    _print_scorecard(rows)
+    return 0 if all(r["ok"] for r in rows) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,7 @@ import tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from ray_lightning_tpu.telemetry.schema import (  # noqa: E402
+    validate_bench_fault,
     validate_bench_telemetry,
     validate_chrome_trace,
     validate_flight_bundle,
@@ -95,6 +96,30 @@ def _self_test_live_plane(tmp: str) -> list:
         make_event("stall", 2, age_s=1.5, message="self-test"),
         "self-test event",
     )
+    # Recovery-plane event shapes (fault/drain + restart governance):
+    # the drain event a worker publishes, and the strategy's backoff /
+    # elastic_restart / ckpt_corrupt records seeded into the monitor.
+    problems += validate_stream_item(
+        make_event("drain", 0, message="self-test drain",
+                   ckpt="/tmp/drain-step-00000007.ckpt"),
+        "self-test drain event",
+    )
+    problems += validate_stream_item(
+        make_event("backoff", -1, delay_s=1.5, attempt=1,
+                   message="self-test backoff"),
+        "self-test backoff event",
+    )
+    problems += validate_stream_item(
+        make_event("elastic_restart", -1, attempt=1, recover_s=0.8,
+                   ckpt="/tmp/restart-epoch-000001.ckpt",
+                   message="self-test restart"),
+        "self-test restart event",
+    )
+    problems += validate_stream_item(
+        make_event("ckpt_corrupt", -1, ckpt="/tmp/bad.ckpt",
+                   message="self-test corrupt"),
+        "self-test ckpt_corrupt event",
+    )
     problems += validate_stream_item(
         make_log_item(0, "WARNING", "self.test", "hello"),
         "self-test log",
@@ -139,9 +164,11 @@ def scan_bench_files() -> list:
             problems.append(f"{name}: not JSON ({e})")
             continue
         block = doc.get("telemetry")
-        if block is None:
-            continue  # pre-telemetry round
-        problems += validate_bench_telemetry(block, f"{name}:telemetry")
+        if block is not None:
+            problems += validate_bench_telemetry(block, f"{name}:telemetry")
+        fault = doc.get("fault")
+        if fault is not None:  # pre-recovery-plane rounds lack it
+            problems += validate_bench_fault(fault, f"{name}:fault")
     return problems
 
 
