@@ -60,6 +60,7 @@ from ray_lightning_tpu.telemetry import (
 WARMUP_STEPS = 3
 WINDOW_STEPS = 8          # steps per timing window
 WINDOWS = 3               # median-of-k windows (k >= 3)
+MEGASTEP_K = 8            # the host_overhead block's megastep A/B arm
 # First recorded number for this config family (BENCH_r01.json, round 1:
 # raw-step path, B=8, XLA-recompute attention backward).
 R1_TOKENS_PER_SEC = 66010.1
@@ -78,22 +79,30 @@ class _StepTimer(Callback):
     Sync discipline: device->host transfer of the loss (on the
     experimental remote-TPU platform ``block_until_ready`` can return
     before execution finishes, but a host copy cannot).
+
+    Megastep-aware: the hook fires once per stride there, so marks are
+    taken at threshold CROSSINGS (step may jump past the exact multiple)
+    and each mark records the step count — window throughput divides by
+    the steps a window actually covered, not a nominal constant.
     """
 
     def __init__(self):
-        self.marks = []
+        self.marks = []  # [(perf_counter, micro_step)]
 
     def on_train_batch_end(self, trainer, module, logs, batch_idx):
         step = trainer.micro_step if hasattr(trainer, "micro_step") else (
             trainer.global_step)
-        if (step >= WARMUP_STEPS
-                and (step - WARMUP_STEPS) % WINDOW_STEPS == 0
-                and len(self.marks) <= WINDOWS):
+        threshold = WARMUP_STEPS + len(self.marks) * WINDOW_STEPS
+        if step >= threshold and len(self.marks) <= WINDOWS:
             float(jax.device_get(logs["train_loss"]))
-            self.marks.append(time.perf_counter())
+            self.marks.append((time.perf_counter(), step))
 
     def window_times(self):
-        return [b - a for a, b in zip(self.marks, self.marks[1:])]
+        """Per-window (seconds, steps) pairs."""
+        return [
+            (b[0] - a[0], b[1] - a[1])
+            for a, b in zip(self.marks, self.marks[1:])
+        ]
 
 
 def _bench_raw_step(module: GPT, cfg: GPTConfig, batch_size: int):
@@ -124,14 +133,22 @@ def _bench_raw_step(module: GPT, cfg: GPTConfig, batch_size: int):
     return _median_spread(windows)
 
 
-def _bench_fit(module: GPT, cfg: GPTConfig, batch_size: int):
+def _bench_fit(module: GPT, cfg: GPTConfig, batch_size: int,
+               megastep=None):
     """Median tokens/s through the real Trainer.fit() path.  Also
     returns the run's fleet telemetry report (the BENCH_* telemetry
-    block, making the perf trajectory machine-comparable)."""
+    block, making the perf trajectory machine-comparable).
+    ``megastep`` drives the A/B arm of the ``host_overhead`` block
+    (None = the default auto resolution)."""
     timer = _StepTimer()
     total = WARMUP_STEPS + WINDOWS * WINDOW_STEPS + 1
+    if isinstance(megastep, int) and megastep > 1:
+        # Whole strides only: a ragged tail would fall back to the
+        # per-step path and pay ITS first-use jit compile inside a
+        # timed window — the A/B must measure steady-state strides.
+        total = ((total + megastep - 1) // megastep) * megastep
     trainer = Trainer(
-        strategy=LocalStrategy(),
+        strategy=LocalStrategy(megastep=megastep),
         max_epochs=1,
         limit_train_batches=total,
         limit_val_batches=0,
@@ -154,12 +171,56 @@ def _bench_fit(module: GPT, cfg: GPTConfig, batch_size: int):
     # raw-step path is genuinely single-device, mesh=None).
     n_chips = jax.local_device_count()
     tps = [
-        WINDOW_STEPS * batch_size * cfg.seq_len / dt / n_chips
-        for dt in times[:WINDOWS]
+        steps * batch_size * cfg.seq_len / dt / n_chips
+        for dt, steps in times[:WINDOWS]
+        if steps > 0
     ]
     med, spread = _median_spread(tps)
     monitor_events = len(trainer.monitor_report.get("events", []))
-    return med, spread, trainer.telemetry_report, monitor_events
+    return med, spread, trainer.telemetry_report, monitor_events, trainer
+
+
+def _dispatches_per_opt_step(trainer) -> float:
+    """Jit dispatches per optimizer update, from the fit's telemetry
+    counters (the host-dispatch acceptance number: ~1.0 per-step,
+    ~1/K under megastep)."""
+    counters = trainer.telemetry_report.get("counters", {})
+    dispatches = (counters.get("train_dispatches") or {}).get("mean")
+    if not dispatches or not trainer.global_step:
+        return None
+    return round(float(dispatches) / trainer.global_step, 4)
+
+
+def _bench_host_overhead(make_module, cfg, batch_size, fit_tps,
+                         raw_tps, headline_trainer) -> dict:
+    """The schema-gated ``host_overhead`` block: Trainer-path overhead
+    (``fit_vs_raw``), dispatch accounting for the headline fit, and a
+    megastep=MEGASTEP_K on/off A/B.  Best-effort per probe — a failed
+    arm nulls its fields, never the headline line."""
+    block = {
+        "fit_vs_raw": round(fit_tps / raw_tps, 3) if raw_tps else None,
+        "dispatches_per_opt_step": _dispatches_per_opt_step(
+            headline_trainer
+        ),
+        "megastep_k": MEGASTEP_K,
+        "megastep_dispatches_per_opt_step": None,
+        "megastep_tokens_per_sec": None,
+        "megastep_speedup": None,
+    }
+    try:
+        mega_tps, _, _, _, mega_trainer = _bench_fit(
+            make_module(), cfg, batch_size, megastep=MEGASTEP_K
+        )
+        block["megastep_tokens_per_sec"] = round(mega_tps, 1)
+        block["megastep_speedup"] = (
+            round(mega_tps / fit_tps, 3) if fit_tps else None
+        )
+        block["megastep_dispatches_per_opt_step"] = (
+            _dispatches_per_opt_step(mega_trainer)
+        )
+    except Exception as e:  # noqa: BLE001 - probe must not cost the line
+        sys.stderr.write(f"megastep A/B skipped: {e}\n")
+    return block
 
 
 def _bench_boring_fit(tier, steps: int = 80) -> float:
@@ -187,7 +248,7 @@ def _bench_boring_fit(tier, steps: int = 80) -> float:
     times = timer.window_times()
     assert len(times) >= WINDOWS
     return _median_spread(
-        [dt / WINDOW_STEPS for dt in times[:WINDOWS]]
+        [dt / steps for dt, steps in times[:WINDOWS] if steps > 0]
     )[0]
 
 
@@ -428,9 +489,18 @@ def main() -> None:
 
     kernel_path = _kernel_paths(cfg, on_tpu)
     raw_tps, raw_spread = _bench_raw_step(make_module(), cfg, batch_size)
-    fit_tps, fit_spread, tel_report, monitor_events = _bench_fit(
-        make_module(), cfg, batch_size
+    # Headline fit pins megastep OFF so the metric stays comparable with
+    # every prior round; the host_overhead block carries the fused arm.
+    fit_tps, fit_spread, tel_report, monitor_events, fit_trainer = (
+        _bench_fit(make_module(), cfg, batch_size, megastep="off")
     )
+    try:
+        host_overhead = _bench_host_overhead(
+            make_module, cfg, batch_size, fit_tps, raw_tps, fit_trainer
+        )
+    except Exception as e:  # noqa: BLE001 - probe must not cost the line
+        sys.stderr.write(f"host_overhead probes skipped: {e}\n")
+        host_overhead = None
     gen_tps, gen_tps_int8 = _bench_generate(make_module(), cfg, on_tpu)
     try:
         overhead_pct = round(_telemetry_overhead_pct(), 3)
@@ -501,6 +571,11 @@ def main() -> None:
         # telemetry block): injected-crash recovery wall time, drain-
         # checkpoint write time, observed backoff delay.
         "fault": fault_block,
+        # Host-dispatch accounting (schema-gated): the Trainer-path
+        # overhead budget, jit dispatches per optimizer step, and the
+        # megastep on/off A/B (docs/PERFORMANCE.md "Host dispatch &
+        # megastep").
+        "host_overhead": host_overhead,
         "windows": WINDOWS,
         "window_steps": WINDOW_STEPS,
         "bottleneck": "attention bwd kernel + scan residual-save HBM "

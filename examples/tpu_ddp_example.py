@@ -30,6 +30,7 @@ def train_mnist(
     grad_comm: str = "full",
     telemetry: str = "cheap",
     heartbeat_s: float = 5.0,
+    megastep: str = "auto",
 ):
     """≙ reference ``train_mnist`` (``ray_ddp_example.py:18-52``)."""
     callbacks = (
@@ -50,7 +51,11 @@ def train_mnist(
         # heartbeat_s sets the live-monitor cadence (--heartbeat; watch
         # the run with `python tools/rlt_top.py rlt_logs/mnist_ddp/
         # telemetry`); 0 disables the publisher.
+        # megastep fuses K micro-steps into one compiled scan dispatch
+        # (--megastep; "auto" = K=8 on TPU, off on CPU — see
+        # docs/PERFORMANCE.md "Host dispatch & megastep").
         strategy=RayStrategy(num_workers=num_workers, grad_comm=grad_comm,
+                             megastep=megastep,
                              telemetry={"tier": telemetry,
                                         "heartbeat_s": heartbeat_s}
                              if telemetry != "off" else "off"),
@@ -112,6 +117,10 @@ if __name__ == "__main__":
     parser.add_argument("--heartbeat", type=float, default=5.0,
                         help="live-monitor heartbeat cadence in seconds "
                         "(0 disables; see docs/OBSERVABILITY.md)")
+    parser.add_argument("--megastep", default="auto",
+                        help="micro-steps fused per compiled dispatch: "
+                        "'auto' (K=8 on TPU, off on CPU), 'off', or an "
+                        "integer K (docs/PERFORMANCE.md)")
     args = parser.parse_args()
 
     epochs = 1 if args.smoke_test else args.num_epochs
@@ -123,6 +132,7 @@ if __name__ == "__main__":
             {}, num_workers=args.num_workers, num_epochs=epochs,
             batch_size=args.batch_size, grad_comm=args.grad_comm,
             telemetry=args.telemetry, heartbeat_s=args.heartbeat,
+            megastep=args.megastep,
         )
         print("final metrics:", {
             k: round(v, 4) for k, v in trainer.callback_metrics.items()

@@ -15,6 +15,8 @@
 #      repo .style.yapf;
 #   4. telemetry artifact schema gate (tools/check_telemetry_schema.py,
 #      no deps beyond the package) — exporter/schema drift fails fast;
+#      self-tests cover spans, the live plane, flight bundles AND the
+#      bench host_overhead block (megastep dispatch accounting);
 #   5. chaos-plane smoke (tools/chaos_sweep.py --selftest, no
 #      subprocesses/fits) — the RLT_FAULT grammar, deterministic
 #      matching, exactly-once markers and the file corruptors vs the
@@ -106,9 +108,10 @@ fi
 
 # -- layer 4: telemetry artifact schemas (zero extra deps) -------------------
 # Gates producer/schema drift: exporter self-test (spans, Chrome traces,
-# heartbeat/event/log stream items, crash flight bundles), the committed
-# flight-bundle fixture (tests/data/flight_bundle.json), and BENCH_*.json
-# telemetry blocks (tools/check_telemetry_schema.py).
+# heartbeat/event/log stream items, crash flight bundles, the bench
+# host_overhead block), the committed flight-bundle fixture
+# (tests/data/flight_bundle.json), and BENCH_*.json telemetry/fault/
+# host_overhead blocks (tools/check_telemetry_schema.py).
 python tools/check_telemetry_schema.py || fail=1
 
 # -- layer 5: chaos-plane smoke (zero extra deps, no subprocess fits) --------

@@ -21,6 +21,8 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from ray_lightning_tpu.fault.drain import sync_point_crossed
+
 __all__ = [
     "Callback",
     "ModelCheckpoint",
@@ -45,7 +47,16 @@ class Callback:
 
     def on_train_batch_end(
         self, trainer, module, logs: Dict[str, float], batch_idx: int
-    ) -> None: ...
+    ) -> None:
+        """End of a train dispatch.  Cadence contract: on the per-step
+        path this fires once per micro-batch.  Under **megastep**
+        execution (``megastep=K`` — docs/PERFORMANCE.md) it fires once
+        per K-step STRIDE: ``trainer.micro_step``/``global_step`` have
+        already advanced across the whole stride, ``logs`` carries the
+        stride's FINAL inner step's values, and ``batch_idx`` is the
+        stride's last batch index.  Count steps from the trainer's
+        counters, never from call counts; step-cadence callbacks (EMA)
+        must compound over ``global_step`` deltas."""
 
     def on_accumulation_flush(
         self, trainer, module, logs: Dict[str, float], batch_idx: int
@@ -309,6 +320,7 @@ class CSVLogger(Callback):
         self.rows: list = []
         self._flushed_rows = 0
         self._flushed_keys: list = []
+        self._last_row_micro = 0
 
     @property
     def path(self) -> Optional[str]:
@@ -319,6 +331,7 @@ class CSVLogger(Callback):
     def setup(self, trainer, module, stage: str) -> None:
         if self.dirpath is None:
             self.dirpath = os.path.join(trainer.default_root_dir, "csv")
+        self._last_row_micro = 0
 
     def _append(self, trainer) -> None:
         row = {
@@ -358,15 +371,32 @@ class CSVLogger(Callback):
         self._flushed_rows = len(self.rows)
         self._flushed_keys = keys
 
+    def on_train_epoch_start(self, trainer, module) -> None:
+        # Anchor the row cadence at the epoch's ACTUAL starting
+        # micro-step — checkpoint restore runs after setup(), so a fit
+        # resumed at step 1003 must keep rows on the same
+        # log_every_n_steps grid instead of emitting one spurious row
+        # on its first post-resume hook (sync_point_crossed from 0 is
+        # trivially true at any resume point).
+        self._last_row_micro = getattr(trainer, "micro_step", 0) or 0
+
     def on_train_batch_end(self, trainer, module, logs, batch_idx) -> None:
-        # Per-step rows on the trainer's log_every_n_steps cadence (the
-        # loop refreshes callback_metrics just before this hook fires) —
-        # a 1-epoch LM run gets a real training curve, not a single row.
+        # Per-step rows on the trainer's log_every_n_steps cadence — a
+        # 1-epoch LM run gets a real training curve, not a single row.
+        # Cadence CROSSING (fault.drain.sync_point_crossed — the one
+        # stride-aware boundary rule), not `% == 0`: under megastep
+        # execution micro_step advances K per hook and can step over
+        # exact multiples; one row per crossed boundary either way.
+        # Metric values may lag one log interval (the loop's async log
+        # fetch, docs/OBSERVABILITY.md) — the curve is intact, staged.
         n = getattr(
             getattr(trainer, "config", None), "log_every_n_steps", 0
         )
         micro = getattr(trainer, "micro_step", None)
-        if n and micro and micro % n == 0:
+        if n and micro and sync_point_crossed(
+            self._last_row_micro, micro, n
+        ):
+            self._last_row_micro = micro
             self._append(trainer)
 
     def on_train_epoch_end(self, trainer, module) -> None:
@@ -759,7 +789,13 @@ class ExponentialMovingAverage(Callback):
     micro-batch cadence would silently shrink the horizon by the
     accumulation factor).  ``update_every_n_steps`` thins the update
     cadence; the decay compounds over the steps actually elapsed, so
-    the averaging horizon is cadence-independent.
+    the averaging horizon is cadence-independent.  Megastep execution
+    (``megastep=K``) is the same contract from the other side: the
+    hook fires once per stride with ``global_step`` advanced by up to
+    K, the decay compounds ``decay**K`` against the stride-final
+    params — horizon-preserving, tolerance-level different from
+    per-step sampling (intermediate params are fused inside the scan
+    and never materialize on host).
 
     At fit end the EMA weights REPLACE the trained ones in the returned
     state when ``swap_at_end=True`` (default).  With

@@ -66,6 +66,17 @@ class FitConfig:
     # FLUSHED (one optimizer step from the averaged micro-grads), again
     # matching Lightning.
     accumulate_grad_batches: int = 1
+    # Megastep execution (the host-dispatch optimization): fuse K
+    # micro-steps into ONE jitted lax.scan per stride, with batches
+    # pre-staged K at a time and metric accumulation on device — Python
+    # re-enters once per stride instead of once per micro-batch
+    # (docs/PERFORMANCE.md "Host dispatch & megastep").  Values:
+    # None (read the RLT_MEGASTEP env bus, default "auto"), "auto"
+    # (K=8 on TPU backends where per-step dispatch is the ceiling; off
+    # on CPU), "off"/1, or an explicit int K >= 1.  Partial strides at
+    # epoch/limit/max_steps boundaries fall back to the per-step path,
+    # so step-count contracts hold exactly.
+    megastep: Optional[Any] = None
     seed: int = 0
     precision: str = "f32"
     default_root_dir: str = "."
@@ -122,10 +133,73 @@ class FitConfig:
                 f"precision {self.precision!r} unsupported on TPU: use "
                 f"'f32' or 'bf16' (accepted aliases: {sorted(aliases)})"
             )
+        # Megastep knob: validated eagerly (a typo'd value must fail at
+        # Trainer construction, not minutes later on a worker); the
+        # BACKEND-dependent "auto" resolution stays fit-time
+        # (_resolve_megastep) — the driver may be CPU-only while the
+        # workers run TPUs.
+        _normalize_megastep(self.megastep)
         if self.fast_dev_run:
             self.max_epochs = 1
             self.limit_train_batches = 1
             self.limit_val_batches = 1
+
+
+def _normalize_megastep(value: Any) -> Optional[Any]:
+    """Validate a megastep knob value and return its normal form:
+    None, "auto", "off" or an int >= 1 (numeric strings become ints;
+    resolution to a concrete K happens at fit time)."""
+    if value is None:
+        return None
+    if isinstance(value, str):
+        s = value.strip().lower()
+        if s in ("auto", "off", ""):
+            return "off" if s == "" else s
+        try:
+            value = int(s)
+        except ValueError:
+            raise ValueError(
+                f"megastep={value!r}: expected 'auto', 'off' or an "
+                "integer K >= 1"
+            ) from None
+    if isinstance(value, bool) or not isinstance(value, int):
+        raise TypeError(
+            f"megastep must be None, 'auto', 'off' or an int >= 1; got "
+            f"{type(value).__name__}"
+        )
+    if value < 1:
+        raise ValueError(f"megastep must be >= 1, got {value}")
+    return value
+
+
+def _resolve_megastep(config: FitConfig) -> int:
+    """The concrete stride length K for this fit.
+
+    Strongest first: an explicit ``megastep=`` on the Trainer/strategy →
+    the ``RLT_MEGASTEP`` env bus (forwarded to workers like
+    ``RLT_GRAD_COMM``) → ``"auto"``.  Auto picks K=8 on TPU backends —
+    there the ~ms-scale per-step host dispatch is the throughput ceiling
+    the MFU telemetry sees (ISSUE 5 / Podracer) — and stays off on
+    CPU/other backends, where execution is effectively synchronous and
+    fusing strides buys little while coarsening hook/drain granularity.
+    """
+    value = config.megastep
+    if value is None:
+        # NB: an empty RLT_MEGASTEP= means "off" (the operator cleared
+        # the knob), same as every other normalization path — only a
+        # genuinely unset var falls through to auto.
+        value = os.environ.get("RLT_MEGASTEP")
+        value = "auto" if value is None else value
+    value = _normalize_megastep(value)
+    if value == "off":
+        return 1
+    if value == "auto":
+        try:
+            on_tpu = jax.default_backend() == "tpu"
+        except RuntimeError:
+            on_tpu = False
+        return 8 if on_tpu else 1
+    return int(value)
 
 
 class LoopContext:
@@ -164,6 +238,12 @@ class LoopContext:
         self.should_stop = False
         self.callback_metrics: Dict[str, float] = {}
         self.logged_metrics: Dict[str, float] = {}
+        # Crash-forensics hook (telemetry/flight_recorder.py): lands any
+        # in-flight _AsyncLogFetch boundary into callback_metrics before
+        # the bundle snapshots them — without it a crash would freeze
+        # the metrics one-to-two log intervals behind where the old
+        # synchronous device_get path left them.
+        self.pending_log_flush: Optional[Callable[[], None]] = None
         self.state: Optional[TrainState] = None
         self.default_root_dir = config.default_root_dir
         # Gradient-communication status (populated by run_fit): modules
@@ -544,6 +624,23 @@ class _RunningMeanLogs:
                 self._cnt[k] = self._cnt[k] + finite.astype(jnp.float32)
         self._n += 1
 
+    def update_stride(self, sums: Dict[str, Any], cnts: Dict[str, Any],
+                      n: int) -> None:
+        """Fold a megastep stride's ON-DEVICE accumulation into the
+        epoch mean: ``sums``/``cnts`` are the finite-filtered f32 sums
+        and finite counts the fused scan already reduced over its ``n``
+        inner steps (``make_multi_step`` aux) — same math as ``n``
+        :meth:`update` calls, paid as one device add per metric per
+        stride instead of one per micro-batch."""
+        if self._sum is None:
+            self._sum = {k: jnp.asarray(v) for k, v in sums.items()}
+            self._cnt = {k: jnp.asarray(v) for k, v in cnts.items()}
+        else:
+            for k in self._sum:
+                self._sum[k] = self._sum[k] + sums[k]
+                self._cnt[k] = self._cnt[k] + cnts[k]
+        self._n += n
+
     def result(self) -> Dict[str, float]:
         if self._sum is None:
             return {}
@@ -558,6 +655,61 @@ class _RunningMeanLogs:
             out[k] = float(s) / c if c else float("nan")
         self.nonfinite_count = nonfinite
         return out
+
+
+class _AsyncLogFetch:
+    """Log-cadence metrics WITHOUT the host sync.
+
+    The old path ran ``ctx.log_metrics(jax.device_get(logs))`` every
+    ``log_every_n_steps`` — a blocking device→host fence that serialized
+    the dispatch pipeline at exactly the cadence users log at.  This
+    helper starts a device→host copy at the boundary
+    (``copy_to_host_async``) and CONSUMES it at the next boundary (by
+    which point the producing step has long finished, so ``device_get``
+    returns without waiting).  Consequence, documented in
+    docs/OBSERVABILITY.md: mid-fit consumers of step-cadence
+    ``callback_metrics`` (CSV step rows, tune reports) see values one
+    log interval late; epoch-end :meth:`flush` drains the tail, so
+    post-fit metrics are identical to the synchronous path.
+    """
+
+    def __init__(self, ctx: "LoopContext"):
+        self._ctx = ctx
+        self._pending: Optional[Tuple[Dict[str, Any], Dict[str, float]]] = (
+            None
+        )
+
+    def schedule(self, logs: Dict[str, Any],
+                 extra: Optional[Dict[str, Any]] = None) -> None:
+        """Consume the previous boundary's logs, then start this one's
+        copy.  ``extra`` carries side values captured NOW (the lr of
+        the step just taken — possibly still a lazy device scalar) so
+        they stay paired with these logs when they land; device values
+        in it ride the same async copy as the logs."""
+        self.flush()
+        for v in (*logs.values(), *(extra or {}).values()):
+            start = getattr(v, "copy_to_host_async", None)
+            if start is not None:
+                try:
+                    start()
+                except Exception:  # noqa: BLE001 - the flush-time
+                    # device_get is always correct; async is a hint.
+                    pass
+        self._pending = (logs, dict(extra or {}))
+
+    def flush(self) -> None:
+        """Land any in-flight logs into the context's metrics.  Called
+        at the next boundary, at epoch end (BEFORE epoch means are
+        logged — stale step values must not overwrite them), and before
+        a drain checkpoint snapshots callback_metrics."""
+        if self._pending is None:
+            return
+        logs, extra = self._pending
+        self._pending = None
+        logs, extra = jax.device_get((logs, extra))
+        self._ctx.log_metrics(logs)
+        if extra:
+            self._ctx.log_metrics(extra)
 
 
 def init_train_state(
@@ -632,10 +784,78 @@ def _place_batch(batch, mesh):
     return shardlib.make_global_batch(batch, mesh)
 
 
+def _same_batch_shape(a: Any, b: Any) -> bool:
+    """Structure + leaf-shape congruence — the stacking precondition."""
+    ta, tb = jax.tree_util.tree_structure(a), jax.tree_util.tree_structure(b)
+    if ta != tb:
+        return False
+    return all(
+        getattr(x, "shape", None) == getattr(y, "shape", None)
+        and getattr(x, "dtype", None) == getattr(y, "dtype", None)
+        for x, y in zip(
+            jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+        )
+    )
+
+
+def _grouped(loader, stack: int, stack_limit: Optional[int]):
+    """Group a batch stream into megastep strides.
+
+    Yields ``("stride", [b0..b{k-1}])`` for full shape-congruent groups
+    of ``stack`` batches, ``("single", b)`` otherwise.  ``stack_limit``
+    (a multiple of ``stack``, or ``None`` for unlimited) bounds the
+    stream POSITION a stride may extend to: every batch emitted —
+    strided or not — consumes budget, so a ragged-shape single slipping
+    into the stream can never push a later stride across the
+    limit/max_steps boundary the caller aligned the budget to.
+    """
+    if stack <= 1:
+        for b in loader:
+            yield ("single", b)
+        return
+    it = iter(loader)
+    emitted = 0  # batches yielded so far == stream position of pending[0]
+    pending: List[Any] = []
+    while True:
+        if stack_limit is not None and emitted + stack > stack_limit:
+            # Stride budget exhausted: drain, then stream singles.
+            for p in pending:
+                yield ("single", p)
+            emitted += len(pending)
+            pending = []
+            for b in it:
+                yield ("single", b)
+            return
+        try:
+            item = next(it)
+        except StopIteration:
+            for p in pending:  # partial tail → per-step fallback
+                yield ("single", p)
+            return
+        if pending and not _same_batch_shape(pending[0], item):
+            # Ragged boundary (last small batch, shape change): flush
+            # what we have as singles; the newcomer may seed a stride.
+            for p in pending:
+                yield ("single", p)
+            emitted += len(pending)
+            pending = [item]
+        else:
+            pending.append(item)
+        if len(pending) == stack:
+            yield ("stride", pending)
+            emitted += stack
+            pending = []
+
+
 def _prefetched(loader, place: Callable[[Any], Any], depth: int = 2,
-                telemetry: Optional[Telemetry] = None):
+                telemetry: Optional[Telemetry] = None, stack: int = 1,
+                stack_limit: Optional[int] = None,
+                place_stride: Optional[Callable[[list], Any]] = None):
     """Iterate ``loader`` with host→device placement running ``depth``
-    batches ahead on a background thread.
+    batches ahead on a background thread.  Yields ``(placed, n)`` pairs:
+    ``n == 1`` for ordinary batches, ``n == stack`` for megastep strides
+    (``stack > 1``) — where the producer stacked ``stack`` host batches
+    and shipped them as ONE device array via ``place_stride``.
 
     On TPU the step is async-dispatched, so the input pipeline is the
     first serial bottleneck: without prefetch every step pays the numpy
@@ -647,12 +867,25 @@ def _prefetched(loader, place: Callable[[Any], Any], depth: int = 2,
     consumer's ``data_wait_ms`` (how long the LOOP stalled) can be read
     against how busy the producer actually was — a high place total with
     near-zero data wait means the prefetch depth is doing its job.
+
+    Lifecycle: the generator's ``close()`` (run the loop's ``finally``
+    — see ``run_fit``) signals the producer's stop event AND JOINS the
+    thread, so a fit that raises mid-epoch (drain, chaos crash, user
+    exception) never leaks an ``rlt-prefetch`` thread into the next
+    attempt of an elastic respawn or the next fit of a tuner sweep.
     """
     import queue as pyqueue
     import threading
 
+    grouped = _grouped(loader, stack, stack_limit)
+
+    def _place(kind: str, payload: Any):
+        if kind == "stride":
+            return (place_stride(payload), len(payload))
+        return (place(payload), 1)
+
     if depth < 1:
-        yield from (place(b) for b in loader)
+        yield from (_place(k, p) for k, p in grouped)
         return
 
     buf: pyqueue.Queue = pyqueue.Queue(maxsize=depth)
@@ -662,16 +895,16 @@ def _prefetched(loader, place: Callable[[Any], Any], depth: int = 2,
 
     def producer() -> None:
         try:
-            for item in loader:
+            for kind, payload in grouped:
                 t0 = time.perf_counter()
-                placed = place(item)
+                placed = _place(kind, payload)
                 if telemetry is not None:
                     # Counter keys are producer-thread-private; the dict
                     # update itself is GIL-atomic.
                     telemetry.add_counter(
                         "prefetch_place_s", time.perf_counter() - t0
                     )
-                    telemetry.add_counter("prefetch_batches", 1)
+                    telemetry.add_counter("prefetch_batches", placed[1])
                 while not stop.is_set():
                     try:
                         buf.put(placed, timeout=0.1)
@@ -704,6 +937,10 @@ def _prefetched(loader, place: Callable[[Any], Any], depth: int = 2,
             yield item
     finally:
         stop.set()
+        # Join, don't just signal: "no thread left behind" is the
+        # contract the leak-regression test pins (the producer's put
+        # loop polls the stop event every 0.1s, so this is bounded).
+        thread.join(timeout=5.0)
 
 
 def _run_validation(
@@ -1021,6 +1258,29 @@ def _run_fit_inner(
         module, tx, mesh, mode=mode, zero_stage=zero_stage,
         state_shardings=state_shardings, grad_sync=grad_sync,
     )
+    # Megastep execution: fuse K micro-steps into one lax.scan dispatch
+    # (docs/PERFORMANCE.md "Host dispatch & megastep").  The single-step
+    # jit above stays alive as the exact-semantics fallback for partial
+    # strides (epoch/limit/max_steps boundaries) and pinned chaos
+    # injections — jit is lazy, so an all-strides fit never compiles it
+    # twice... and an all-singles fit never compiles the scan.
+    megastep_k = _resolve_megastep(config)
+    multi_step = (
+        step_fns.make_multi_step(
+            module, tx, mesh, megastep_k, mode=mode,
+            zero_stage=zero_stage, state_shardings=state_shardings,
+            grad_sync=grad_sync,
+        )
+        if megastep_k > 1 else None
+    )
+    tel.set_meta("megastep", megastep_k)
+
+    def _place_stride(batches: List[Any]):
+        """K host micro-batches → one stacked device array (leaf shape
+        (K, B, ...)) — a single transfer per stride."""
+        if mesh is None:
+            return jax.device_put(shardlib.stack_host_batches(batches))
+        return shardlib.make_global_stacked_batch(batches, mesh)
     val_loader = datamodule.val_dataloader()
     eval_step = (
         step_fns.build_eval_step(
@@ -1042,6 +1302,12 @@ def _run_fit_inner(
     # (multi-process meshes only — None is the zero-overhead local path)
     # and the drain finish-line itself.
     drain_poll = _make_drain_poll(mesh, world_size)
+    # Async log-cadence fetch (see _AsyncLogFetch): scheduled at log
+    # boundaries, consumed one boundary later, flushed before anything
+    # that snapshots callback_metrics (epoch means, drain META, and —
+    # via ctx.pending_log_flush — the crash flight bundle).
+    log_fetch = _AsyncLogFetch(ctx)
+    ctx.pending_log_flush = log_fetch.flush
 
     def _graceful_drain(mid_epoch: bool, batch_in_epoch: int):
         """Preemption finish-line: write the step-granular sharded
@@ -1053,6 +1319,12 @@ def _run_fit_inner(
         from ray_lightning_tpu.utils import sharded_ckpt
 
         ctx.phase = "draining"
+        try:
+            # In-flight async log fetch lands BEFORE the META snapshot
+            # of callback_metrics below.
+            log_fetch.flush()
+        except Exception:  # noqa: BLE001 - never cost the drain
+            pass
         reason = drain_mod.drain_reason() or "requested"
         drain_dir = config.restart_dir or os.path.join(
             config.default_root_dir, "preempt"
@@ -1174,6 +1446,14 @@ def _run_fit_inner(
             )
         except AttributeError:
             since_update = ctx.micro_step % accum
+    # First-use jit compiles of the two train programs (the fused scan
+    # and the per-step fallback) can land MID-fit under megastep — a
+    # partial tail stride or a chaos-degraded stride compiles the lazy
+    # single-step program while progress is frozen for 20-40s at scale.
+    # Flag those dispatches as a "compile" phase flip so the monitor's
+    # per-phase exemption (telemetry/monitor.py) disarms the stall
+    # watchdog instead of raising a false hang on a healthy rank.
+    compiled_kinds: set = set()
     for epoch in range(start_epoch, config.max_epochs):
         ctx.current_epoch = epoch
         ctx.phase = "train"
@@ -1209,93 +1489,214 @@ def _run_fit_inner(
         if skip:
             src = itertools.islice(src, skip, None)
         source = src if cap is None else itertools.islice(src, cap + 1)
+        # Megastep stride budget: only full K-strides lying ENTIRELY
+        # inside the cap are fused (a multiple of K); the remainder —
+        # partial strides at epoch/limit/max_steps boundaries — ships
+        # per-step, so the in-loop boundary checks keep exact
+        # "max_steps means max_steps" semantics.
+        if megastep_k > 1:
+            stack_limit = (
+                None if cap is None else (cap // megastep_k) * megastep_k
+            )
+        else:
+            stack_limit = 0
         last_logs: Dict[str, Any] = {}
         last_batch_idx = -1
+        batch_idx = skip - 1  # absolute index of the last COMPLETED batch
         # Telemetry marks: ``t_mark`` is set at the end of each loop body,
         # so the gap to the next batch's arrival is exactly the time spent
         # blocked on the (prefetched) input pipeline — data_wait.
         t_mark = time.perf_counter()
         tracer = tel.tracer
-        for batch_idx, gbatch in enumerate(
-            _prefetched(
-                source, lambda b: _place_batch(b, mesh),
-                telemetry=tel if tel.enabled else None,
-            ),
-            start=skip,
-        ):
-            t_ready = time.perf_counter()
-            if (
-                config.limit_train_batches >= 0
-                and batch_idx >= config.limit_train_batches
-            ):
-                break
-            # Check BEFORE executing: max_steps=0 must train zero steps.
-            if (
-                config.max_steps >= 0
-                and ctx.global_step >= config.max_steps
-            ):
-                stop = True
-                break
-            # Chaos injection point: crash/hang/slow/sigterm pinned to
-            # (micro_step, epoch, rank) — near-zero cost unless RLT_FAULT
-            # is set (docs/FAULT_TOLERANCE.md).
-            chaos.fire("step", step=ctx.micro_step, epoch=epoch,
-                       rank=global_rank)
-            rng = jax.random.fold_in(base_rng, ctx.micro_step)
-            t_disp = time.perf_counter()
-            ctx.state, logs = train_step(ctx.state, gbatch, rng)
-            t_disp_end = time.perf_counter()
-            # Periodic device sampling: make THIS step's wall time
-            # include device execution (async dispatch hides it
-            # otherwise).  Never per-step — that would serialize host
-            # and device and become the overhead telemetry promises
-            # not to add.
-            sampled = tel_stats is not None and tel_stats.should_sample()
-            if sampled:
-                jax.block_until_ready(logs)
-            epoch_mean.update(logs)
-            ctx.micro_step += 1
-            ctx.progress += 1  # heartbeat liveness counter
-            since_update += 1
-            if since_update == accum:
-                ctx.global_step += 1  # one optimizer step completed
-                since_update = 0
-            if ctx.micro_step % config.log_every_n_steps == 0:
-                ctx.log_metrics(jax.device_get(logs))
-                _log_lr(ctx, lr_schedule)
-            _call_hooks(
-                callbacks, "on_train_batch_end", ctx, module, logs, batch_idx
-            )
-            last_logs, last_batch_idx = logs, batch_idx
-            t_end = time.perf_counter()
-            if tel_stats is not None:
-                leaves = jax.tree_util.tree_leaves(gbatch)
-                shape = getattr(leaves[0], "shape", None) if leaves else None
-                tel_stats.record_step(
-                    step_s=t_end - t_mark,
-                    data_wait_s=t_ready - t_mark,
-                    dispatch_s=t_disp_end - t_disp,
-                    examples=int(shape[0]) if shape else 1,
-                    sampled=sampled,
-                )
-            if tracer.enabled:
-                tracer.record("data_wait", t_mark, t_ready - t_mark)
-                tracer.record(
-                    "compile" if ctx.micro_step == 1 else "dispatch",
-                    t_disp, t_disp_end - t_disp,
-                )
-            t_mark = t_end
-            # Drain agreement (mesh-coordinated): a SIGTERM on ANY rank
-            # drains every rank at the same step boundary.  The multi-
-            # process collective runs on the K-step cadence (micro_step
-            # is identical across ranks); single-process fits poll the
-            # local flag every step.
-            if _drain_agreed(
-                sync_round=ctx.micro_step % drain_sync_every == 0
-            ):
-                _graceful_drain(
-                    mid_epoch=True, batch_in_epoch=batch_idx + 1
-                )
+        items = _prefetched(
+            source, lambda b: _place_batch(b, mesh),
+            telemetry=tel if tel.enabled else None,
+            stack=megastep_k, stack_limit=stack_limit,
+            place_stride=_place_stride,
+        )
+        try:
+            for gbatch, n_inner in items:
+                t_ready = time.perf_counter()
+                if (
+                    config.limit_train_batches >= 0
+                    and batch_idx + 1 >= config.limit_train_batches
+                ):
+                    break
+                # Check BEFORE executing: max_steps=0 trains zero steps.
+                if (
+                    config.max_steps >= 0
+                    and ctx.global_step >= config.max_steps
+                ):
+                    stop = True
+                    break
+                if n_inner > 1 and chaos.step_fault_in_range(
+                    ctx.micro_step, ctx.micro_step + n_inner,
+                    epoch=epoch, rank=global_rank,
+                ):
+                    # A step-pinned chaos fault lands inside this stride:
+                    # lower K to 1 around the injection — run the already
+                    # -stacked micro-batches singly (device slices) so
+                    # the fault fires at its exact inner-step index.
+                    sub = [
+                        (jax.tree_util.tree_map(
+                            lambda x, j=j: x[j], gbatch), 1)
+                        for j in range(n_inner)
+                    ]
+                else:
+                    sub = [(gbatch, n_inner)]
+                for gb, n in sub:
+                    prev_micro = ctx.micro_step
+                    # First use of either train program compiles inside
+                    # the dispatch call below (host-blocking): flip the
+                    # heartbeat phase so the monitor's per-phase stall
+                    # arming (telemetry/monitor.py) treats the freeze as
+                    # a compile, not a hang.
+                    kind = "single" if n == 1 else "fused"
+                    first_use = kind not in compiled_kinds
+                    if first_use:
+                        compiled_kinds.add(kind)
+                        ctx.phase = "compile"
+                    if n == 1:
+                        # -- per-step path (exact boundary semantics) ----
+                        # Chaos injection point: crash/hang/slow/sigterm
+                        # pinned to (micro_step, epoch, rank) — near-zero
+                        # cost unless RLT_FAULT is set.
+                        chaos.fire("step", step=ctx.micro_step,
+                                   epoch=epoch, rank=global_rank)
+                        rng = jax.random.fold_in(base_rng, ctx.micro_step)
+                        t_disp = time.perf_counter()
+                        ctx.state, logs = train_step(ctx.state, gb, rng)
+                        t_disp_end = time.perf_counter()
+                        # Periodic device sampling: make THIS step's wall
+                        # time include device execution (async dispatch
+                        # hides it otherwise).  Never per-step — that
+                        # would serialize host and device and become the
+                        # overhead telemetry promises not to add.
+                        sampled = (tel_stats is not None
+                                   and tel_stats.should_sample())
+                        if sampled:
+                            jax.block_until_ready(logs)
+                        epoch_mean.update(logs)
+                        ctx.micro_step += 1
+                        ctx.progress += 1  # heartbeat liveness counter
+                        since_update += 1
+                        if since_update == accum:
+                            ctx.global_step += 1  # optimizer step done
+                            since_update = 0
+                        batch_idx += 1
+                    else:
+                        # -- megastep stride: ONE dispatch, n micro-steps
+                        # fused in a lax.scan, metrics accumulated on
+                        # device; the host does integer bookkeeping only.
+                        t_disp = time.perf_counter()
+                        ctx.state, saux = multi_step(
+                            ctx.state, gb, base_rng,
+                            np.int32(ctx.micro_step),
+                        )
+                        t_disp_end = time.perf_counter()
+                        sampled = (
+                            tel_stats is not None
+                            and tel_stats.should_sample_stride(n)
+                        )
+                        if sampled:
+                            jax.block_until_ready(saux)
+                        epoch_mean.update_stride(
+                            saux["sum"], saux["cnt"], n
+                        )
+                        logs = saux["last"]
+                        ctx.micro_step += n
+                        ctx.progress += n
+                        since_update += n
+                        ctx.global_step += since_update // accum
+                        since_update %= accum
+                        batch_idx += n
+                        tel.add_counter("megastep_dispatches", 1)
+                    tel.add_counter("train_dispatches", 1)
+                    if ctx.phase == "compile":
+                        ctx.phase = "train"
+                    # Log cadence: identical to the old `% == 0` on the
+                    # per-step path; a stride rounds the boundary to its
+                    # end (stride-final logs).  The fetch is ASYNC —
+                    # copy-to-host starts here, lands at the next
+                    # boundary/epoch end — so logging never serializes
+                    # host and device (docs/OBSERVABILITY.md).
+                    n_log = config.log_every_n_steps
+                    if n_log and drain_mod.sync_point_crossed(
+                        prev_micro, ctx.micro_step, n_log
+                    ):
+                        extra = (
+                            # Lazily-enqueued device scalar: the fetch
+                            # materializes it at the NEXT boundary, so
+                            # logging lr never fences the just-dispatched
+                            # train program (a float() here would).
+                            {"lr": lr_schedule(
+                                max(ctx.global_step - 1, 0))}
+                            if lr_schedule is not None else None
+                        )
+                        log_fetch.schedule(logs, extra)
+                    _call_hooks(
+                        callbacks, "on_train_batch_end", ctx, module,
+                        logs, batch_idx,
+                    )
+                    last_logs, last_batch_idx = logs, batch_idx
+                    t_end = time.perf_counter()
+                    if tel_stats is not None:
+                        leaves = jax.tree_util.tree_leaves(gb)
+                        shape = (getattr(leaves[0], "shape", None)
+                                 if leaves else None)
+                        if n == 1:
+                            tel_stats.record_step(
+                                step_s=t_end - t_mark,
+                                data_wait_s=t_ready - t_mark,
+                                dispatch_s=t_disp_end - t_disp,
+                                examples=int(shape[0]) if shape else 1,
+                                sampled=sampled, compiled=first_use,
+                            )
+                        else:
+                            tel_stats.record_stride(
+                                stride_s=t_end - t_mark,
+                                data_wait_s=t_ready - t_mark,
+                                dispatch_s=t_disp_end - t_disp,
+                                examples=(
+                                    int(shape[0]) * int(shape[1])
+                                    if shape and len(shape) > 1 else n
+                                ),
+                                k=n, sampled=sampled, compiled=first_use,
+                            )
+                    if tracer.enabled:
+                        tracer.record(
+                            "data_wait", t_mark, t_ready - t_mark
+                        )
+                        tracer.record(
+                            "compile" if first_use
+                            else ("megastep" if n > 1 else "dispatch"),
+                            t_disp, t_disp_end - t_disp,
+                        )
+                    t_mark = t_end
+                    # Chaos-degraded slices after the first: the data was
+                    # already resident, only the first slice paid wait.
+                    t_ready = t_mark
+                    # Drain agreement (mesh-coordinated): a SIGTERM on
+                    # ANY rank drains every rank at the same boundary.
+                    # The multi-process collective runs whenever the
+                    # advance crossed the K-step sync cadence (micro_step
+                    # is identical across ranks, strides are config-
+                    # deterministic — call counts stay aligned);
+                    # single-process fits poll the local flag for free.
+                    if _drain_agreed(
+                        sync_round=drain_mod.sync_point_crossed(
+                            prev_micro, ctx.micro_step, drain_sync_every
+                        )
+                    ):
+                        _graceful_drain(
+                            mid_epoch=True, batch_in_epoch=batch_idx + 1
+                        )
+        finally:
+            # Deterministic producer shutdown: signal + JOIN the
+            # rlt-prefetch thread even when the body raised (drain,
+            # chaos, user exception) — a leaked producer would survive
+            # into the next elastic attempt / tuner fit.
+            items.close()
 
         # Flush a partial accumulation window (Lightning semantics: the
         # last incomplete window of an epoch still steps, from the mean
@@ -1325,6 +1726,9 @@ def _run_fit_inner(
                 last_logs, last_batch_idx,
             )
 
+        # Land the tail of the async log fetch BEFORE the epoch means:
+        # a stale step value arriving later would overwrite them.
+        log_fetch.flush()
         train_metrics = epoch_mean.result()
         ctx.log_metrics(train_metrics)
         _log_lr(ctx, lr_schedule)

@@ -85,6 +85,7 @@ class Trainer:
         limit_val_batches: int = -1,
         log_every_n_steps: int = 50,
         accumulate_grad_batches: int = 1,
+        megastep=None,
         enable_checkpointing: bool = True,
         fast_dev_run: bool = False,
         resume_from_checkpoint: Optional[str] = None,
@@ -120,6 +121,11 @@ class Trainer:
             limit_val_batches=limit_val_batches,
             log_every_n_steps=log_every_n_steps,
             accumulate_grad_batches=accumulate_grad_batches,
+            # Megastep execution mode (fuse K micro-steps into one
+            # compiled scan — docs/PERFORMANCE.md "Host dispatch &
+            # megastep").  None defers to the strategy's knob / the
+            # RLT_MEGASTEP env bus / "auto".
+            megastep=megastep,
             seed=seed,
             precision=precision,
             default_root_dir=default_root_dir,

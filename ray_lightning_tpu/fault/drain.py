@@ -42,6 +42,7 @@ __all__ = [
     "reset_drain",
     "set_fit_active",
     "fit_active",
+    "sync_point_crossed",
     "install_signal_handlers",
     "uninstall_signal_handlers",
 ]
@@ -139,6 +140,20 @@ def reset_drain() -> None:
     with _state_lock:
         _drain_event.clear()
         _reason = None
+
+
+def sync_point_crossed(prev_step: int, step: int, every: int) -> bool:
+    """Did the micro-step counter cross a multiple of ``every`` moving
+    from ``prev_step`` to ``step``?  The drain-agreement cadence for BOTH
+    loop shapes: the per-step path advances by 1 (equivalent to the old
+    ``step % every == 0``), a megastep stride advances by K — either
+    way the collective fires iff a sync point lies inside the advance,
+    so every rank's collective call count stays aligned regardless of
+    stride shape (strides are config-deterministic and identical
+    fleet-wide)."""
+    if every <= 1:
+        return True
+    return (step // every) > (prev_step // every)
 
 
 def set_fit_active(active: bool) -> None:

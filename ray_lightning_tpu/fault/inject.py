@@ -74,6 +74,7 @@ __all__ = [
     "parse_faults",
     "fire",
     "set_rank",
+    "step_fault_in_range",
     "POINTS",
     "KINDS",
 ]
@@ -303,6 +304,51 @@ def _execute(spec: FaultSpec, point: str, path: Optional[str]) -> None:
             return
         (_corrupt_torn if spec.kind == "torn" else _corrupt_bitflip)(path)
         return
+
+
+def step_fault_in_range(start: int, stop: int, *,
+                        epoch: Optional[int] = None,
+                        rank: Optional[int] = None) -> bool:
+    """Could a ``step``-point fault fire anywhere in micro-steps
+    ``[start, stop)``?  The megastep loop asks this BEFORE fusing a
+    stride: a pinned injection inside the stride means those steps must
+    run singly so the fault fires at its exact inner-step index (a fault
+    fired "somewhere inside the scan" would not be deterministic, and a
+    fault skipped entirely would "prove" recovery paths that never ran).
+
+    Near-zero cost with ``RLT_FAULT`` unset (one dict lookup).  ``nth``
+    pins are treated conservatively (any occurrence could be the Nth),
+    and so are ``rank`` pins: the degrade decision must be IDENTICAL
+    fleet-wide — strides shape the compiled program and its collective
+    call sequence, so a rank that fuses while the fault's pinned rank
+    runs singles would execute a divergent global program and hang in
+    the first collective.  Every rank lowers K around the injection;
+    :func:`fire` still honors the rank pin, so the fault itself fires
+    only where it was aimed.  (``rank`` is accepted for signature
+    stability but does not narrow the match.)  ``once`` specs that
+    already fired stop degrading strides — the markers live in the
+    shared ``RLT_FAULT_STATE`` dir, so that call too stays rank-aligned
+    — and a chaos A/B keeps its megastep performance after the
+    injection.
+    """
+    plan = _current_plan()
+    if plan is None:
+        return False
+    for spec in plan.specs:
+        if spec.point != "step":
+            continue
+        if (spec.epoch is not None and epoch is not None
+                and spec.epoch != epoch):
+            continue
+        if spec.step is not None and not (start <= spec.step < stop):
+            continue
+        if spec.once and spec.nth is None and plan.already_fired(spec):
+            # Fired-and-done — but keep degrading when an nth pin is
+            # present: its occurrence counter must keep seeing every
+            # coordinate match to stay deterministic.
+            continue
+        return True
+    return False
 
 
 def fire(point: str, *, step: Optional[int] = None,

@@ -19,7 +19,7 @@ Sharding of Weight Update in Data-Parallel Training", arXiv:2004.13336).
 from __future__ import annotations
 
 import functools
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 import jax
 import numpy as np
@@ -36,6 +36,9 @@ __all__ = [
     "state_shardings_for_module",
     "params_shardings_for_module",
     "make_global_batch",
+    "stacked_batch_sharding",
+    "stack_host_batches",
+    "make_global_stacked_batch",
 ]
 
 
@@ -327,15 +330,33 @@ def state_shardings_for_module(
     return TrainState(params_sh, opt_sh, replicated(mesh))
 
 
-def make_global_batch(batch: Any, mesh: Mesh, axis=None) -> Any:
-    """Per-host numpy batch shard → globally batch-sharded jax.Arrays.
+def stacked_batch_sharding(mesh: Mesh, axis=None) -> NamedSharding:
+    """Sharding for a megastep's K pre-staged micro-batches stacked on a
+    new leading axis: the STRIDE axis (dim 0) is replicated — every
+    device sees all K inner steps in order — and the batch dim (dim 1)
+    shards over the data axes exactly like a single batch would."""
+    if axis is None:
+        axis = data_axes(mesh)
+    return NamedSharding(mesh, P(None, axis))
 
-    Every host holds ``global_batch / num_hosts`` examples (the
-    DistributedSampler analogue in :mod:`..core.data`); this assembles the
-    logical global array without any cross-host data movement — each
-    host's shard lands on its own devices
-    (``make_array_from_process_local_data``).
-    """
+
+def stack_host_batches(batches: list) -> Any:
+    """K shape-congruent host micro-batches → one numpy pytree with a
+    new leading stride axis (leaf shape ``(K, B, ...)``).  The single
+    host-side stacking rule for megastep strides — both the mesh path
+    (:func:`make_global_stacked_batch`) and the single-device
+    ``device_put`` path go through here so their semantics can't drift."""
+    return jax.tree_util.tree_map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]), *batches
+    )
+
+
+def _batch_axes_prologue(mesh: Mesh, axis) -> Tuple[tuple, int]:
+    """Shared head of the global-batch builders: normalize the data axes,
+    enforce the multi-host no-data-axis guard, and compute the axis-size
+    product.  Both :func:`make_global_batch` and
+    :func:`make_global_stacked_batch` go through here so the placement
+    contract can't drift between the single-batch and stride paths."""
     if axis is None:
         axis = data_axes(mesh)
     axes = (axis,) if isinstance(axis, str) else tuple(axis)
@@ -347,22 +368,73 @@ def make_global_batch(batch: Any, mesh: Mesh, axis=None) -> Any:
             "multi-host run would train on inconsistent data. Add a "
             "batch-parallel axis to mesh_axes."
         )
-    sharding = batch_sharding(mesh, axes)
     axis_size = 1
     for a in axes:
         axis_size *= mesh.shape[a]
+    return axes, axis_size
+
+
+def _require_rows_divisible(
+    what: str, global_rows: int, shaped: bool, axes: tuple, axis_size: int
+) -> None:
+    """The divisibility contract for the batch-row dim — must divide over
+    the mesh's data axes or XLA raises an opaque placement error."""
+    if not shaped or global_rows % axis_size != 0:
+        raise ValueError(
+            f"{what} (global {global_rows}) must be divisible "
+            f"by the {axes!r} mesh axes size ({axis_size}). Pick a "
+            f"batch_size that is a multiple of the number of devices."
+        )
+
+
+def make_global_stacked_batch(batches: list, mesh: Mesh, axis=None) -> Any:
+    """K per-host numpy batch shards → one globally placed stride array.
+
+    Stacks the K micro-batches leaf-wise on a new leading axis (host-side
+    ``np.stack`` — the batches must be shape-congruent; the prefetch
+    producer guarantees it) and ships the result as ONE ``jax.Array`` per
+    leaf with :func:`stacked_batch_sharding` — a single host→device
+    transfer per stride instead of K, feeding ``make_multi_step``'s
+    ``lax.scan``.
+    """
+    axes, axis_size = _batch_axes_prologue(mesh, axis)
+    sharding = stacked_batch_sharding(mesh, axes)
+
+    stacked = stack_host_batches(batches)
+
+    def to_global(x):
+        # Batch rows live on dim 1 of the stacked leaf; the same
+        # divisibility contract as make_global_batch applies there.
+        global_rows = (
+            x.shape[1] * jax.process_count() if x.ndim >= 2 else 0
+        )
+        _require_rows_divisible(
+            "Stacked batch dim", global_rows, x.ndim >= 2, axes, axis_size
+        )
+        return jax.make_array_from_process_local_data(sharding, x)
+
+    return jax.tree_util.tree_map(to_global, stacked)
+
+
+def make_global_batch(batch: Any, mesh: Mesh, axis=None) -> Any:
+    """Per-host numpy batch shard → globally batch-sharded jax.Arrays.
+
+    Every host holds ``global_batch / num_hosts`` examples (the
+    DistributedSampler analogue in :mod:`..core.data`); this assembles the
+    logical global array without any cross-host data movement — each
+    host's shard lands on its own devices
+    (``make_array_from_process_local_data``).
+    """
+    axes, axis_size = _batch_axes_prologue(mesh, axis)
+    sharding = batch_sharding(mesh, axes)
 
     def to_global(x):
         x = np.asarray(x)
-        # Global rows = local rows × num_processes; must divide over the
-        # mesh's data axis or XLA raises an opaque placement error.
+        # Global rows = local rows × num_processes.
         global_rows = x.shape[0] * jax.process_count() if x.ndim else 0
-        if x.ndim == 0 or global_rows % axis_size != 0:
-            raise ValueError(
-                f"Batch leading dim (global {global_rows}) must be divisible "
-                f"by the {axis!r} mesh axes size ({axis_size}). Pick a "
-                f"batch_size that is a multiple of the number of devices."
-            )
+        _require_rows_divisible(
+            "Batch leading dim", global_rows, x.ndim > 0, axes, axis_size
+        )
         return jax.make_array_from_process_local_data(sharding, x)
 
     return jax.tree_util.tree_map(to_global, batch)

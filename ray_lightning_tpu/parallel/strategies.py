@@ -45,6 +45,7 @@ from ray_lightning_tpu.cluster import rpc
 from ray_lightning_tpu.cluster.actor import ActorDiedError, RemoteError
 from ray_lightning_tpu.core.loop import (
     FitConfig,
+    _normalize_megastep,
     run_eval,
     run_fit,
     run_predict,
@@ -255,6 +256,7 @@ class TpuStrategy:
         grad_comm=None,
         telemetry=None,
         monitor=None,
+        megastep=None,
     ):
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
@@ -301,6 +303,12 @@ class TpuStrategy:
 
             MonitorConfig.coerce(monitor)
         self.monitor = monitor
+        # Megastep stride length (core/loop.py megastep mode: K fused
+        # micro-steps per jitted dispatch).  None defers to the
+        # Trainer's knob / the RLT_MEGASTEP env bus / "auto"; validated
+        # eagerly like every other strategy knob.
+        _normalize_megastep(megastep)
+        self.megastep = megastep
         self.env_per_worker = dict(env_per_worker or {})
         # Persistent XLA compilation cache (RLT_COMPILE_CACHE=dir): the
         # first GPT-2-scale compile costs 20-40s on this platform; a
@@ -342,7 +350,11 @@ class TpuStrategy:
                     # the inline path.  The drain-agreement cadence
                     # rides along (loop-side knob).
                     "RLT_FAULT", "RLT_FAULT_STATE",
-                    "RLT_DRAIN_SYNC_EVERY"):
+                    "RLT_DRAIN_SYNC_EVERY",
+                    # Megastep execution mode (core/loop.py): a driver-
+                    # side RLT_MEGASTEP must reach remote workers or the
+                    # knob would only ever affect inline fits.
+                    "RLT_MEGASTEP"):
             val = os.environ.get(var)
             if val is not None:
                 self.env_per_worker.setdefault(var, val)
@@ -533,6 +545,10 @@ class TpuStrategy:
         re-raises cleanly with the checkpoint named.
         """
         assert self._backend is not None, "setup() must run first"
+        if config.megastep is None and self.megastep is not None:
+            # The strategy's megastep knob fills the unset Trainer
+            # default (an explicit Trainer(megastep=...) always wins).
+            config = dataclasses.replace(config, megastep=self.megastep)
         elastic = self.max_restarts > 0 and kind == "fit"
         if elastic and config.restart_every_n_epochs is None:
             # The strategy's cadence fills the unset default wherever the
@@ -1025,10 +1041,11 @@ class LocalStrategy(TpuStrategy):
 
     def __init__(self, mesh_axes: Optional[Dict[str, int]] = None,
                  mode: str = "gspmd", zero_stage: int = 0,
-                 grad_comm=None, telemetry=None, monitor=None):
+                 grad_comm=None, telemetry=None, monitor=None,
+                 megastep=None):
         super().__init__(
             num_workers=1, mesh_axes=mesh_axes, grad_comm=grad_comm,
-            telemetry=telemetry, monitor=monitor,
+            telemetry=telemetry, monitor=monitor, megastep=megastep,
         )
         if monitor is not None:
             warnings.warn(
@@ -1062,6 +1079,8 @@ class LocalStrategy(TpuStrategy):
     ) -> List[Dict[str, Any]]:
         from ray_lightning_tpu.parallel.mesh import MeshSpec, build_mesh
 
+        if config.megastep is None and self.megastep is not None:
+            config = dataclasses.replace(config, megastep=self.megastep)
         mesh = build_mesh(MeshSpec(self.mesh_axes))
         common = dict(
             module=module, datamodule=datamodule, config=config,

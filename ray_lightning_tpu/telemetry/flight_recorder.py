@@ -197,6 +197,19 @@ class FlightRecorder:
             "fingerprint": _fingerprint(),
             "stacks": format_all_stacks(),
         }
+        metrics = getattr(ctx, "callback_metrics", None)
+        if metrics:
+            # record_crash flushed the pending async log fetch before
+            # composing, so this snapshot carries the latest scheduled
+            # boundary — not one-to-two log intervals behind it.
+            snap = {}
+            for k, v in metrics.items():
+                try:  # numpy/jax scalars coerce; non-numerics are skipped
+                    snap[k] = float(v)
+                except (TypeError, ValueError):
+                    pass
+            if snap:
+                doc["callback_metrics"] = snap
         if tel is not None:
             tracer = getattr(tel, "tracer", None)
             if tracer is not None and tracer.enabled:
@@ -222,6 +235,15 @@ class FlightRecorder:
         """Persist the bundle, announce it on the queue, disarm.
         Returns the bundle path (``None`` if even that failed — crash
         handling must never mask the original exception)."""
+        # Land any in-flight async log fetch first: the bundle's
+        # callback_metrics snapshot must carry the latest scheduled
+        # boundary, like the synchronous log path always did.
+        flush = getattr(self._ctx, "pending_log_flush", None)
+        if flush is not None:
+            try:
+                flush()
+            except Exception:  # noqa: BLE001 - forensics are best-effort
+                pass
         # Stop the publisher FIRST: a final "done" beat would make the
         # monitor retire a rank that actually died.
         if self._heartbeat is not None:

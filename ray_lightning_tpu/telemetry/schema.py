@@ -25,6 +25,7 @@ __all__ = [
     "validate_chrome_trace",
     "validate_bench_telemetry",
     "validate_bench_fault",
+    "validate_bench_host_overhead",
     "validate_heartbeat",
     "validate_event",
     "validate_log_item",
@@ -220,6 +221,8 @@ _BUNDLE_OPTIONAL = {
     "logs": list,         # ring-buffered rank-tagged log lines
     "device_memory": dict,
     "stacks": str,        # all-thread py stacks at crash time
+    "callback_metrics": dict,  # metrics at crash time (async log fetch
+                               # flushed first — latest boundary landed)
 }
 
 
@@ -328,3 +331,30 @@ def validate_bench_fault(block: Any, where: str = "fault") -> List[str]:
     """Validate the ``fault`` block of a ``BENCH_*.json`` artifact
     (absent on pre-recovery-plane rounds)."""
     return _check_fields(block, {}, _BENCH_FAULT_OPTIONAL, where)
+
+
+# The bench host_overhead block: how much of the step the HOST costs
+# (the megastep round's acceptance surface).  ``fit_vs_raw`` is the
+# Trainer-path overhead budget; ``dispatches_per_opt_step`` counts jit
+# dispatches per optimizer update on the headline (per-step) fit;
+# ``megastep_*`` record the K-fused A/B arm.  Nullable per probe — each
+# arm is best-effort, a failed probe must never cost the headline line.
+_BENCH_HOST_OVERHEAD_OPTIONAL = {
+    "fit_vs_raw": (int, float, type(None)),
+    "dispatches_per_opt_step": (int, float, type(None)),
+    "megastep_k": (int, type(None)),
+    "megastep_dispatches_per_opt_step": (int, float, type(None)),
+    "megastep_tokens_per_sec": (int, float, type(None)),
+    "megastep_speedup": (int, float, type(None)),
+}
+
+
+def validate_bench_host_overhead(block: Any,
+                                 where: str = "host_overhead") -> List[str]:
+    """Validate the ``host_overhead`` block of a ``BENCH_*.json``
+    artifact (absent on pre-megastep rounds)."""
+    problems = _check_fields(block, {}, _BENCH_HOST_OVERHEAD_OPTIONAL, where)
+    k = block.get("megastep_k") if isinstance(block, dict) else None
+    if not problems and isinstance(k, int) and k < 1:
+        problems.append(f"{where}: megastep_k must be >= 1, got {k}")
+    return problems

@@ -182,6 +182,19 @@ class _Agg:
         if v > self.max:
             self.max = v
 
+    def add_scaled(self, total: float, n: int) -> None:
+        """Book ``n`` steps observed as ONE wall measurement (a megastep
+        stride): the mean stays exact (``total``/``n`` ride the sums);
+        min/max see the stride's per-step AVERAGE — inner-step extremes
+        are invisible to the host by design."""
+        self.n += n
+        self.total += total
+        per = total / n
+        if per < self.min:
+            self.min = per
+        if per > self.max:
+            self.max = per
+
     def summary_ms(self) -> Dict[str, float]:
         if not self.n:
             return {}
@@ -246,19 +259,84 @@ class StepStats:
             return False
         return self.steps == 1 or self.steps % self.sample_every == 0
 
+    def should_sample_stride(self, k: int) -> bool:
+        """Stride-shaped :meth:`should_sample`: never the compile stride
+        (the first record), always the stride right after it (the early
+        honest number), then whenever the stride crosses the
+        ``sample_every`` cadence — so megastep fits sample device time at
+        the same step frequency the per-step loop does."""
+        if self.steps == 0:
+            return False
+        return (
+            self.steps <= k
+            or (self.steps // self.sample_every)
+            != ((self.steps + k) // self.sample_every)
+        )
+
+    def _record_midfit_compile(self, wall_s: float, k: int) -> None:
+        """A first-use program compiled MID-fit (megastep's lazy tail /
+        chaos-degraded single-step program, or the fused scan after a
+        singles-only start): book the wall as compile time and excise
+        the interval from the throughput window — steady-state
+        ``step_time_ms``/``dispatch_ms``/tokens-per-sec must not carry a
+        multi-second XLA outlier the way a hidden ordinary record would.
+        """
+        self.compile_ms = (self.compile_ms or 0.0) + 1e3 * wall_s
+        self.steps += k
+        if self._t_first is not None:
+            self._t_first += wall_s
+
+    def record_stride(self, stride_s: float, data_wait_s: float,
+                      dispatch_s: float, examples: int, k: int,
+                      sampled: bool = False, compiled: bool = False) -> None:
+        """One megastep stride = ``k`` micro-steps in one dispatch.
+
+        Headline attribution divides by ``k``: ``step_time_ms`` stays a
+        PER-MICRO-STEP number (comparable across megastep on/off runs),
+        with ``k`` steps booked per call via the scaled aggregators.
+        The first stride is booked as compile, like step 0 on the
+        per-step path — it is dominated by the scan trace + XLA compile
+        (the k-1 fused steps riding along are noise next to it).
+        ``compiled=True`` marks a mid-fit first-use compile (see
+        :meth:`_record_midfit_compile`).
+        """
+        if self.steps == 0:
+            self.compile_ms = 1e3 * stride_s
+            self.steps = k
+            self._t_first = time.perf_counter()
+            return
+        if compiled:
+            self._record_midfit_compile(stride_s, k)
+            return
+        self.steps += k
+        self.examples += int(examples)
+        if self.tokens_per_example:
+            self.tokens += int(examples) * self.tokens_per_example
+        self._step.add_scaled(stride_s, k)
+        self._data_wait.add_scaled(data_wait_s, k)
+        self._dispatch.add_scaled(dispatch_s, k)
+        if sampled:
+            self._device.add_scaled(stride_s, k)
+        self._t_last = time.perf_counter()
+
     def record_step(self, step_s: float, data_wait_s: float,
                     dispatch_s: float, examples: int,
-                    sampled: bool = False) -> None:
+                    sampled: bool = False, compiled: bool = False) -> None:
         """One loop iteration: total wall, input wait, jit-call time.
 
         ``sampled=True`` marks a step whose caller synced the device
         before the end mark — its wall time feeds the device-step
-        aggregate.  Step 0 is booked as compile time, not steady state.
+        aggregate.  Step 0 is booked as compile time, not steady state;
+        ``compiled=True`` marks a mid-fit first-use compile (see
+        :meth:`_record_midfit_compile`).
         """
         if self.steps == 0:
             self.compile_ms = 1e3 * step_s
             self.steps = 1
             self._t_first = time.perf_counter()
+            return
+        if compiled:
+            self._record_midfit_compile(step_s, 1)
             return
         self.steps += 1
         self.examples += int(examples)

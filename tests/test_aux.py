@@ -60,24 +60,38 @@ def test_profiler_callback_survives_short_run(tmp_path):
 
 
 def test_prefetched_preserves_order_and_errors():
+    # Items arrive as (placed, n_inner) pairs since the megastep round
+    # (n_inner == 1 when no stacking is configured).
     out = list(_prefetched(range(10), lambda x: x * 2))
-    assert out == [2 * i for i in range(10)]
+    assert out == [(2 * i, 1) for i in range(10)]
 
     def boom():
         yield 1
         raise RuntimeError("loader died")
 
     it = _prefetched(boom(), lambda x: x)
-    assert next(it) == 1
+    assert next(it) == (1, 1)
     with pytest.raises(RuntimeError, match="loader died"):
         list(it)
+
+
+def test_prefetched_stacks_strides_within_budget():
+    """stack=4 over 10 items with an 8-item stride budget: two full
+    strides, then per-item singles (the megastep grouping contract)."""
+    out = list(_prefetched(
+        range(10), lambda x: x, stack=4, stack_limit=8,
+        place_stride=lambda xs: tuple(xs),
+    ))
+    assert out == [
+        ((0, 1, 2, 3), 4), ((4, 5, 6, 7), 4), (8, 1), (9, 1),
+    ]
 
 
 def test_prefetched_early_break_stops_cleanly():
     import threading
 
     before = threading.active_count()
-    for item in _prefetched(range(1000), lambda x: x):
+    for item, _n in _prefetched(range(1000), lambda x: x):
         if item == 3:
             break
     import time

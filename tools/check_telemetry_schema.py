@@ -30,6 +30,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from ray_lightning_tpu.telemetry.schema import (  # noqa: E402
     validate_bench_fault,
+    validate_bench_host_overhead,
     validate_bench_telemetry,
     validate_chrome_trace,
     validate_flight_bundle,
@@ -136,6 +137,37 @@ def _self_test_live_plane(tmp: str) -> list:
             problems += validate_flight_bundle(
                 json.load(f), "self-test bundle"
             )
+    problems += _self_test_host_overhead()
+    return problems
+
+
+def _self_test_host_overhead() -> list:
+    """The megastep bench block: the shape bench.py emits must pass, and
+    a drifted producer (unknown key, bad megastep_k) must NOT."""
+    problems = validate_bench_host_overhead(
+        {
+            "fit_vs_raw": 0.97,
+            "dispatches_per_opt_step": 1.0,
+            "megastep_k": 8,
+            "megastep_dispatches_per_opt_step": 0.125,
+            "megastep_tokens_per_sec": 1234.5,
+            "megastep_speedup": 1.02,
+        },
+        "self-test host_overhead",
+    )
+    # All-null probes (every arm best-effort) are a legal block too.
+    problems += validate_bench_host_overhead(
+        {"fit_vs_raw": None, "megastep_speedup": None},
+        "self-test host_overhead nulls",
+    )
+    if not validate_bench_host_overhead({"unknown_key": 1}):
+        problems.append(
+            "self-test host_overhead: validator accepted an unknown key"
+        )
+    if not validate_bench_host_overhead({"megastep_k": 0}):
+        problems.append(
+            "self-test host_overhead: validator accepted megastep_k=0"
+        )
     return problems
 
 
@@ -169,6 +201,11 @@ def scan_bench_files() -> list:
         fault = doc.get("fault")
         if fault is not None:  # pre-recovery-plane rounds lack it
             problems += validate_bench_fault(fault, f"{name}:fault")
+        host = doc.get("host_overhead")
+        if host is not None:  # pre-megastep rounds lack it
+            problems += validate_bench_host_overhead(
+                host, f"{name}:host_overhead"
+            )
     return problems
 
 
