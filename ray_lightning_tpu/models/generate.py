@@ -106,9 +106,14 @@ def _block_pass(
     return _mlp_residual(x, p, c), k_l, v_l
 
 
-def _trunk_pass(cfg, params, cache, x, off, c):
+def _trunk_blocks(cfg, params, cache, x, off, c):
     """Scan :func:`_block_pass` over the stacked layers; return the
-    final LN'd last-position hidden and the updated cache."""
+    pre-``ln_f`` hidden for EVERY position and the updated cache.
+
+    The building block shared by :func:`_trunk_pass` (full forward →
+    last-position logits) and the serving plane's bucketed prefill
+    (``serve/kv_cache.py`` needs the hidden at the last *valid* prompt
+    position of a padded bucket, not the last slot)."""
 
     def block(carry, layer):
         x, = carry
@@ -119,12 +124,24 @@ def _trunk_pass(cfg, params, cache, x, off, c):
     (x,), (k_new, v_new) = jax.lax.scan(
         block, (x,), (params["blocks"], cache["k"], cache["v"])
     )
-    x = _layer_norm(x[:, -1], params["ln_f_g"], params["ln_f_b"])
-    logits = jnp.einsum(
-        "bd,vd->bv", x, _wte(params, c),
+    return x, {"k": k_new, "v": v_new}
+
+
+def _head_logits(params, h, c):
+    """``ln_f`` + tied LM head on hidden ``(..., d)`` → logits
+    ``(..., V)`` f32 (int8-storage aware via :func:`_wte`)."""
+    h = _layer_norm(h, params["ln_f_g"], params["ln_f_b"])
+    return jnp.einsum(
+        "...d,vd->...v", h, _wte(params, c),
         preferred_element_type=jnp.float32,
     )
-    return logits, {"k": k_new, "v": v_new}
+
+
+def _trunk_pass(cfg, params, cache, x, off, c):
+    """Scan :func:`_block_pass` over the stacked layers; return the
+    final LN'd last-position logits and the updated cache."""
+    x, cache = _trunk_blocks(cfg, params, cache, x, off, c)
+    return _head_logits(params, x[:, -1], c), cache
 
 
 def _wte(params, c):
@@ -307,6 +324,19 @@ def generate(
     # Accept host pytrees (e.g. ``trainer.params``) as well as device
     # arrays: numpy leaves cannot be gather-indexed by traced tokens.
     params = jax.tree.map(jnp.asarray, params)
+    # Int8 weight-only storage pays off where decode is HBM-bandwidth
+    # bound (TPU: int8 is what HBM streams, the convert fuses into the
+    # matmul).  Off-TPU the per-token dequant inside the decode scan
+    # COSTS more than the bandwidth it saves (BENCH_r05: 3345.7 int8 vs
+    # 4025.3 fp tokens/s on CPU), so hoist it: dequantize ONCE per call,
+    # outside the scan — same math, amortized over every generated
+    # token.
+    from ray_lightning_tpu.models.quant import (
+        dequantize_decode_params, is_quantized,
+    )
+
+    if is_quantized(params) and jax.default_backend() != "tpu":
+        params = dequantize_decode_params(params)
     prompt = jnp.asarray(prompt).astype(jnp.int32)
     if max_new_tokens == 0:
         return prompt

@@ -24,7 +24,12 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["quantize_decode_params", "resolve_weight", "is_quantized"]
+__all__ = [
+    "quantize_decode_params",
+    "dequantize_decode_params",
+    "resolve_weight",
+    "is_quantized",
+]
 
 # Weights worth quantizing: the 2-D+ matmul operands.  Biases, LN
 # params, and the positional table stay f32 (tiny, and bias precision
@@ -108,4 +113,35 @@ def quantize_decode_params(
     q, sc = _quantize(jnp.asarray(wte), contract_axis=-1)
     out["wte_q8"] = q
     out["wte_sc"] = sc
+    return out
+
+
+def dequantize_decode_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Fold int8 storage back into dense f32 weights.
+
+    Exactly the :func:`resolve_weight` / ``_wte`` arithmetic, applied
+    ONCE instead of at every consumption site.  Used by the generation
+    path to hoist the dequant out of the decode scan on backends where
+    weight bytes are not the decode bottleneck (CPU: the per-token
+    ``int8 → f32`` convert costs more than the bandwidth it saves —
+    BENCH_r05 measured the int8 tree 17% SLOWER there).  The rounding
+    already baked into the int8 storage is kept — this is a placement
+    change, not a precision change.
+    """
+    if not is_quantized(params):
+        return params
+    blocks = dict(params["blocks"])
+    for key in [k for k in blocks if str(k).endswith("_q8")]:
+        base = key[: -len("_q8")]
+        q = blocks.pop(key)
+        sc = blocks.pop(base + "_sc")
+        blocks[base] = q.astype(jnp.float32) * sc[..., None, :]
+    out = {
+        k: v for k, v in params.items()
+        if k not in ("blocks", "wte_q8", "wte_sc")
+    }
+    out["blocks"] = blocks
+    if "wte_q8" in params:
+        out["wte"] = (params["wte_q8"].astype(jnp.float32)
+                      * params["wte_sc"].astype(jnp.float32)[:, None])
     return out

@@ -1,0 +1,56 @@
+"""Continuous-batching inference serving plane (ISSUE 6).
+
+The "serve heavy traffic" half of the north star: a TPU-shaped serving
+engine on the existing actor/queue substrate.  Shape discipline is the
+same one the training core lives by — every steady-state program is
+compiled ONCE and re-dispatched forever:
+
+* :mod:`.kv_cache` — **paged KV cache**: the per-layer cache is a pool
+  of fixed-size token blocks shared by every in-flight sequence, with a
+  host-side block allocator and device-side block tables.  Finished
+  requests free their blocks immediately; prefill writes whole blocks,
+  decode scatters one slot per step;
+* :mod:`.scheduler` — **continuous batcher**: bounded admission queue
+  with per-request deadlines, join-on-arrival / evict-on-finish between
+  decode steps, recompute-style preemption when the block pool runs dry;
+* :mod:`.engine` — the driver-side serve loop: bucketed prefill
+  programs + ONE fixed-width decode program, SLO stats (TTFT, per-token
+  latency, queue depth, occupancy) and OpenMetrics export;
+* :mod:`.client` — request submission/streaming over the DriverQueue
+  plane, with backpressure surfaced as typed rejections;
+* :mod:`.metrics` — the jax-free SLO stats engine the bench and the
+  exporters share.
+
+See ``docs/SERVING.md`` for architecture, knobs and the bench
+methodology (``bench_serve.py``).
+"""
+
+from ray_lightning_tpu.serve.client import ServeClient, ServeRejected
+from ray_lightning_tpu.serve.engine import ServeConfig, ServeEngine
+from ray_lightning_tpu.serve.kv_cache import (
+    BlockAllocator,
+    PagedKVCache,
+    paged_decode_step,
+    paged_prefill,
+)
+from ray_lightning_tpu.serve.metrics import ServeStats
+from ray_lightning_tpu.serve.scheduler import (
+    Request,
+    RequestState,
+    Scheduler,
+)
+
+__all__ = [
+    "ServeEngine",
+    "ServeConfig",
+    "ServeClient",
+    "ServeRejected",
+    "ServeStats",
+    "PagedKVCache",
+    "BlockAllocator",
+    "paged_prefill",
+    "paged_decode_step",
+    "Request",
+    "RequestState",
+    "Scheduler",
+]

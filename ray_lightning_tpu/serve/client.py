@@ -1,0 +1,183 @@
+"""Request submission + token streaming over the DriverQueue plane.
+
+The wire shape mirrors the training stream items: every message is a
+small dict with a ``type`` tag (schema-pinned in ``telemetry/schema.py``
+— ``validate_serve_request`` / ``validate_serve_reply``).  Transport is
+the existing :class:`~ray_lightning_tpu.cluster.queue.DriverQueue`
+machinery in BOTH directions:
+
+* **requests** flow client → engine over the engine's inbox (the
+  picklable :meth:`ServeEngine.queue_handle`);
+* **replies** (per-token stream + completion) flow engine → client over
+  a reply queue the CLIENT owns, its ``(host, port)`` carried inside
+  each request — so one engine serves any number of clients on any
+  host, exactly like workers stream into the training driver.
+
+Backpressure is explicit: a full admission queue comes back as a
+``serve_done(status="rejected")`` reply and surfaces as
+:class:`ServeRejected` — clients decide whether to retry, the server
+never buffers unboundedly.
+
+After a preemption the engine re-streams a request's tokens from index
+0 (recompute preemption regenerates them); the client dedups on the
+token index, so consumers see each index exactly once.
+"""
+
+from __future__ import annotations
+
+import queue as _pyqueue
+import threading
+import uuid
+from typing import Dict, Iterator, List, Optional, Sequence
+
+from ray_lightning_tpu.cluster.queue import DriverQueue, QueueHandle
+from ray_lightning_tpu.serve.engine import ServeRejected
+
+__all__ = ["ServeClient", "ServeRejected"]
+
+
+class _Pending:
+    """Client-side state for one in-flight request."""
+
+    def __init__(self, rid: str):
+        self.rid = rid
+        self.tokens: List[int] = []
+        self.stream: _pyqueue.Queue = _pyqueue.Queue()
+        self.done = threading.Event()
+        self.status: Optional[str] = None
+        self.reason: Optional[str] = None
+        self.error: Optional[str] = None
+
+
+class ServeClient:
+    """One consumer of a serving engine.
+
+    Thread-safe: many threads may ``generate``/``stream`` concurrently
+    through one client; replies are demuxed by request id on a single
+    reader thread.
+    """
+
+    def __init__(self, handle: QueueHandle):
+        self._inbox = handle
+        self._replies = DriverQueue()
+        self._reply_addr = (self._replies.handle.host,
+                            self._replies.handle.port)
+        self._pending: Dict[str, _Pending] = {}
+        self._lock = threading.Lock()
+        self._closed = threading.Event()
+        self._reader = threading.Thread(
+            target=self._read_loop, name="rlt-serve-client", daemon=True
+        )
+        self._reader.start()
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               temperature: float = 0.0,
+               eos_token_id: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> str:
+        """Ship one request; returns its id immediately (streaming and
+        completion arrive asynchronously)."""
+        rid = uuid.uuid4().hex[:12]
+        with self._lock:
+            self._pending[rid] = _Pending(rid)
+        self._inbox.put({
+            "type": "serve_request",
+            "rid": rid,
+            "prompt": [int(t) for t in prompt],
+            "max_new_tokens": int(max_new_tokens),
+            "temperature": float(temperature),
+            "eos_token_id": eos_token_id,
+            "deadline_s": deadline_s,
+            "reply": list(self._reply_addr),
+        })
+        return rid
+
+    def generate(self, prompt: Sequence[int], max_new_tokens: int,
+                 timeout: Optional[float] = 60.0, **kw) -> List[int]:
+        """Blocking round trip → the generated tokens."""
+        rid = self.submit(prompt, max_new_tokens, **kw)
+        return self.result(rid, timeout=timeout)
+
+    def stream(self, prompt: Sequence[int], max_new_tokens: int,
+               timeout: Optional[float] = 60.0, **kw) -> Iterator[int]:
+        """Submit and yield tokens as the engine emits them (indices
+        deduped across preemptions)."""
+        rid = self.submit(prompt, max_new_tokens, **kw)
+        pend = self._pending[rid]
+        next_idx = 0
+        while True:
+            try:
+                kind, payload = pend.stream.get(timeout=timeout)
+            except _pyqueue.Empty:
+                raise TimeoutError(
+                    f"request {rid}: no stream item within {timeout}s"
+                ) from None
+            if kind == "token":
+                idx, tok = payload
+                if idx == next_idx:  # dedup re-emissions after preempt
+                    next_idx += 1
+                    yield tok
+            else:  # done
+                self._check_done(pend)
+                return
+
+    def result(self, rid: str, timeout: Optional[float] = 60.0
+               ) -> List[int]:
+        pend = self._pending.get(rid)
+        if pend is None:
+            raise KeyError(f"unknown request id {rid}")
+        if not pend.done.wait(timeout):
+            raise TimeoutError(f"request {rid} not finished in {timeout}s")
+        self._check_done(pend)
+        return list(pend.tokens)
+
+    def _check_done(self, pend: _Pending) -> None:
+        with self._lock:
+            self._pending.pop(pend.rid, None)
+        if pend.status == "invalid":
+            raise ValueError(
+                f"request {pend.rid} invalid: {pend.error}"
+            )
+        if pend.status == "error":
+            raise RuntimeError(
+                f"serve engine died with request {pend.rid} in flight: "
+                f"{pend.error}"
+            )
+        if pend.reason in ("rejected", "expired"):
+            raise ServeRejected(f"request {pend.rid} {pend.reason}")
+
+    # -- reply demux ---------------------------------------------------------
+    def _read_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                item = self._replies.get(timeout=0.5)
+            except _pyqueue.Empty:
+                continue
+            except (OSError, ValueError):
+                return  # queue shut down
+            if not isinstance(item, dict):
+                continue
+            pend = self._pending.get(str(item.get("rid")))
+            if pend is None:
+                continue
+            kind = item.get("type")
+            if kind == "serve_token":
+                idx, tok = int(item["index"]), int(item["token"])
+                if idx == len(pend.tokens):
+                    pend.tokens.append(tok)
+                elif idx < len(pend.tokens):
+                    pend.tokens[idx] = tok  # preemption re-emission
+                pend.stream.put(("token", (idx, tok)))
+            elif kind == "serve_done":
+                pend.status = item.get("status")
+                pend.reason = item.get("reason")
+                pend.error = item.get("error")
+                if item.get("tokens"):
+                    pend.tokens = [int(t) for t in item["tokens"]]
+                pend.stream.put(("done", None))
+                pend.done.set()
+
+    def close(self) -> None:
+        self._closed.set()
+        self._replies.shutdown()
+        self._inbox.close()

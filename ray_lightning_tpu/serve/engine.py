@@ -1,0 +1,607 @@
+"""The serve loop: compiled programs + continuous batching + SLO stats.
+
+Steady-state shape discipline (the whole point): after warmup the
+engine dispatches exactly TWO program families —
+
+* one **prefill program per bucket length** (a handful, compiled on
+  first use of each bucket);
+* ONE **fixed-width decode program** over the ``num_slots`` slot set.
+
+Join-on-arrival, evict-on-finish, growth and preemption all happen
+host-side between steps by mutating the programs' int32 operands
+(block tables, sequence lengths, current tokens) — never a shape, so
+steady-state serving triggers ZERO recompiles (asserted by the bench
+and the serve test suite via the telemetry recompile counter).
+
+The engine is driver-side and single-threaded over the device: call
+:meth:`step` yourself (tests, bench inner loops) or :meth:`start` a
+background thread (`serve_forever` semantics).  Requests arrive either
+in-process (:meth:`submit`) or over the DriverQueue plane
+(:meth:`queue_handle` + ``serve/client.py``) — same admission path,
+same backpressure.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["ServeConfig", "ServeEngine", "ServeHandle", "ServeRejected"]
+
+
+class ServeRejected(RuntimeError):
+    """Admission backpressure: the queue is full (or the request
+    expired before admission).  Typed so clients can retry-with-backoff
+    without string-matching."""
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    """Serving knobs (docs/SERVING.md "Knobs")."""
+
+    # Decode width: concurrent sequences in flight.  The ONE decode
+    # program is compiled at this width; admissions only fill slots.
+    num_slots: int = 8
+    # Tokens per KV block.  Smaller = finer pool granularity, larger =
+    # fewer scatter/gather indices per sequence.
+    block_size: int = 16
+    # Physical blocks in the pool (block 0 is the trash block).  None =
+    # enough for every slot at max_model_len plus one admission's worth
+    # of headroom — preemption-free at full width.
+    num_blocks: Optional[int] = None
+    # Longest prompt+generation the engine admits.  None = the model's
+    # positional table (cfg.seq_len).
+    max_model_len: Optional[int] = None
+    # Prefill bucket lengths (multiples of block_size).  None =
+    # power-of-two block counts up to max_model_len.
+    prefill_buckets: Optional[Sequence[int]] = None
+    # Admission-queue bound: submissions beyond it are REJECTED
+    # synchronously (backpressure, never silent queue bloat).
+    max_queue: int = 64
+    # Sampling seed for temperature>0 requests.
+    seed: int = 0
+    # Background-thread idle sleep between polls when no work exists.
+    idle_wait_s: float = 0.002
+    # Live-export refresh cadence (prom textfile / serve-live.json).
+    export_every_s: float = 1.0
+
+
+class ServeHandle:
+    """Host-side future for one request."""
+
+    def __init__(self, rid: str, request):
+        self.rid = rid
+        self.request = request
+        self.error: Optional[BaseException] = None  # engine-death only
+        self._done = threading.Event()
+
+    @property
+    def status(self) -> str:
+        return self.request.state.value
+
+    @property
+    def tokens(self) -> List[int]:
+        return list(self.request.generated)
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Generated tokens (prompt excluded).  Raises
+        :class:`ServeRejected` on backpressure/expiry, ``TimeoutError``
+        when the engine did not finish in time."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.rid} not finished within {timeout}s "
+                f"(state={self.status})"
+            )
+        if self.error is not None:
+            raise RuntimeError(
+                f"serve engine died with request {self.rid} in flight"
+            ) from self.error
+        if self.request.done_reason in ("rejected", "expired"):
+            raise ServeRejected(
+                f"request {self.rid} {self.request.done_reason}"
+            )
+        return list(self.request.generated)
+
+
+class ServeEngine:
+    """Continuous-batching inference engine for one GPT module."""
+
+    def __init__(self, module, params, config: Optional[ServeConfig] = None,
+                 telemetry_dir: Optional[str] = None,
+                 prom_file: Optional[str] = None,
+                 prom_port: Optional[int] = None):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_lightning_tpu.models.generate import _reject_unmerged_lora
+        from ray_lightning_tpu.models.quant import (
+            dequantize_decode_params, is_quantized,
+        )
+        from ray_lightning_tpu.serve.kv_cache import PagedKVCache
+        from ray_lightning_tpu.serve.metrics import ServeStats
+        from ray_lightning_tpu.serve.scheduler import (
+            Scheduler, default_buckets,
+        )
+
+        self.module = module
+        self.cfg = module.config
+        self.config = cfg = config or ServeConfig()
+        _reject_unmerged_lora(params)
+        params = jax.tree.map(jnp.asarray, params)
+        # Same backend gate as generate(): off-TPU, per-token dequant
+        # inside the decode program costs more than the weight-bandwidth
+        # it saves — hoist it once at engine build.
+        if is_quantized(params) and jax.default_backend() != "tpu":
+            params = dequantize_decode_params(params)
+        self.params = params
+        self._c = module._compute_dtype()
+
+        self.max_model_len = cfg.max_model_len or self.cfg.seq_len
+        if self.max_model_len > self.cfg.seq_len:
+            raise ValueError(
+                f"max_model_len {self.max_model_len} exceeds the "
+                f"positional table ({self.cfg.seq_len})"
+            )
+        blocks_per_seq = -(-self.max_model_len // cfg.block_size)
+        num_blocks = cfg.num_blocks
+        if num_blocks is None:
+            # Preemption-free at full width: every slot at max length,
+            # one extra admission's worth of blocks, plus the trash
+            # block.
+            num_blocks = (cfg.num_slots + 1) * blocks_per_seq + 1
+        if num_blocks - 1 < blocks_per_seq:
+            raise ValueError(
+                f"num_blocks {num_blocks} cannot hold even one "
+                f"max-length sequence ({blocks_per_seq} blocks)"
+            )
+        self.cache = PagedKVCache(
+            self.cfg, num_blocks, cfg.block_size, dtype=self._c
+        )
+        buckets = list(cfg.prefill_buckets or default_buckets(
+            cfg.block_size, max(1, self.max_model_len - 1)
+        ))
+        # A bucket longer than max_model_len cannot run (the prefill
+        # indexes the positional table at [0, T)), so the longest
+        # RETAINED bucket bounds the admissible prompt length — submit()
+        # enforces it, so Scheduler.bucket_for can never raise inside
+        # the serve loop.  The bound only bites when max_model_len is
+        # not bucket-aligned (docs/SERVING.md "Knobs").
+        buckets = sorted(b for b in buckets if b <= self.max_model_len)
+        if not buckets:
+            raise ValueError(
+                f"no prefill bucket fits max_model_len "
+                f"{self.max_model_len} (block_size {cfg.block_size} too "
+                f"large? smallest bucket is one block)"
+            )
+        self.max_prompt_len = buckets[-1]
+        self.scheduler = Scheduler(
+            cfg.num_slots, self.cache.allocator, cfg.block_size,
+            blocks_per_seq, buckets, max_queue=cfg.max_queue,
+        )
+        self.stats = ServeStats()
+        self._pool = self.cache.init_pool()
+        self._cur_tokens = np.zeros((cfg.num_slots,), np.int32)
+        self._rng = jax.random.PRNGKey(cfg.seed)
+        self._build_programs()
+
+        self._handles: Dict[str, ServeHandle] = {}
+        self._error: Optional[BaseException] = None
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._inbox = None           # DriverQueue, lazily created
+        self._reply_handles: Dict[Tuple[str, int], Any] = {}
+        self._exporter = None
+        self._live_path = None
+        self._last_export = 0.0
+        if prom_file or prom_port is not None:
+            from ray_lightning_tpu.telemetry.export_prom import PromExporter
+
+            self._exporter = PromExporter(
+                textfile=prom_file, port=prom_port
+            )
+        if telemetry_dir:
+            import os
+
+            os.makedirs(telemetry_dir, exist_ok=True)
+            self._live_path = f"{telemetry_dir}/serve-live.json"
+
+    # -- compiled programs ---------------------------------------------------
+    def _build_programs(self) -> None:
+        import jax
+
+        from ray_lightning_tpu.serve.kv_cache import (
+            paged_decode_step, paged_prefill, sample_tokens,
+        )
+
+        cfg, c = self.cfg, self._c
+        # Donation keeps the pool update in place on TPU; XLA:CPU cannot
+        # donate and would warn on every dispatch.
+        donate = (1,) if jax.default_backend() == "tpu" else ()
+
+        def _decode(params, pool, block_tables, seq_lens, tokens, temps,
+                    rng):
+            logits, pool = paged_decode_step(
+                cfg, params, pool, block_tables, seq_lens, tokens,
+                compute_dtype=c,
+            )
+            return sample_tokens(logits, rng, temps), pool
+
+        def _prefill(params, pool, tokens, prompt_len, block_ids, temp,
+                     rng):
+            logits, pool = paged_prefill(
+                cfg, params, pool, tokens, prompt_len, block_ids,
+                compute_dtype=c,
+            )
+            first = sample_tokens(logits[None], rng, temp[None])[0]
+            return first, pool
+
+        self._decode_fn = jax.jit(_decode, donate_argnums=donate)
+        # One python callable; XLA compiles one executable per bucket
+        # length (tokens/block_ids shapes) — the bucketed prefill set.
+        self._prefill_fn = jax.jit(_prefill, donate_argnums=donate)
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               temperature: float = 0.0,
+               eos_token_id: Optional[int] = None,
+               deadline_s: Optional[float] = None,
+               on_token=None, rid: Optional[str] = None) -> ServeHandle:
+        """Enqueue one request (thread-safe).  Returns a handle; a
+        backpressure rejection is visible immediately as
+        ``handle.status == "rejected"`` (and ``result()`` raises)."""
+        from ray_lightning_tpu.serve.scheduler import Request
+
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("prompt must contain at least one token")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        if len(prompt) + max_new_tokens > self.max_model_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_model_len "
+                f"({self.max_model_len})"
+            )
+        if len(prompt) > self.max_prompt_len:
+            raise ValueError(
+                f"prompt ({len(prompt)}) exceeds the largest prefill "
+                f"bucket ({self.max_prompt_len}); raise max_model_len "
+                f"to a multiple of block_size or pass prefill_buckets"
+            )
+        if any(not 0 <= t < self.cfg.vocab_size for t in prompt):
+            raise ValueError("prompt token outside the vocab")
+        if self._error is not None:
+            raise RuntimeError(
+                "serve engine is dead (its loop raised; see the chained "
+                "error) — build a fresh ServeEngine"
+            ) from self._error
+        rid = rid or uuid.uuid4().hex[:12]
+        req = Request(
+            rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
+            temperature=float(temperature), eos_token_id=eos_token_id,
+            deadline_s=deadline_s, on_token=on_token,
+        )
+        handle = ServeHandle(rid, req)
+        with self._lock:
+            self.stats.bump("submitted")
+            accepted = self.scheduler.submit(req)
+            if accepted:
+                self._handles[rid] = handle
+        if not accepted:
+            self.stats.bump("rejected")
+            req.finished_t = time.monotonic()
+            handle._done.set()
+        return handle
+
+    def generate(self, prompt: Sequence[int], max_new_tokens: int,
+                 timeout: Optional[float] = 60.0, **kw) -> List[int]:
+        """Blocking convenience: submit + drive (when no background
+        thread runs) + result."""
+        handle = self.submit(prompt, max_new_tokens, **kw)
+        if self._thread is None:
+            self.run_until_idle()
+        return handle.result(timeout)
+
+    # -- the loop ------------------------------------------------------------
+    def step(self) -> bool:
+        """One serve iteration: drain the queue plane, expire/admit,
+        grow/preempt, one decode step.  Returns True when any work was
+        done (False = idle)."""
+        import jax
+        import jax.numpy as jnp
+
+        self._drain_inbox()
+        with self._lock:
+            admissions, expired = self.scheduler.poll()
+        worked = bool(admissions) or bool(expired)
+        for req in expired:
+            self.stats.bump("expired")
+            self._finish_handle(req)
+        now = time.monotonic()
+        for slot, req, bucket in admissions:
+            self.stats.note_admitted(now - req.arrival_t)
+            self.stats.bump("prefills")
+            padded = np.zeros((bucket,), np.int32)
+            padded[: req.prompt_len] = req.prompt
+            ids = np.asarray(
+                self.scheduler._blocks[slot][: bucket
+                                             // self.config.block_size],
+                np.int32,
+            )
+            self._rng, sub = jax.random.split(self._rng)
+            first, self._pool = self._prefill_fn(
+                self.params, self._pool, jnp.asarray(padded),
+                np.int32(req.prompt_len), jnp.asarray(ids),
+                np.float32(req.temperature), sub,
+            )
+            first = int(first)
+            t_first = time.monotonic()
+            self.stats.note_first_token(t_first - req.arrival_t)
+            done = self.scheduler.append_token(slot, first, now=t_first)
+            self.stats.bump("tokens_out")
+            self._cur_tokens[slot] = first
+            if done:
+                self._complete(slot)
+
+        # Growth (and preemption when the pool is dry) for every slot
+        # about to write past its allocated blocks.
+        active = [
+            s for s, r in enumerate(self.scheduler.slots) if r is not None
+        ]
+        for slot in list(active):
+            if self.scheduler.slots[slot] is None:
+                continue  # preempted by an earlier slot's growth
+            while self.scheduler.needs_block(slot):
+                if self.scheduler.grow(slot):
+                    break
+                victim = self.scheduler.preempt_youngest(protect=slot)
+                if victim is None:
+                    # Only this request is live and the pool is dry —
+                    # impossible under the init-time sizing invariant.
+                    raise RuntimeError(
+                        "block pool exhausted with a single live "
+                        "request — num_blocks below one sequence"
+                    )
+                self.stats.bump("preempted")
+
+        active = [
+            s for s, r in enumerate(self.scheduler.slots) if r is not None
+        ]
+        if active:
+            worked = True
+            self._rng, sub = jax.random.split(self._rng)
+            t0 = time.monotonic()
+            toks, self._pool = self._decode_fn(
+                self.params, self._pool,
+                jnp.asarray(self.scheduler.block_tables),
+                jnp.asarray(self.scheduler.seq_lens),
+                jnp.asarray(self._cur_tokens),
+                jnp.asarray(self.scheduler.temperatures), sub,
+            )
+            toks = np.asarray(toks)
+            dt = time.monotonic() - t0
+            self.stats.bump("decode_steps")
+            self.stats.note_token_latency(dt, n_tokens=len(active))
+            for slot in active:
+                self.scheduler.seq_lens[slot] += 1
+                tok = int(toks[slot])
+                self._cur_tokens[slot] = tok
+                done = self.scheduler.append_token(slot, tok)
+                if done:
+                    self._complete(slot)
+        self._refresh_gauges()
+        self._maybe_export()
+        return worked
+
+    def run_until_idle(self, max_steps: int = 1_000_000) -> None:
+        """Drive the loop synchronously until queue and slots drain."""
+        for _ in range(max_steps):
+            self.step()
+            if not self.scheduler.has_work():
+                return
+        raise RuntimeError(f"still busy after {max_steps} serve steps")
+
+    def _complete(self, slot: int) -> None:
+        req = self.scheduler.finish(slot)
+        self.stats.note_completed(req.finished_t - req.arrival_t)
+        self._finish_handle(req)
+
+    def _finish_handle(self, req) -> None:
+        with self._lock:
+            handle = self._handles.pop(req.rid, None)
+        if handle is not None:
+            handle._done.set()
+        self._reply_done(req)
+
+    # -- background thread ---------------------------------------------------
+    def start(self) -> "ServeEngine":
+        if self._thread is not None:
+            raise RuntimeError("engine already started")
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._serve_forever, name="rlt-serve", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _serve_forever(self) -> None:
+        while not self._stop.is_set():
+            try:
+                worked = self.step()
+            except Exception as e:  # noqa: BLE001 - a dying loop must
+                # fail its pending work loudly, never strand it
+                self._fail_pending(e)
+                return
+            if not worked:
+                time.sleep(self.config.idle_wait_s)
+
+    def _fail_pending(self, exc: BaseException) -> None:
+        """The serve loop died: mark the engine dead (submit() refuses
+        from now on), fail every in-flight/queued handle with the error,
+        and tell queue-plane clients (``serve_done(status="error")``)
+        instead of letting them block to their timeouts."""
+        import logging
+
+        logging.getLogger(__name__).error(
+            "serve loop died: %r — failing %d pending request(s)",
+            exc, len(self._handles), exc_info=exc,
+        )
+        self._error = exc
+        with self._lock:
+            handles = list(self._handles.values())
+            self._handles.clear()
+        for handle in handles:
+            handle.error = exc
+            req = handle.request
+            reply = getattr(req, "_reply", None)
+            if reply is not None:
+                self._reply(reply, {
+                    "type": "serve_done", "rid": req.rid,
+                    "status": "error", "error": repr(exc),
+                    "tokens": [int(t) for t in req.generated],
+                })
+            handle._done.set()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        if self._inbox is not None:
+            self._inbox.shutdown()
+            self._inbox = None
+        for h in self._reply_handles.values():
+            h.close()
+        self._reply_handles.clear()
+        if self._exporter is not None:
+            self._exporter.close()
+
+    # -- DriverQueue request plane ------------------------------------------
+    def queue_handle(self):
+        """Picklable submission handle for :class:`serve.client.
+        ServeClient` — created on first use (driver-side TCP inbox)."""
+        if self._inbox is None:
+            from ray_lightning_tpu.cluster.queue import DriverQueue
+
+            self._inbox = DriverQueue()
+        return self._inbox.handle
+
+    def _drain_inbox(self) -> None:
+        if self._inbox is None:
+            return
+        import queue as _pyqueue
+
+        while True:
+            try:
+                item = self._inbox.get_nowait()
+            except _pyqueue.Empty:
+                return
+            try:
+                self._handle_queue_request(item)
+            except Exception as e:  # noqa: BLE001 - a bad request must
+                # never take the serve loop down
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "serve: dropped malformed queue request: %s", e
+                )
+
+    def _handle_queue_request(self, item: dict) -> None:
+        if not isinstance(item, dict) or item.get("type") != "serve_request":
+            raise ValueError(f"not a serve_request: {type(item).__name__}")
+        rid = str(item["rid"])
+        reply = tuple(item["reply"])  # (host, port)
+
+        def on_token(i: int, tok: int) -> None:
+            self._reply(reply, {
+                "type": "serve_token", "rid": rid, "index": i,
+                "token": int(tok),
+            })
+
+        try:
+            handle = self.submit(
+                item["prompt"], int(item["max_new_tokens"]),
+                temperature=float(item.get("temperature", 0.0)),
+                eos_token_id=item.get("eos_token_id"),
+                deadline_s=item.get("deadline_s"),
+                on_token=on_token, rid=rid,
+            )
+        except (ValueError, TypeError) as e:
+            # TypeError covers malformed field coercion (int(None), ...):
+            # once the reply address is known, every bad request gets
+            # the typed "invalid" reply — a silent drop would leave the
+            # client blocking to its timeout.
+            self._reply(reply, {
+                "type": "serve_done", "rid": rid, "status": "invalid",
+                "error": str(e), "tokens": [],
+            })
+            return
+        handle.request._reply = reply
+        if handle.status == "rejected":
+            self._reply_done(handle.request)
+
+    def _reply_done(self, req) -> None:
+        reply = getattr(req, "_reply", None)
+        if reply is None:
+            return
+        self._reply(reply, {
+            "type": "serve_done", "rid": req.rid,
+            "status": req.state.value,
+            "reason": req.done_reason,
+            "tokens": [int(t) for t in req.generated],
+        })
+
+    def _reply(self, addr: Tuple[str, int], item: dict) -> None:
+        from ray_lightning_tpu.cluster.queue import QueueHandle
+
+        handle = self._reply_handles.get(addr)
+        if handle is None:
+            handle = QueueHandle(addr[0], addr[1])
+            self._reply_handles[addr] = handle
+        try:
+            handle.put(item)
+        except (OSError, ConnectionError):
+            # Client went away: drop its stream, keep serving others.
+            self._reply_handles.pop(addr, None)
+
+    # -- telemetry -----------------------------------------------------------
+    def _refresh_gauges(self) -> None:
+        self.stats.set_gauges(**self.scheduler.snapshot())
+
+    def snapshot(self) -> dict:
+        """The live serve snapshot (schema:
+        ``telemetry/schema.py::validate_serve_snapshot``)."""
+        return self.stats.snapshot()
+
+    def _maybe_export(self) -> None:
+        if self._exporter is None and self._live_path is None:
+            return
+        now = time.monotonic()
+        if now - self._last_export < self.config.export_every_s:
+            return
+        self._last_export = now
+        snap = self.snapshot()
+        if self._exporter is not None:
+            self._exporter.update({"serve": snap})
+        if self._live_path is not None:
+            import json
+            import os
+
+            tmp = self._live_path + ".tmp"
+            try:
+                with open(tmp, "w") as f:
+                    json.dump({"ts": snap["ts"], "serve": snap}, f)
+                os.replace(tmp, self._live_path)
+            except OSError:
+                pass  # a full disk must not take the serve loop down

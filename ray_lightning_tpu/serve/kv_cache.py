@@ -1,0 +1,327 @@
+"""Paged/blocked KV cache: one block pool shared by every sequence.
+
+``models/generate.py`` allocates ONE contiguous ``(L, B, total, H, Dh)``
+cache per batch — fine for a fixed batch generating in lockstep, fatal
+for serving: every request would own ``total_len`` slots for its whole
+lifetime, and a new request could not join until the whole batch
+finished.  The serving cache is instead a pool of fixed-size token
+blocks (the vLLM/PagedAttention layout, TPU-shaped):
+
+* **pool** — ``k``/``v`` each ``(L, num_blocks, block_size, H, Dh)``.
+  One allocation for the whole server, sized by memory, not by batch;
+* **block tables** — per-slot ``(max_blocks_per_seq,)`` int32 rows
+  mapping a sequence's logical block index → physical pool block.
+  Tables live host-side (numpy, mutated by the scheduler between steps)
+  and ride into the compiled step as ordinary int32 operands — shapes
+  never change, so steady-state serving never recompiles;
+* **allocator** — a host-side free list.  Finished/evicted requests
+  free their blocks immediately; the next admission reuses them.
+
+Physical block 0 is reserved as the **trash block**: inactive slots
+point their writes at it, so the fixed-width decode program needs no
+active-mask branch — garbage lands where nothing ever reads.
+
+Device programs (pure functions, jitted by the engine):
+
+* :func:`paged_prefill` — one padded prompt bucket through the SAME
+  stacked-layer block scan the static path uses
+  (``generate._trunk_blocks``), then the per-layer k/v scattered into
+  the sequence's pool blocks.  Compiled once per bucket length;
+* :func:`paged_decode_step` — one token for EVERY slot: scatter the new
+  k/v into each slot's current block, gather each slot's blocks, and
+  attend under a ``position <= seq_len`` mask.  ONE fixed-width program
+  for the server's lifetime.
+
+Numerics match the contiguous path by construction: the gather lays a
+sequence's blocks back into logical order, the mask hides exactly the
+slots the static path's causal mask hides, and scores/softmax/PV stay
+f32 (see ``generate._block_pass``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ray_lightning_tpu.models.generate import (
+    _embed, _head_logits, _trunk_blocks,
+)
+from ray_lightning_tpu.models.gpt import (
+    GPTConfig, _layer_norm, _mlp_residual, _moe_residual,
+)
+from ray_lightning_tpu.models.quant import resolve_weight
+from ray_lightning_tpu.ops.attention import _NEG_INF
+
+__all__ = [
+    "BlockAllocator",
+    "PagedKVCache",
+    "paged_prefill",
+    "paged_decode_step",
+]
+
+# Physical block 0 is never allocated: it is the write target for
+# inactive slots (and the padding entry of short block tables), so the
+# decode program stays branch-free.
+TRASH_BLOCK = 0
+
+
+class BlockAllocator:
+    """Host-side free list over the physical block pool.
+
+    jax-free and O(1) per op.  Double-free and foreign-id frees raise —
+    a scheduler bug that silently re-issued a live block would corrupt
+    another request's cache, the one failure mode a serving cache must
+    never shrug off.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block {TRASH_BLOCK} is "
+                f"reserved), got {num_blocks}"
+            )
+        self.num_blocks = num_blocks
+        # LIFO free list: recently-freed blocks are re-issued first
+        # (their pool pages are the warmest).
+        self._free: List[int] = list(range(num_blocks - 1, TRASH_BLOCK, -1))
+        self._live: set = set()
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def live_blocks(self) -> int:
+        return len(self._live)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` physical block ids, or ``None`` (all-or-nothing) when
+        the pool cannot cover the request."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        ids = [self._free.pop() for _ in range(n)]
+        self._live.update(ids)
+        return ids
+
+    def free(self, ids) -> None:
+        for b in ids:
+            if b not in self._live:
+                raise RuntimeError(
+                    f"free of block {b} which is not live (double-free "
+                    f"or foreign id) — scheduler bookkeeping bug"
+                )
+            self._live.discard(b)
+            self._free.append(b)
+
+
+class PagedKVCache:
+    """The device block pool + its allocator.
+
+    ``pool`` is a ``{"k", "v"}`` dict of ``(L, N, Bs, H, Dh)`` arrays —
+    the same stacked-layer leading axis as the static cache, so the
+    layer scan is shared.  The engine owns the authoritative pool arrays
+    (they flow through the donated compiled steps); this object carries
+    the geometry and the allocator.
+    """
+
+    def __init__(self, cfg: GPTConfig, num_blocks: int, block_size: int,
+                 dtype=jnp.float32):
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.cfg = cfg
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        self.dtype = dtype
+        self.allocator = BlockAllocator(num_blocks)
+
+    def init_pool(self) -> Dict[str, jax.Array]:
+        cfg = self.cfg
+        shape = (cfg.n_layer, self.num_blocks, self.block_size,
+                 cfg.n_head, cfg.head_dim)
+        return {"k": jnp.zeros(shape, self.dtype),
+                "v": jnp.zeros(shape, self.dtype)}
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Physical blocks needed to hold ``n_tokens`` cache slots."""
+        return -(-n_tokens // self.block_size)
+
+
+def paged_prefill(
+    cfg: GPTConfig,
+    params: Dict[str, Any],
+    pool: Dict[str, jax.Array],
+    tokens: jax.Array,
+    prompt_len: jax.Array,
+    block_ids: jax.Array,
+    compute_dtype=jnp.float32,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One prompt through the full-sequence causal pass, cache written
+    into the sequence's pool blocks.
+
+    Args:
+        tokens: ``(T,)`` int32, the prompt right-padded to a bucket
+            length ``T`` that is a multiple of the pool's block size.
+        prompt_len: scalar int32, the number of VALID leading tokens.
+        block_ids: ``(T // block_size,)`` int32 physical blocks that
+            will hold cache positions ``[0, T)`` of this sequence.
+
+    Returns:
+        ``(next-token logits (V,) f32 at position prompt_len - 1,
+        updated pool)``.  Padding positions write garbage into the tail
+        of the sequence's own blocks; decode masks ``s <= seq_len`` so
+        it is never attended, and the sequence's own growth overwrites
+        it slot by slot.
+
+    Compiled once per bucket length ``T`` — the "few bucketed prompt
+    lengths" prefill programs of the serving plane.
+    """
+    c = compute_dtype
+    T = tokens.shape[0]
+    Bs = pool["k"].shape[2]
+    if T % Bs != 0:
+        raise ValueError(
+            f"prefill bucket length {T} is not a multiple of the "
+            f"block size {Bs}"
+        )
+    x = _embed(params, tokens[None], c) + params["wpe"][:T].astype(c)
+    # The contiguous temp cache reuses the static path's stacked-layer
+    # scan verbatim (ONE source for the block math), then the per-layer
+    # k/v reshape into whole blocks and scatter into the pool.
+    tmp = {
+        "k": jnp.zeros((cfg.n_layer, 1, T, cfg.n_head, cfg.head_dim),
+                       pool["k"].dtype),
+        "v": jnp.zeros((cfg.n_layer, 1, T, cfg.n_head, cfg.head_dim),
+                       pool["v"].dtype),
+    }
+    hidden, tmp = _trunk_blocks(cfg, params, tmp, x, 0, c)
+    h_last = jax.lax.dynamic_index_in_dim(
+        hidden[0], prompt_len - 1, axis=0, keepdims=False
+    )
+    logits = _head_logits(params, h_last, c)
+    n = T // Bs
+    out = {}
+    for key in ("k", "v"):
+        per_block = tmp[key][:, 0].reshape(
+            cfg.n_layer, n, Bs, cfg.n_head, cfg.head_dim
+        )
+        out[key] = pool[key].at[:, block_ids].set(per_block)
+    return logits, out
+
+
+def paged_decode_step(
+    cfg: GPTConfig,
+    params: Dict[str, Any],
+    pool: Dict[str, jax.Array],
+    block_tables: jax.Array,
+    seq_lens: jax.Array,
+    tokens: jax.Array,
+    compute_dtype=jnp.float32,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One token for every slot of the fixed-width active set.
+
+    Args:
+        block_tables: ``(W, M)`` int32 — each slot's physical blocks in
+            logical order; unused entries (and whole inactive rows)
+            point at the trash block.
+        seq_lens: ``(W,)`` int32 — tokens already IN the cache per slot;
+            the current token is written at this position.
+        tokens: ``(W,)`` int32 — the token each slot feeds this step
+            (inactive slots: anything; their row is masked by pointing
+            at the trash block and never being read).
+
+    Returns:
+        ``(logits (W, V) f32, updated pool)``.
+
+    ONE compiled program for any mix of sequence lengths: the per-slot
+    write position, the gather, and the visibility mask are all data,
+    never shapes — join-on-arrival/evict-on-finish between steps only
+    changes operand VALUES, so steady-state serving never recompiles.
+    """
+    c = compute_dtype
+    Bs = pool["k"].shape[2]
+    W, M = block_tables.shape
+    S = M * Bs
+    pos = seq_lens
+    # Clamp the positional lookup: inactive slots carry pos 0, active
+    # ones are scheduler-bounded to < seq_len; the clamp only guards
+    # garbage from ever indexing out of the table.
+    safe_pos = jnp.minimum(pos, params["wpe"].shape[0] - 1)
+    x = _embed(params, tokens, c) + params["wpe"][safe_pos].astype(c)
+    write_blk = jnp.take_along_axis(
+        block_tables, (pos // Bs)[:, None], axis=1
+    )[:, 0]
+    write_off = pos % Bs
+    scale = cfg.head_dim ** -0.5
+    # Visible: cache positions [0, pos] inclusive — the current token's
+    # k/v are written before the gather, exactly the static path's
+    # causal frontier.
+    visible = jnp.arange(S)[None, :] <= pos[:, None]
+
+    def block(carry, layer):
+        x, = carry
+        p, k_pool, v_pool = layer  # (N, Bs, H, Dh) each
+        h = _layer_norm(x, p["ln1_g"], p["ln1_b"])
+        qkv = h @ resolve_weight(p, "qkv_w", c) + p["qkv_b"].astype(c)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(z):
+            return z.reshape(W, cfg.n_head, cfg.head_dim)
+
+        k_pool = k_pool.at[write_blk, write_off].set(
+            heads(k).astype(k_pool.dtype)
+        )
+        v_pool = v_pool.at[write_blk, write_off].set(
+            heads(v).astype(v_pool.dtype)
+        )
+        ctx_k = k_pool[block_tables].reshape(W, S, cfg.n_head, cfg.head_dim)
+        ctx_v = v_pool[block_tables].reshape(W, S, cfg.n_head, cfg.head_dim)
+        scores = jnp.einsum(
+            "whd,wshd->whs", heads(q).astype(jnp.float32),
+            ctx_k.astype(jnp.float32),
+        ) * scale
+        scores = jnp.where(visible[:, None, :], scores, _NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        att = jnp.einsum(
+            "whs,wshd->whd", probs, ctx_v.astype(jnp.float32)
+        ).reshape(W, cfg.d_model).astype(c)
+        x = x + att @ resolve_weight(p, "proj_w", c) + p["proj_b"].astype(c)
+        if cfg.n_experts > 0:
+            # Same routed-MLP math as the static decode; the routed set
+            # here is the W current tokens (see generate() caveat).
+            x2, _ = _moe_residual(x[:, None], p, cfg, groups=1)
+            x = x2[:, 0]
+        else:
+            x = _mlp_residual(x, p, c)
+        return (x,), (k_pool, v_pool)
+
+    (x,), (k_new, v_new) = jax.lax.scan(
+        block, (x,), (params["blocks"], pool["k"], pool["v"])
+    )
+    logits = _head_logits(params, x, c)
+    return logits, {"k": k_new, "v": v_new}
+
+
+def sample_tokens(
+    logits: jax.Array,
+    rng: jax.Array,
+    temperatures: jax.Array,
+) -> jax.Array:
+    """Per-slot sampling decision: greedy where ``temperature <= 0``,
+    categorical at ``logits / temperature`` elsewhere.  Shape-static
+    (W,) → (W,) int32 so it fuses into the decode program.
+
+    Per-request top-k/top-p are intentionally not offered: they would
+    either force per-slot sorted-vocab work into every step or bucket
+    requests by sampler config; greedy/temperature covers the serving
+    SLO bench and the static path keeps the full sampler family.
+    """
+    greedy = jnp.argmax(logits, axis=-1)
+    temps = jnp.maximum(temperatures, 1e-6)[:, None]
+    sampled = jax.random.categorical(rng, logits / temps)
+    return jnp.where(
+        temperatures <= 0.0, greedy, sampled
+    ).astype(jnp.int32)

@@ -1,0 +1,340 @@
+"""Continuous batcher: admission queue, slot table, preemption policy.
+
+jax-free host-side control plane for the serving engine.  The unit of
+scheduling is the **slot** — one of ``num_slots`` rows of the compiled
+decode program's fixed width.  Between decode steps the scheduler:
+
+1. **expires** queued requests whose deadline passed (never admitted —
+   cheaper to reject at the queue than to evict mid-decode);
+2. **evicts** finished slots, freeing their blocks immediately;
+3. **admits** queued requests while a free slot AND enough blocks for
+   the request's prefill bucket exist (join-on-arrival: a request never
+   waits for the running batch to drain);
+4. **grows** active sequences one block at a time as they cross block
+   boundaries.  When the pool is dry, the YOUNGEST active request is
+   preempted (recompute-style: blocks freed, request requeued at the
+   FRONT so it re-admits first) — latency already invested in old
+   requests is never thrown away for a newcomer.
+
+Everything here mutates small numpy arrays (block tables, seq lens,
+temperatures) that the engine ships into the compiled step as operand
+VALUES — admission and eviction never change a shape, so the scheduler
+is recompile-free by construction.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Deque, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ray_lightning_tpu.serve.kv_cache import BlockAllocator, TRASH_BLOCK
+
+__all__ = ["Request", "RequestState", "Scheduler", "default_buckets"]
+
+
+class RequestState(enum.Enum):
+    QUEUED = "queued"
+    RUNNING = "running"
+    FINISHED = "finished"
+    EXPIRED = "expired"     # deadline passed while queued
+    REJECTED = "rejected"   # admission-queue backpressure
+
+
+@dataclass
+class Request:
+    """One generation request and its runtime state."""
+
+    rid: str
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    eos_token_id: Optional[int] = None
+    # Seconds from arrival the FIRST token must land by (TTFT SLO at
+    # admission; None = no deadline).
+    deadline_s: Optional[float] = None
+    # Called with (token_index, token_id) as tokens stream out; after a
+    # preemption the engine re-emits from index 0 — consumers dedup on
+    # the index (greedy regenerates identical tokens).
+    on_token: Optional[Callable[[int, int], None]] = None
+
+    # -- runtime (scheduler-owned) ------------------------------------------
+    state: RequestState = RequestState.QUEUED
+    arrival_t: float = field(default_factory=time.monotonic)
+    admitted_t: Optional[float] = None
+    first_token_t: Optional[float] = None
+    finished_t: Optional[float] = None
+    generated: List[int] = field(default_factory=list)
+    slot: Optional[int] = None
+    preemptions: int = 0
+    # Admission ordinal — the preemption victim ordering key.
+    _seq_no: int = -1
+
+    @property
+    def prompt_len(self) -> int:
+        return len(self.prompt)
+
+    @property
+    def done_reason(self) -> Optional[str]:
+        if self.state is RequestState.FINISHED:
+            return "eos" if (
+                self.eos_token_id is not None
+                and self.generated
+                and self.generated[-1] == self.eos_token_id
+            ) else "length"
+        if self.state in (RequestState.EXPIRED, RequestState.REJECTED):
+            return self.state.value
+        return None
+
+
+def default_buckets(block_size: int, max_prompt_len: int) -> List[int]:
+    """Power-of-two block counts: ``block_size * (1, 2, 4, ...)`` up to
+    the first bucket covering ``max_prompt_len``.  A handful of prefill
+    programs covers every prompt length with <= 2x padding waste."""
+    buckets = []
+    b = block_size
+    while True:
+        buckets.append(b)
+        if b >= max_prompt_len:
+            return buckets
+        b *= 2
+
+
+class Scheduler:
+    """Slot table + admission queue + block accounting.
+
+    The engine drives it: ``poll()`` between decode steps returns what
+    changed (admissions to prefill, expiries to report); ``append`` /
+    ``finish`` / ``preempt_for_growth`` mutate per-slot state as tokens
+    land.
+    """
+
+    def __init__(
+        self,
+        num_slots: int,
+        allocator: BlockAllocator,
+        block_size: int,
+        max_blocks_per_seq: int,
+        buckets: Sequence[int],
+        max_queue: int = 64,
+    ):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        for b in buckets:
+            if b % block_size:
+                raise ValueError(
+                    f"prefill bucket {b} is not a multiple of the "
+                    f"block size {block_size}"
+                )
+        self.num_slots = num_slots
+        self.allocator = allocator
+        self.block_size = block_size
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.buckets = sorted(buckets)
+        self.max_queue = max_queue
+        self.queue: Deque[Request] = deque()
+        self.slots: List[Optional[Request]] = [None] * num_slots
+        # Per-slot allocated physical blocks, in logical order.
+        self._blocks: List[List[int]] = [[] for _ in range(num_slots)]
+        # The compiled step's operands (value-only mutation).
+        self.block_tables = np.full(
+            (num_slots, max_blocks_per_seq), TRASH_BLOCK, np.int32
+        )
+        self.seq_lens = np.zeros((num_slots,), np.int32)
+        self.temperatures = np.zeros((num_slots,), np.float32)
+        self._admit_counter = 0
+
+    # -- queue side ----------------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        return len(self.queue)
+
+    @property
+    def active_slots(self) -> int:
+        return sum(r is not None for r in self.slots)
+
+    def has_work(self) -> bool:
+        return bool(self.queue) or self.active_slots > 0
+
+    def submit(self, req: Request) -> bool:
+        """Enqueue, or reject (backpressure) when the queue is full.
+        Rejection is synchronous and typed — the client decides whether
+        to retry, never the server."""
+        if len(self.queue) >= self.max_queue:
+            req.state = RequestState.REJECTED
+            return False
+        req.state = RequestState.QUEUED
+        self.queue.append(req)
+        return True
+
+    def bucket_for(self, prompt_len: int) -> int:
+        for b in self.buckets:
+            if b >= prompt_len:
+                return b
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds the largest prefill "
+            f"bucket {self.buckets[-1]}"
+        )
+
+    # -- between-steps poll --------------------------------------------------
+    def poll(
+        self, now: Optional[float] = None
+    ) -> Tuple[List[Tuple[int, Request, int]], List[Request]]:
+        """Expire, then admit.  Returns ``(admissions, expired)`` where
+        each admission is ``(slot, request, bucket_len)`` with blocks
+        already allocated and the slot row populated — the engine only
+        has to run the bucket's prefill program."""
+        now = time.monotonic() if now is None else now
+        expired: List[Request] = []
+        fresh: Deque[Request] = deque()
+        while self.queue:
+            req = self.queue.popleft()
+            # deadline_s is a TTFT-at-admission SLO: once a request has
+            # been admitted and streamed (then got preempted back into
+            # the queue), its deadline is already MET — expiring it on
+            # requeue would throw away the invested latency the
+            # front-requeue policy exists to protect.
+            if (req.deadline_s is not None
+                    and req.preemptions == 0
+                    and now - req.arrival_t > req.deadline_s):
+                req.state = RequestState.EXPIRED
+                req.finished_t = now
+                expired.append(req)
+            else:
+                fresh.append(req)
+        self.queue = fresh
+
+        admissions: List[Tuple[int, Request, int]] = []
+        while self.queue:
+            slot = next(
+                (i for i, r in enumerate(self.slots) if r is None), None
+            )
+            if slot is None:
+                break
+            req = self.queue[0]
+            bucket = self.bucket_for(req.prompt_len)
+            ids = self.allocator.alloc(bucket // self.block_size)
+            if ids is None:
+                break  # pool dry: wait for evictions, keep FIFO order
+            self.queue.popleft()
+            req.state = RequestState.RUNNING
+            req.slot = slot
+            req.admitted_t = now
+            req.generated = []
+            req._seq_no = self._admit_counter
+            self._admit_counter += 1
+            self.slots[slot] = req
+            self._blocks[slot] = ids
+            row = self.block_tables[slot]
+            row[:] = TRASH_BLOCK
+            row[: len(ids)] = ids
+            self.seq_lens[slot] = req.prompt_len
+            self.temperatures[slot] = req.temperature
+            admissions.append((slot, req, bucket))
+        return admissions, expired
+
+    # -- per-step slot transitions ------------------------------------------
+    def append_token(self, slot: int, token: int,
+                     now: Optional[float] = None) -> bool:
+        """Record one generated token for ``slot``; returns True when
+        the request just finished (eos or length)."""
+        now = time.monotonic() if now is None else now
+        req = self.slots[slot]
+        assert req is not None, f"append_token on empty slot {slot}"
+        if req.first_token_t is None:
+            req.first_token_t = now
+        idx = len(req.generated)
+        req.generated.append(token)
+        if req.on_token is not None:
+            try:
+                req.on_token(idx, token)
+            except Exception:  # noqa: BLE001 - a raising stream consumer
+                # must never take the serve loop down with it
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "serve: on_token callback raised for %s", req.rid,
+                    exc_info=True,
+                )
+        done = (
+            len(req.generated) >= req.max_new_tokens
+            or (req.eos_token_id is not None and token == req.eos_token_id)
+        )
+        return done
+
+    def needs_block(self, slot: int) -> bool:
+        """True when the NEXT decode write for ``slot`` crosses into an
+        unallocated block."""
+        pos = int(self.seq_lens[slot])
+        return pos // self.block_size >= len(self._blocks[slot])
+
+    def grow(self, slot: int) -> bool:
+        """Allocate the next block for ``slot``.  False = pool dry."""
+        if len(self._blocks[slot]) >= self.max_blocks_per_seq:
+            raise RuntimeError(
+                f"slot {slot} exceeded max_blocks_per_seq "
+                f"{self.max_blocks_per_seq} — engine admission bound bug"
+            )
+        ids = self.allocator.alloc(1)
+        if ids is None:
+            return False
+        self._blocks[slot].extend(ids)
+        self.block_tables[slot, len(self._blocks[slot]) - 1] = ids[0]
+        return True
+
+    def preempt_youngest(self, protect: Optional[int] = None
+                         ) -> Optional[Request]:
+        """Evict the most recently admitted active request (recompute
+        preemption): free its blocks, requeue it at the FRONT.  Returns
+        the victim, or None when no slot (other than ``protect``) is
+        evictable."""
+        victims = [
+            (req._seq_no, slot)
+            for slot, req in enumerate(self.slots)
+            if req is not None and slot != protect
+        ]
+        if not victims:
+            return None
+        _, slot = max(victims)
+        req = self.slots[slot]
+        self._release(slot)
+        req.state = RequestState.QUEUED
+        req.slot = None
+        req.preemptions += 1
+        req.generated = []
+        req.first_token_t = None
+        self.queue.appendleft(req)
+        return req
+
+    def finish(self, slot: int, now: Optional[float] = None) -> Request:
+        now = time.monotonic() if now is None else now
+        req = self.slots[slot]
+        assert req is not None, f"finish on empty slot {slot}"
+        req.state = RequestState.FINISHED
+        req.finished_t = now
+        req.slot = None
+        self._release(slot)
+        return req
+
+    def _release(self, slot: int) -> None:
+        self.allocator.free(self._blocks[slot])
+        self._blocks[slot] = []
+        self.slots[slot] = None
+        self.block_tables[slot, :] = TRASH_BLOCK
+        self.seq_lens[slot] = 0
+        self.temperatures[slot] = 0.0
+
+    # -- introspection -------------------------------------------------------
+    def snapshot(self) -> dict:
+        return {
+            "queue_depth": self.queue_depth,
+            "slots_active": self.active_slots,
+            "num_slots": self.num_slots,
+            "blocks_free": self.allocator.free_blocks,
+            "blocks_live": self.allocator.live_blocks,
+            "num_blocks": self.allocator.num_blocks,
+        }

@@ -86,8 +86,58 @@ def render_openmetrics(snapshot: Dict[str, Any],
             lines.append(
                 f'{_PREFIX}_monitor_events_total{{kind="{_esc(kind)}"}} {n}'
             )
+    serve = snapshot.get("serve")
+    if serve:
+        lines.extend(_render_serve(serve))
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
+
+
+def _render_serve(serve: Dict[str, Any]) -> list:
+    """The serving plane's SLO section (``ServeStats.snapshot`` shape —
+    ``telemetry/schema.py::validate_serve_snapshot``): admission/slot
+    gauges, request counters by state, and TTFT / per-token latency
+    percentiles."""
+    lines = []
+    gauges = serve.get("gauges", {})
+    for name, help_ in (
+        ("queue_depth", "requests waiting for admission"),
+        ("slots_active", "decode slots in flight"),
+        ("num_slots", "decode program width"),
+        ("blocks_free", "free KV-cache blocks"),
+        ("blocks_live", "allocated KV-cache blocks"),
+        ("num_blocks", "KV-cache pool size in blocks"),
+    ):
+        if name in gauges:
+            lines.append(f"# TYPE {_PREFIX}_serve_{name} gauge")
+            lines.append(f"# HELP {_PREFIX}_serve_{name} {help_}")
+            lines.append(f"{_PREFIX}_serve_{name} {gauges[name]}")
+    counters = serve.get("counters", {})
+    if counters:
+        lines.append(f"# TYPE {_PREFIX}_serve_requests counter")
+        lines.append(
+            f"# HELP {_PREFIX}_serve_requests serve events by kind"
+        )
+        for kind in sorted(counters):
+            lines.append(
+                f'{_PREFIX}_serve_requests_total'
+                f'{{kind="{_esc(kind)}"}} {counters[kind]}'
+            )
+    latency = serve.get("latency", {})
+    for family, summary in sorted(latency.items()):
+        metric = f"serve_{family}_latency_ms"
+        lines.append(f"# TYPE {_PREFIX}_{metric} gauge")
+        lines.append(
+            f"# HELP {_PREFIX}_{metric} {family} latency percentiles "
+            f"over the recent window"
+        )
+        for q in ("p50_ms", "p99_ms", "max_ms"):
+            if q in summary:
+                lines.append(
+                    f'{_PREFIX}_{metric}{{quantile="{q[:-3]}"}} '
+                    f"{summary[q]}"
+                )
+    return lines
 
 
 class PromExporter:

@@ -31,6 +31,10 @@ __all__ = [
     "validate_log_item",
     "validate_stream_item",
     "validate_flight_bundle",
+    "validate_serve_request",
+    "validate_serve_reply",
+    "validate_serve_snapshot",
+    "validate_bench_serve",
     "FLIGHT_BUNDLE_SCHEMA_ID",
 ]
 
@@ -291,6 +295,179 @@ def validate_flight_bundle(doc: Any, where: str = "bundle") -> List[str]:
         )
     for i, span in enumerate(doc.get("spans", [])):
         problems += validate_span(span, f"{where}.spans[{i}]")
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# Serving plane (serve/): wire items, live snapshot, bench block
+# ---------------------------------------------------------------------------
+
+# The client → engine submission item (serve/client.py → engine inbox).
+_SERVE_REQUEST_REQUIRED = {
+    "type": str,              # always "serve_request"
+    "rid": str,
+    "prompt": list,           # int token ids
+    "max_new_tokens": int,
+    "reply": list,            # [host, port] of the client's reply queue
+}
+_SERVE_REQUEST_OPTIONAL = {
+    "temperature": (int, float),
+    "eos_token_id": (int, type(None)),
+    "deadline_s": (int, float, type(None)),
+}
+
+# Engine → client replies: the per-token stream and the completion.
+_SERVE_TOKEN_REQUIRED = {
+    "type": str,              # "serve_token"
+    "rid": str,
+    "index": int,             # re-emitted from 0 after a preemption
+    "token": int,
+}
+_SERVE_DONE_REQUIRED = {
+    "type": str,              # "serve_done"
+    "rid": str,
+    "status": str,            # finished/rejected/expired/invalid/error
+    "tokens": list,
+}
+_SERVE_DONE_OPTIONAL = {
+    "reason": (str, type(None)),   # eos/length/rejected/expired
+    "error": str,                  # invalid submissions only
+}
+
+
+def validate_serve_request(item: Any,
+                           where: str = "serve_request") -> List[str]:
+    problems = _validate_typed(
+        item, "serve_request", _SERVE_REQUEST_REQUIRED,
+        _SERVE_REQUEST_OPTIONAL, where,
+    )
+    if not problems:
+        if item["max_new_tokens"] < 1:
+            problems.append(f"{where}: max_new_tokens < 1")
+        if not item["prompt"]:
+            problems.append(f"{where}: empty prompt")
+        if len(item["reply"]) != 2:
+            problems.append(f"{where}: reply is not [host, port]")
+    return problems
+
+
+def validate_serve_reply(item: Any, where: str = "serve_reply") -> List[str]:
+    """Dispatch over the engine → client reply family."""
+    if not isinstance(item, dict):
+        return [f"{where}: expected object, got {type(item).__name__}"]
+    kind = item.get("type")
+    if kind == "serve_token":
+        problems = _validate_typed(
+            item, "serve_token", _SERVE_TOKEN_REQUIRED, {}, where
+        )
+        if not problems and item["index"] < 0:
+            problems.append(f"{where}: negative index")
+        return problems
+    if kind == "serve_done":
+        return _validate_typed(
+            item, "serve_done", _SERVE_DONE_REQUIRED,
+            _SERVE_DONE_OPTIONAL, where,
+        )
+    return [f"{where}: unknown serve reply type {kind!r}"]
+
+
+# The live SLO snapshot (ServeStats.snapshot → serve-live.json, the
+# OpenMetrics serve gauges and rlt_top's serve pane).
+_SERVE_SNAPSHOT_REQUIRED = {
+    "ts": (int, float),
+    "counters": dict,
+    "gauges": dict,
+    "latency": dict,
+}
+_SERVE_LATENCY_KEYS = ("ttft", "token", "queue_wait", "e2e")
+_SERVE_LATENCY_FIELDS = {
+    "n": int,
+    "p50_ms": (int, float),
+    "p99_ms": (int, float),
+    "max_ms": (int, float),
+}
+
+
+def validate_serve_snapshot(doc: Any,
+                            where: str = "serve_snapshot") -> List[str]:
+    problems = _check_fields(doc, _SERVE_SNAPSHOT_REQUIRED, {}, where)
+    if problems:
+        return problems
+    for key, value in doc["counters"].items():
+        if not isinstance(value, int) or isinstance(value, bool):
+            problems.append(f"{where}: counter {key!r} is not an int")
+    for key, value in doc["gauges"].items():
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            problems.append(f"{where}: gauge {key!r} is not numeric")
+    for family, summary in doc["latency"].items():
+        if family not in _SERVE_LATENCY_KEYS:
+            problems.append(f"{where}: unknown latency family {family!r}")
+            continue
+        problems += _check_fields(
+            summary, _SERVE_LATENCY_FIELDS, {},
+            f"{where}.latency.{family}",
+        )
+    return problems
+
+
+# The bench_serve.py artifact block: serving rounds become comparable
+# only if every round spells the SLO numbers the same way.  The A/B
+# ratio and sweep arms are nullable (best-effort probes), the headline
+# latency/throughput numbers are not — a serve bench that cannot
+# measure them has failed.
+_BENCH_SERVE_REQUIRED = {
+    "requests_per_sec": (int, float),
+    "p50_token_latency_ms": (int, float),
+    "p99_token_latency_ms": (int, float),
+    "recompiles_steady_state": int,
+}
+_BENCH_SERVE_OPTIONAL = {
+    "tokens_per_sec": (int, float, type(None)),
+    "p50_ttft_ms": (int, float, type(None)),
+    "p99_ttft_ms": (int, float, type(None)),
+    "continuous_vs_sequential": (int, float, type(None)),
+    "sequential_requests_per_sec": (int, float, type(None)),
+    "sequential_tokens_per_sec": (int, float, type(None)),
+    "num_slots": int,
+    "block_size": int,
+    "num_blocks": int,
+    "completed": int,
+    "preempted": int,
+    "rejected": int,
+    "expired": int,
+    "rate_sweep": list,       # per-offered-rate open-loop arms
+}
+_BENCH_SERVE_SWEEP_REQUIRED = {
+    "offered_rps": (int, float),
+    "requests_per_sec": (int, float),
+    "p50_token_latency_ms": (int, float, type(None)),
+    "p99_token_latency_ms": (int, float, type(None)),
+}
+_BENCH_SERVE_SWEEP_OPTIONAL = {
+    "p50_ttft_ms": (int, float, type(None)),
+    "p99_ttft_ms": (int, float, type(None)),
+    "completed": int,
+    "expired": int,
+    "rejected": int,
+    "queue_depth_max": int,
+}
+
+
+def validate_bench_serve(block: Any, where: str = "serve") -> List[str]:
+    """Validate the ``serve`` block of a bench artifact (absent on
+    pre-serving rounds)."""
+    problems = _check_fields(
+        block, _BENCH_SERVE_REQUIRED, _BENCH_SERVE_OPTIONAL, where
+    )
+    if problems:
+        return problems
+    if block["recompiles_steady_state"] < 0:
+        problems.append(f"{where}: negative recompiles_steady_state")
+    for i, arm in enumerate(block.get("rate_sweep", [])):
+        problems += _check_fields(
+            arm, _BENCH_SERVE_SWEEP_REQUIRED, _BENCH_SERVE_SWEEP_OPTIONAL,
+            f"{where}.rate_sweep[{i}]",
+        )
     return problems
 
 

@@ -65,6 +65,7 @@ def test_get_backend_passes_instances_through(agent):
         backend.shutdown()
 
 
+@pytest.mark.slow  # tier-1 diet (round 11): see pytest.ini 'slow'
 def test_user_owned_backend_survives_fit_teardown(agent):
     """A caller-provided backend instance must remain usable after fit
     (the strategy only owns backends it constructed itself)."""

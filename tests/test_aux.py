@@ -20,6 +20,7 @@ from ray_lightning_tpu.models.gpt import GPT, GPTConfig, SyntheticLMDataModule
 from ray_lightning_tpu.parallel.strategies import LocalStrategy, RayStrategy
 
 
+@pytest.mark.slow  # tier-1 diet (round 11): see pytest.ini 'slow'
 def test_profiler_callback_writes_trace(tmp_path):
     cb = ProfilerCallback(start_step=1, num_steps=2)
     trainer = Trainer(
@@ -132,6 +133,7 @@ def test_resume_with_fewer_workers(tmp_path):
     assert np.isfinite(resumed.callback_metrics["train_loss"])
 
 
+@pytest.mark.slow  # tier-1 diet (round 11): see pytest.ini 'slow'
 def test_pbt_sweep_of_gpt_lr(tmp_path):
     """BASELINE #5 shape at test scale: PBT explores GPT learning rates."""
     from ray_lightning_tpu.tune import TuneReportCallback

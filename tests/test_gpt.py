@@ -59,6 +59,7 @@ def test_gpt_tp_fsdp_parity_with_data_mesh():
     )
 
 
+@pytest.mark.slow  # tier-1 diet (round 11): see pytest.ini 'slow'
 def test_gpt_ring_attention_training():
     """Sequence-parallel (ring attention) flavor trains and agrees."""
     base = fit_metrics(LocalStrategy())
@@ -71,6 +72,7 @@ def test_gpt_ring_attention_training():
     )
 
 
+@pytest.mark.slow  # tier-1 diet (round 11): see pytest.ini 'slow'
 def test_gpt_zigzag_ring_training():
     """Zig-zag (causally balanced) sequence parallelism trains and agrees
     with the plain local run — the in/out permutations cancel."""
@@ -235,6 +237,7 @@ def test_gpt_shard_map_flavor_trains():
     )
 
 
+@pytest.mark.slow  # tier-1 diet (round 11): see pytest.ini 'slow'
 @pytest.mark.parametrize("policy", ["dots+flash", "dots+flash-out", "dots"])
 def test_remat_policy_variants_same_numerics(policy):
     """remat_policy only changes WHAT the backward saves, never the
@@ -324,6 +327,7 @@ class TestByteLMDataModule:
         assert (batch["tokens"][:, 0] == 256).all()  # BOS
         assert batch["tokens"].max() < ByteLMDataModule.vocab_size
 
+    @pytest.mark.slow  # tier-1 diet (round 11): see pytest.ini 'slow'
     def test_gpt_trains_on_real_text(self, tmp_path):
         """End-to-end: byte-level GPT on real text, loss clearly below
         uniform (ln 384 ≈ 5.95) after one epoch on repetitive text."""

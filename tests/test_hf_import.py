@@ -34,6 +34,7 @@ def _tiny_hf(vocab=97, n_layer=2, n_head=4, d=64, seq=32):
     return model
 
 
+@pytest.mark.slow  # tier-1 diet (round 11): see pytest.ini 'slow'
 def test_logits_parity_with_transformers():
     hf = _tiny_hf()
     cfg, params = import_gpt2(hf)
@@ -52,6 +53,7 @@ def test_logits_parity_with_transformers():
     np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow  # tier-1 diet (round 11): see pytest.ini 'slow'
 def test_imported_params_train_under_strategy(tmp_path):
     """Imported weights drop into the normal fit path (sharded mesh):
     the loss moves and stays finite."""

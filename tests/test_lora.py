@@ -142,6 +142,7 @@ def test_lora_sharded_mesh_parity(tmp_path):
     assert base == pytest.approx(sharded, rel=1e-5)
 
 
+@pytest.mark.slow  # tier-1 diet (round 11): see pytest.ini 'slow'
 def test_hf_import_lora_flow():
     """The migration recipe: import HF GPT-2 → add adapters →
     warm-start a LoRA fit → the base stays at the imported values."""

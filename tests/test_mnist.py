@@ -1,11 +1,14 @@
 """MNIST classifier convergence (BASELINE.md config #1 analogue;
 ≙ reference predict_test accuracy>=0.5, tests/utils.py:256-272)."""
 
+import pytest
+
 from ray_lightning_tpu.core.trainer import Trainer
 from ray_lightning_tpu.models.mnist import MNISTClassifier, MNISTDataModule
 from ray_lightning_tpu.parallel.strategies import LocalStrategy
 
 
+@pytest.mark.slow  # tier-1 diet (round 11): see pytest.ini 'slow'
 def test_mnist_converges(tmp_path):
     trainer = Trainer(
         strategy=LocalStrategy(),

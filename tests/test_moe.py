@@ -92,6 +92,7 @@ def fit_moe(strategy, **cfg_kw):
     return tr
 
 
+@pytest.mark.slow  # tier-1 diet (round 11): see pytest.ini 'slow'
 def test_moe_gpt_trains():
     tr = fit_moe(LocalStrategy())
     assert np.isfinite(tr.callback_metrics["train_loss"])
@@ -100,6 +101,7 @@ def test_moe_gpt_trains():
     assert 0.5 < tr.callback_metrics["moe_aux_loss"] < 4.0
 
 
+@pytest.mark.slow  # tier-1 diet (round 11): see pytest.ini 'slow'
 def test_moe_expert_parallel_parity():
     """ep × tp × dp mesh must match the unsharded run numerically.
 

@@ -532,6 +532,7 @@ class _CrashAt(Callback):
 
 @pytest.mark.remote
 class TestLivePlaneIntegration:
+    @pytest.mark.slow  # tier-1 diet (round 11): see pytest.ini 'slow'
     def test_hang_detected_dumped_and_aborted(self, tmp_path):
         """Acceptance: a stalled worker is detected within K heartbeat
         intervals, a stack-dump event names the stalled rank in

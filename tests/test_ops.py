@@ -428,6 +428,7 @@ def test_zigzag_ring_forward_matches_xla(qkv, mesh_shape, axes):
     assert float(jnp.abs(out - ref).max()) < 1e-5
 
 
+@pytest.mark.slow  # tier-1 diet (round 11): see pytest.ini 'slow'
 def test_zigzag_ring_grad_matches_xla(qkv):
     q, k, v = qkv
     mesh = Mesh(mesh_utils.create_device_mesh((2, 4)), ("data", "sp"))

@@ -38,6 +38,7 @@ def make_trainer(**kw):
     return Trainer(**kw)
 
 
+@pytest.mark.slow  # tier-1 diet (round 11): see pytest.ini 'slow'
 def test_resnet_trains_and_converges():
     tr = make_trainer(max_epochs=3)
     tr.fit(tiny_resnet(), make_data())

@@ -1,0 +1,693 @@
+"""Serving plane: paged KV cache vs the static path, continuous batching.
+
+The correctness contract mirrors the repo's grad-parity discipline:
+``models/generate.py`` (the static one-cache-per-batch path) is the
+reference — a request served through the paged cache must produce
+exactly the tokens ``generate()`` would, regardless of what else is in
+flight, which blocks it landed on, or how many times it was preempted.
+On top: block free/reuse correctness, the zero-recompile steady-state
+guarantee (via the telemetry recompile counter), scheduler policy
+units, SLO stats schema, and the DriverQueue client plane.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.models.generate import generate
+from ray_lightning_tpu.models.gpt import GPT, GPTConfig
+from ray_lightning_tpu.serve.engine import (
+    ServeConfig, ServeEngine, ServeRejected,
+)
+from ray_lightning_tpu.serve.kv_cache import (
+    TRASH_BLOCK, BlockAllocator, PagedKVCache, paged_decode_step,
+    paged_prefill,
+)
+from ray_lightning_tpu.serve.metrics import ServeStats, percentile
+from ray_lightning_tpu.serve.scheduler import (
+    Request, Scheduler, default_buckets,
+)
+from ray_lightning_tpu.telemetry import compile_event_count
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = GPTConfig(vocab_size=128, n_layer=2, n_head=4, d_model=64,
+                    seq_len=64, warmup_steps=1)
+    m = GPT(cfg, attn_impl="xla")
+    params = m.init_params(jax.random.PRNGKey(0))
+    return m, params
+
+
+def _ref_tokens(m, params, prompt, n):
+    """Static-path greedy reference continuation."""
+    out = generate(m, params, jnp.asarray([prompt], jnp.int32), n)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def _rand_prompt(seed, length, vocab):
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, vocab, size=(length,)).tolist()
+
+
+# ---------------------------------------------------------------------------
+# Block allocator + scheduler policy (jax-free units)
+# ---------------------------------------------------------------------------
+
+class TestAllocator:
+    def test_alloc_free_reuse(self):
+        a = BlockAllocator(6)
+        assert a.free_blocks == 5  # block 0 reserved
+        ids = a.alloc(3)
+        assert len(ids) == 3 and TRASH_BLOCK not in ids
+        assert a.alloc(3) is None          # all-or-nothing
+        assert a.free_blocks == 2
+        a.free(ids)
+        assert a.free_blocks == 5
+        again = a.alloc(5)
+        assert sorted(again) == [1, 2, 3, 4, 5]
+
+    def test_double_free_raises(self):
+        a = BlockAllocator(4)
+        ids = a.alloc(1)
+        a.free(ids)
+        with pytest.raises(RuntimeError, match="double-free"):
+            a.free(ids)
+        with pytest.raises(RuntimeError, match="not live"):
+            a.free([2])
+
+    def test_too_small_pool_rejected(self):
+        with pytest.raises(ValueError, match="reserved"):
+            BlockAllocator(1)
+
+
+class TestSchedulerPolicy:
+    def _sched(self, num_slots=2, num_blocks=9, max_queue=4):
+        alloc = BlockAllocator(num_blocks)
+        return Scheduler(num_slots, alloc, block_size=4,
+                         max_blocks_per_seq=4, buckets=[4, 8, 16],
+                         max_queue=max_queue)
+
+    def _req(self, rid, prompt_len=3, max_new=4, **kw):
+        return Request(rid=rid, prompt=list(range(1, prompt_len + 1)),
+                       max_new_tokens=max_new, **kw)
+
+    def test_default_buckets_cover_max_prompt(self):
+        assert default_buckets(16, 100) == [16, 32, 64, 128]
+        assert default_buckets(8, 8) == [8]
+
+    def test_bucket_for_picks_smallest_cover(self):
+        s = self._sched()
+        assert s.bucket_for(3) == 4
+        assert s.bucket_for(4) == 4
+        assert s.bucket_for(5) == 8
+        with pytest.raises(ValueError, match="exceeds"):
+            s.bucket_for(17)
+
+    def test_admission_fifo_and_slot_fill(self):
+        s = self._sched()
+        for i in range(3):
+            assert s.submit(self._req(f"r{i}"))
+        admissions, expired = s.poll(now=0.0)
+        assert not expired
+        assert [r.rid for _, r, _ in admissions] == ["r0", "r1"]
+        assert s.queue_depth == 1 and s.active_slots == 2
+        # Slot rows populated for the compiled step.
+        for slot, req, bucket in admissions:
+            assert bucket == 4
+            assert s.seq_lens[slot] == req.prompt_len
+            assert s.block_tables[slot, 0] != TRASH_BLOCK
+
+    def test_backpressure_rejects_beyond_max_queue(self):
+        s = self._sched(max_queue=2)
+        assert s.submit(self._req("a")) and s.submit(self._req("b"))
+        rej = self._req("c")
+        assert not s.submit(rej)
+        assert rej.done_reason == "rejected"
+
+    def test_deadline_expires_queued_requests(self):
+        s = self._sched()
+        req = self._req("late", deadline_s=0.5)
+        req.arrival_t = 100.0
+        s.submit(req)
+        admissions, expired = s.poll(now=101.0)
+        assert not admissions and [r.rid for r in expired] == ["late"]
+        assert req.done_reason == "expired"
+
+    def test_growth_and_preemption_frees_youngest(self):
+        # Pool of 8 usable blocks, two admitted sequences (1 block
+        # each); exhaust the rest, then growth must preempt the
+        # YOUNGER request and requeue it at the front.
+        s = self._sched(num_blocks=9)
+        s.submit(self._req("old", prompt_len=4))
+        s.submit(self._req("young", prompt_len=4))
+        (s0, old, _), (s1, young, _) = s.poll(now=0.0)[0]
+        hog = s.allocator.alloc(6)
+        s.seq_lens[s0] += 4  # next write crosses into block 2
+        assert s.needs_block(s0) and not s.grow(s0)
+        victim = s.preempt_youngest(protect=s0)
+        assert victim is young and victim.preemptions == 1
+        assert s.queue[0].rid == "young"
+        s.allocator.free(hog)
+        assert s.grow(s0)
+        # The freed slot is admissible again.
+        admissions, _ = s.poll(now=1.0)
+        assert [r.rid for _, r, _ in admissions] == ["young"]
+
+    def test_finish_releases_everything(self):
+        s = self._sched()
+        s.submit(self._req("a"))
+        (slot, req, _), = s.poll(now=0.0)[0]
+        free_before = s.allocator.free_blocks
+        s.append_token(slot, 7, now=0.1)
+        done = s.finish(slot, now=0.2)
+        assert done.state.value == "finished"
+        assert s.slots[slot] is None
+        assert (s.block_tables[slot] == TRASH_BLOCK).all()
+        assert s.allocator.free_blocks == free_before + 1
+
+    def test_preempted_request_survives_deadline_on_requeue(self):
+        """deadline_s is a TTFT-at-admission SLO: a request that already
+        streamed tokens and was preempted back into the queue must NOT
+        be expired on re-admission, however late it is."""
+        s = self._sched()
+        req = self._req("a", deadline_s=0.5)
+        req.arrival_t = 100.0
+        s.submit(req)
+        (slot, r, _), = s.poll(now=100.1)[0]
+        s.append_token(slot, 7, now=100.2)  # first token delivered
+        assert s.preempt_youngest() is req
+        admissions, expired = s.poll(now=200.0)  # way past the deadline
+        assert not expired
+        assert [x.rid for _, x, _ in admissions] == ["a"]
+
+    def test_raising_on_token_does_not_break_append(self):
+        s = self._sched()
+
+        def bad(i, t):
+            raise RuntimeError("consumer bug")
+
+        s.submit(self._req("a", on_token=bad, max_new=1))
+        (slot, req, _), = s.poll(now=0.0)[0]
+        assert s.append_token(slot, 5) is True
+        assert req.generated == [5]
+
+
+# ---------------------------------------------------------------------------
+# Paged cache vs the static path (device programs)
+# ---------------------------------------------------------------------------
+
+class TestPagedParity:
+    def test_prefill_logits_match_full_forward(self, model):
+        """A padded-bucket prefill == the full forward's logits at the
+        last VALID prompt position, and the written blocks hold exactly
+        the contiguous cache's k/v."""
+        m, params = model
+        cfg = m.config
+        toks = _rand_prompt(1, 5, cfg.vocab_size)
+        full = np.asarray(m.forward(params, jnp.asarray([toks])))
+        cache = PagedKVCache(cfg, num_blocks=8, block_size=8)
+        pool = cache.init_pool()
+        ids = cache.allocator.alloc(1)
+        padded = np.zeros((8,), np.int32)
+        padded[:5] = toks
+        logits, pool = paged_prefill(
+            cfg, params, pool, jnp.asarray(padded), jnp.int32(5),
+            jnp.asarray(np.asarray(ids, np.int32)),
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits), full[0, 4], rtol=1e-4, atol=1e-4
+        )
+        # Cache content parity against the static path.
+        from ray_lightning_tpu.models.generate import init_kv_cache, prefill
+        ref_cache = init_kv_cache(cfg, 1, 8)
+        _, ref_cache = prefill(cfg, params, ref_cache,
+                               jnp.asarray(padded[None, :5]))
+        got_k = np.asarray(pool["k"][:, ids[0], :5])
+        np.testing.assert_allclose(
+            got_k, np.asarray(ref_cache["k"][:, 0, :5]),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_teacher_forced_decode_matches_full_forward(self, model):
+        """Feeding tokens one-by-one through the PAGED cache reproduces
+        the full forward's logits at every position — across block
+        boundaries and with the sequence's blocks deliberately
+        scattered through the pool."""
+        m, params = model
+        cfg = m.config
+        toks = np.asarray(_rand_prompt(2, 15, cfg.vocab_size))
+        full = np.asarray(m.forward(params, jnp.asarray([toks])))
+        cache = PagedKVCache(cfg, num_blocks=16, block_size=4)
+        pool = cache.init_pool()
+        # Non-contiguous physical placement: logical block i lands on
+        # physical block 2i+1.
+        phys = [1, 3, 5, 7]
+        bt = np.full((2, 4), TRASH_BLOCK, np.int32)
+        seq_lens = np.zeros((2,), np.int32)
+        for t in range(15):
+            if t % 4 == 0:
+                bt[0, t // 4] = phys[t // 4]
+            logits, pool = paged_decode_step(
+                cfg, params, pool, jnp.asarray(bt),
+                jnp.asarray(seq_lens),
+                jnp.asarray(np.array([toks[t], 0], np.int32)),
+            )
+            np.testing.assert_allclose(
+                np.asarray(logits)[0], full[0, t], rtol=1e-4, atol=1e-4
+            )
+            seq_lens[0] += 1
+
+
+# ---------------------------------------------------------------------------
+# Engine acceptance: continuous batching == isolated static decoding
+# ---------------------------------------------------------------------------
+
+class TestEngine:
+    def test_single_request_matches_generate(self, model):
+        m, params = model
+        eng = ServeEngine(m, params, ServeConfig(num_slots=2,
+                                                 block_size=8))
+        prompt = _rand_prompt(3, 7, m.config.vocab_size)
+        assert eng.generate(prompt, 9) == _ref_tokens(m, params, prompt, 9)
+
+    def test_join_on_arrival_matches_isolated(self, model):
+        """A request admitted MID-decode of another must not disturb
+        either: both match their isolated static-path rollouts."""
+        m, params = model
+        eng = ServeEngine(m, params, ServeConfig(num_slots=4,
+                                                 block_size=8))
+        p1 = _rand_prompt(4, 6, m.config.vocab_size)
+        p2 = _rand_prompt(5, 11, m.config.vocab_size)
+        h1 = eng.submit(p1, 12)
+        for _ in range(4):  # p1 alone for a few decode steps
+            eng.step()
+        h2 = eng.submit(p2, 8)  # joins the running batch
+        eng.run_until_idle()
+        assert h1.result(5) == _ref_tokens(m, params, p1, 12)
+        assert h2.result(5) == _ref_tokens(m, params, p2, 8)
+        assert eng.snapshot()["counters"]["completed"] == 2
+
+    def test_block_free_and_reuse_is_clean(self, model):
+        """After a request finishes its blocks are reused by the next
+        admission — stale cache content leaking through would corrupt
+        the successor's tokens."""
+        m, params = model
+        # 5 usable blocks: a full max_model_len sequence needs 4, so
+        # consecutive requests MUST reuse each other's blocks.
+        eng = ServeEngine(m, params, ServeConfig(
+            num_slots=1, block_size=8, num_blocks=6, max_model_len=32,
+        ))
+        for seed in (6, 7, 8):
+            prompt = _rand_prompt(seed, 9, m.config.vocab_size)
+            assert eng.generate(prompt, 12) == _ref_tokens(
+                m, params, prompt, 12
+            )
+        snap = eng.snapshot()
+        assert snap["gauges"]["blocks_free"] == 5.0
+        assert snap["counters"]["completed"] == 3
+
+    def test_steady_state_triggers_zero_recompiles(self, model):
+        """The acceptance bar: after warmup, join-on-arrival traffic of
+        mixed prompt lengths (same buckets) and evict-on-finish churn
+        must not trigger a single XLA compile (telemetry counter)."""
+        m, params = model
+        eng = ServeEngine(m, params, ServeConfig(num_slots=3,
+                                                 block_size=8))
+        # Warmup: one request per bucket the traffic will use.
+        eng.generate(_rand_prompt(9, 5, m.config.vocab_size), 4)   # b=8
+        eng.generate(_rand_prompt(10, 12, m.config.vocab_size), 4)  # b=16
+        eng.stats = ServeStats()  # count steady-state traffic only
+        before = compile_event_count()
+        for seed in range(8):
+            eng.submit(
+                _rand_prompt(20 + seed, 3 + (seed % 12), 128),
+                3 + seed % 5,
+            )
+        eng.run_until_idle()
+        assert eng.snapshot()["counters"]["completed"] == 8
+        assert compile_event_count() - before == 0
+
+    def test_preemption_under_block_exhaustion(self, model):
+        """Pool too small for two full sequences: the younger request
+        is preempted (recompute) and BOTH still match the static path
+        bitwise."""
+        m, params = model
+        eng = ServeEngine(m, params, ServeConfig(
+            num_slots=2, block_size=4, num_blocks=8, max_model_len=24,
+        ))
+        p1, p2 = [3, 1, 4, 1], [2, 7, 1]
+        h1 = eng.submit(p1, 16)
+        h2 = eng.submit(p2, 16)
+        eng.run_until_idle()
+        assert h1.result(5) == _ref_tokens(m, params, p1, 16)
+        assert h2.result(5) == _ref_tokens(m, params, p2, 16)
+        snap = eng.snapshot()
+        assert snap["counters"]["preempted"] >= 1
+        assert snap["gauges"]["blocks_free"] == 7.0  # all returned
+
+    def test_backpressure_and_deadline(self, model):
+        m, params = model
+        eng = ServeEngine(m, params, ServeConfig(
+            num_slots=1, block_size=8, max_queue=2,
+        ))
+        a = eng.submit([1, 2, 3], 4)
+        b = eng.submit([4, 5], 4)
+        c = eng.submit([6], 4)  # queue full → rejected synchronously
+        assert c.status == "rejected"
+        with pytest.raises(ServeRejected, match="rejected"):
+            c.result(1)
+        # Deadline: admit a first (freeing a queue seat), then a
+        # zero-deadline request expires while queued behind b.
+        eng.step()
+        d = eng.submit([7, 8], 4, deadline_s=0.0)
+        time.sleep(0.01)
+        eng.run_until_idle()
+        assert a.result(5) and b.result(5)
+        with pytest.raises(ServeRejected, match="expired"):
+            d.result(1)
+        counters = eng.snapshot()["counters"]
+        assert counters["rejected"] == 1 and counters["expired"] == 1
+
+    def test_submit_validates(self, model):
+        m, params = model
+        eng = ServeEngine(m, params, ServeConfig(num_slots=1,
+                                                 block_size=8))
+        with pytest.raises(ValueError, match="at least one"):
+            eng.submit([], 4)
+        with pytest.raises(ValueError, match=">= 1"):
+            eng.submit([1], 0)
+        with pytest.raises(ValueError, match="max_model_len"):
+            eng.submit([1] * 60, 10)
+        with pytest.raises(ValueError, match="vocab"):
+            eng.submit([m.config.vocab_size], 2)
+
+    def test_prompt_beyond_largest_bucket_is_typed_rejection(self, model):
+        """A non-bucket-aligned max_model_len drops the covering
+        bucket; prompts past the largest RETAINED bucket must be a
+        typed submit() rejection, never a serve-loop crash."""
+        m, params = model
+        eng = ServeEngine(m, params, ServeConfig(
+            num_slots=2, block_size=8, max_model_len=24,
+        ))
+        assert eng.max_prompt_len == 16  # buckets [8, 16]; 32 dropped
+        with pytest.raises(ValueError, match="largest prefill bucket"):
+            eng.submit(list(range(1, 18)), 1)  # 17+1 <= 24 alone passes
+        assert len(eng.generate([1, 2, 3], 2)) == 2  # loop healthy
+
+    def test_unbucketable_block_size_raises_at_build(self, model):
+        m, params = model
+        with pytest.raises(ValueError, match="no prefill bucket"):
+            ServeEngine(m, params, ServeConfig(
+                num_slots=1, block_size=32, max_model_len=16,
+            ))
+
+    def test_serve_loop_death_fails_pending_loudly(self, model):
+        """An exception escaping step() on the background thread must
+        fail every pending handle with the chained error and turn the
+        engine dead for new submits — never strand clients at their
+        timeouts."""
+        m, params = model
+        eng = ServeEngine(m, params, ServeConfig(num_slots=2,
+                                                 block_size=8))
+
+        def boom(*a, **k):
+            raise RuntimeError("injected device fault")
+
+        eng._decode_fn = boom
+        eng.start()
+        try:
+            h = eng.submit([1, 2, 3], 4)
+            with pytest.raises(RuntimeError, match="engine died"):
+                h.result(timeout=30)
+            with pytest.raises(RuntimeError, match="dead"):
+                eng.submit([1, 2, 3], 4)
+        finally:
+            eng.stop()
+
+    def test_eos_and_streaming_callback(self, model):
+        """eos stops the request early; on_token saw every token in
+        order."""
+        m, params = model
+        prompt = _rand_prompt(11, 5, m.config.vocab_size)
+        ref = _ref_tokens(m, params, prompt, 8)
+        eos = ref[3]
+        seen = []
+        eng = ServeEngine(m, params, ServeConfig(num_slots=2,
+                                                 block_size=8))
+        h = eng.submit(prompt, 8, eos_token_id=eos,
+                       on_token=lambda i, t: seen.append((i, t)))
+        eng.run_until_idle()
+        got = h.result(5)
+        # Stopped at the FIRST occurrence of eos in the reference
+        # rollout (greedy regenerates the same prefix).
+        assert got == ref[: ref.index(eos) + 1]
+        assert seen == list(enumerate(got))
+        assert h.request.done_reason == "eos"
+
+    def test_temperature_sampling_reproducible(self, model):
+        m, params = model
+        prompt = _rand_prompt(12, 6, m.config.vocab_size)
+        outs = []
+        for _ in range(2):
+            eng = ServeEngine(m, params, ServeConfig(
+                num_slots=2, block_size=8, seed=7,
+            ))
+            outs.append(eng.generate(prompt, 8, temperature=1.0))
+        assert outs[0] == outs[1]
+
+    def test_int8_engine_matches_int8_generate(self, model):
+        """The int8-storage tree through the paged path == the static
+        path fed the SAME tree (both dequant-hoisted off-TPU)."""
+        from ray_lightning_tpu.models.quant import quantize_decode_params
+
+        m, params = model
+        q8 = quantize_decode_params(params, m.config)
+        prompt = _rand_prompt(13, 6, m.config.vocab_size)
+        eng = ServeEngine(m, q8, ServeConfig(num_slots=2, block_size=8))
+        ref = generate(m, q8, jnp.asarray([prompt], jnp.int32), 7)
+        assert eng.generate(prompt, 7) == np.asarray(ref)[0, 6:].tolist()
+
+
+# ---------------------------------------------------------------------------
+# SLO stats + schema + exporters
+# ---------------------------------------------------------------------------
+
+class TestServeStats:
+    def test_percentile_nearest_rank(self):
+        assert percentile([], 50) is None
+        assert percentile([3.0], 99) == 3.0
+        vals = [float(i) for i in range(1, 101)]
+        assert percentile(vals, 50) == 50.0
+        assert percentile(vals, 99) == 99.0
+        assert percentile(vals, 0) == 1.0
+
+    def test_snapshot_is_schema_valid(self):
+        from ray_lightning_tpu.telemetry.schema import (
+            validate_serve_snapshot,
+        )
+
+        s = ServeStats()
+        s.bump("submitted", 3)
+        s.note_admitted(0.01)
+        s.note_first_token(0.02)
+        s.note_token_latency(0.004, n_tokens=2)
+        s.note_completed(0.5)
+        s.set_gauges(queue_depth=1, slots_active=1, num_slots=4,
+                     blocks_free=3, blocks_live=2, num_blocks=6)
+        snap = s.snapshot()
+        assert validate_serve_snapshot(snap) == []
+        assert snap["counters"]["tokens_out"] == 2
+        assert snap["latency"]["token"]["n"] == 2
+
+    def test_engine_snapshot_schema_and_prom_render(self, model):
+        from ray_lightning_tpu.telemetry.export_prom import (
+            render_openmetrics,
+        )
+        from ray_lightning_tpu.telemetry.schema import (
+            validate_serve_snapshot,
+        )
+
+        m, params = model
+        eng = ServeEngine(m, params, ServeConfig(num_slots=2,
+                                                 block_size=8))
+        eng.generate([1, 2, 3], 4)
+        snap = eng.snapshot()
+        assert validate_serve_snapshot(snap) == []
+        text = render_openmetrics({"serve": snap})
+        assert "rlt_serve_slots_active" in text
+        assert 'rlt_serve_requests_total{kind="completed"} 1' in text
+        assert 'rlt_serve_token_latency_ms{quantile="p50"}' in text
+
+    def test_rlt_top_renders_serve_live(self, model, tmp_path):
+        m, params = model
+        eng = ServeEngine(
+            m, params,
+            ServeConfig(num_slots=2, block_size=8, export_every_s=0.0),
+            telemetry_dir=str(tmp_path),
+        )
+        eng.generate([5, 6], 3)
+        assert (tmp_path / "serve-live.json").exists()
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__), "..", "tools",
+                          "rlt_top.py"),
+             "--once", str(tmp_path)],
+            capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "serve:" in out.stdout and "slots" in out.stdout
+
+    def test_bench_serve_block_schema(self):
+        from ray_lightning_tpu.telemetry.schema import validate_bench_serve
+
+        good = {
+            "requests_per_sec": 10.0, "p50_token_latency_ms": 5.0,
+            "p99_token_latency_ms": 9.0, "recompiles_steady_state": 0,
+            "continuous_vs_sequential": 2.0,
+            "rate_sweep": [{"offered_rps": 1.0, "requests_per_sec": 1.0,
+                            "p50_token_latency_ms": None,
+                            "p99_token_latency_ms": None}],
+        }
+        assert validate_bench_serve(good) == []
+        assert validate_bench_serve({"requests_per_sec": 1.0})
+        assert validate_bench_serve({**good, "surprise": 1})
+
+
+# ---------------------------------------------------------------------------
+# DriverQueue client plane
+# ---------------------------------------------------------------------------
+
+class TestClientPlane:
+    def test_generate_stream_and_backpressure_over_queue(self, model):
+        from ray_lightning_tpu.serve.client import ServeClient
+
+        m, params = model
+        eng = ServeEngine(m, params, ServeConfig(
+            num_slots=1, block_size=8, max_queue=2,
+        ))
+        client = ServeClient(eng.queue_handle())
+        try:
+            p1 = _rand_prompt(14, 5, m.config.vocab_size)
+            p2 = _rand_prompt(15, 4, m.config.vocab_size)
+            r1 = client.submit(p1, 6)
+            r2 = client.submit(p2, 5)
+            r3 = client.submit([1], 2)   # queue full once drained
+            # Engine not started: drain deterministically.
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and eng.step():
+                pass
+            eng.run_until_idle()
+            assert client.result(r1, 10) == _ref_tokens(m, params, p1, 6)
+            assert client.result(r2, 10) == _ref_tokens(m, params, p2, 5)
+            with pytest.raises(ServeRejected):
+                client.result(r3, 10)
+            # Streaming (engine thread drives) + invalid submission.
+            eng.start()
+            toks = list(client.stream(p1, 6, timeout=30))
+            assert toks == _ref_tokens(m, params, p1, 6)
+            with pytest.raises(ValueError, match="max_model_len"):
+                client.generate([1] * 60, 10, timeout=30)
+        finally:
+            eng.stop()
+            client.close()
+
+    def test_malformed_queue_request_gets_invalid_reply(self, model):
+        """Bad field TYPES (int(None), ...) after the reply address is
+        known must come back as serve_done(status="invalid"), not a
+        silent drop that strands the client at its timeout."""
+        from ray_lightning_tpu.cluster.queue import DriverQueue
+
+        m, params = model
+        eng = ServeEngine(m, params, ServeConfig(num_slots=1,
+                                                 block_size=8))
+        replies = DriverQueue()
+        try:
+            eng.queue_handle().put({
+                "type": "serve_request", "rid": "bad", "prompt": [1, 2],
+                "max_new_tokens": None,
+                "reply": [replies.handle.host, replies.handle.port],
+            })
+            deadline = time.monotonic() + 10
+            item = None
+            while item is None and time.monotonic() < deadline:
+                eng.step()
+                try:
+                    item = replies.get(timeout=0.2)
+                except Exception:
+                    item = None
+            assert item is not None, "no reply for the malformed request"
+            assert item["type"] == "serve_done"
+            assert item["status"] == "invalid"
+        finally:
+            replies.shutdown()
+            eng.stop()
+
+    def test_wire_items_are_schema_valid(self, model):
+        """Capture real wire traffic and pin it to the schema."""
+        from ray_lightning_tpu.telemetry.schema import (
+            validate_serve_reply, validate_serve_request,
+        )
+
+        m, params = model
+        eng = ServeEngine(m, params, ServeConfig(num_slots=1,
+                                                 block_size=8))
+        sent = []
+        orig = eng._reply
+
+        def spy(addr, item):
+            sent.append(item)
+            orig(addr, item)
+
+        eng._reply = spy
+        from ray_lightning_tpu.serve.client import ServeClient
+
+        client = ServeClient(eng.queue_handle())
+        try:
+            rid = client.submit([1, 2, 3], 3)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and eng.step():
+                pass
+            eng.run_until_idle()
+            client.result(rid, 10)
+            # The request as the engine saw it (re-build from client
+            # fields) + every reply it actually sent.
+            req_item = {
+                "type": "serve_request", "rid": rid, "prompt": [1, 2, 3],
+                "max_new_tokens": 3, "temperature": 0.0,
+                "eos_token_id": None, "deadline_s": None,
+                "reply": list(client._reply_addr),
+            }
+            assert validate_serve_request(req_item) == []
+            assert sent, "engine sent no replies"
+            for item in sent:
+                assert validate_serve_reply(item) == [], item
+        finally:
+            eng.stop()
+            client.close()
+
+
+def test_bench_serve_block_in_artifacts_gated():
+    """A drifted serve block in a committed BENCH artifact fails the
+    format.sh layer-4 gate (scan wired into check_telemetry_schema)."""
+    root = os.path.join(os.path.dirname(__file__), "..")
+    sys.path.insert(0, os.path.join(root, "tools"))
+    try:
+        import importlib
+
+        mod = importlib.import_module("check_telemetry_schema")
+        block = {"requests_per_sec": 1.0, "p50_token_latency_ms": 1.0,
+                 "p99_token_latency_ms": 2.0, "recompiles_steady_state": 0}
+        from ray_lightning_tpu.telemetry.schema import validate_bench_serve
+        assert validate_bench_serve(block) == []
+        assert mod.self_test() == []
+    finally:
+        sys.path.pop(0)

@@ -7,6 +7,9 @@ generation.
 """
 
 import os
+import pathlib
+import subprocess
+import sys
 
 import numpy as np
 import pytest
@@ -318,15 +321,17 @@ def test_concurrent_trials_overlap_and_isolate(tmp_path):
     assert analysis.best_result["marker"] == 1.0
 
 
-def test_concurrent_trials_with_real_fits(tmp_path):
-    """Two LocalStrategy fits in concurrent trial threads: jax dispatch,
-    queue-less reporting, and per-thread sessions must not cross wires."""
+def _concurrent_real_fits_body(tmp_path: str) -> None:
+    """The real-fit concurrency assertion — module-level so the
+    subprocess harness below can import and run it in a FRESH
+    interpreter."""
     analysis = tune_run(
-        lambda cfg: _train_boring(cfg, tmp_path, max_epochs=2),
+        lambda cfg: _train_boring(cfg, pathlib.Path(tmp_path),
+                                  max_epochs=2),
         config={"lr": grid_search([0.05, 0.1])},
         metric="val_loss",
         mode="min",
-        local_dir=str(tmp_path / "tune"),
+        local_dir=os.path.join(tmp_path, "tune"),
         verbose=False,
         max_concurrent_trials=2,
     )
@@ -334,6 +339,37 @@ def test_concurrent_trials_with_real_fits(tmp_path):
     for t in analysis.trials:
         assert t.status == "TERMINATED", t.error
         assert t.training_iteration == 2
+
+
+def test_concurrent_trials_with_real_fits(tmp_path):
+    """Two LocalStrategy fits in concurrent trial threads: jax dispatch,
+    queue-less reporting, and per-thread sessions must not cross wires.
+
+    QUARANTINE (round 11): run in a fresh subprocess with a hard
+    timeout.  In-process this test wedged ONLY under whole-suite state
+    (passes alone in 4s and in every subset bisected — suspicion is
+    accumulated interpreter state after ~450 tests: compile-cache
+    memory, leaked helper threads, monkeypatched globals).  A fresh
+    interpreter is the isolation, the timeout turns any recurrence into
+    a loud failure instead of a tier-1 hang."""
+    script = (
+        "import importlib.util, sys\n"
+        "spec = importlib.util.spec_from_file_location('t', sys.argv[1])\n"
+        "mod = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(mod)\n"
+        "mod._concurrent_real_fits_body(sys.argv[2])\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", script, os.path.abspath(__file__),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=180, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, (
+        f"concurrent-trials subprocess failed (rc={proc.returncode})\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    )
 
 
 def test_pbt_restore_path_resolves_directory_checkpoints(tmp_path):

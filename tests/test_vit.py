@@ -37,6 +37,7 @@ def make_trainer(**kw):
     return Trainer(**kw)
 
 
+@pytest.mark.slow  # tier-1 diet (round 11): see pytest.ini 'slow'
 def test_vit_trains_and_converges():
     tr = make_trainer(max_epochs=3)
     tr.fit(tiny_vit(), make_data())
@@ -93,6 +94,7 @@ def test_vit_bf16_remat_forward_finite():
     assert np.all(np.isfinite(np.asarray(logits)))
 
 
+@pytest.mark.slow  # tier-1 diet (round 11): see pytest.ini 'slow'
 def test_vit_checkpoint_roundtrip(tmp_path):
     """Fit → checkpoint → resume on a fresh trainer: the resumed epoch
     continues from the saved weights (≙ reference load_test,

@@ -31,9 +31,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from ray_lightning_tpu.telemetry.schema import (  # noqa: E402
     validate_bench_fault,
     validate_bench_host_overhead,
+    validate_bench_serve,
     validate_bench_telemetry,
     validate_chrome_trace,
     validate_flight_bundle,
+    validate_serve_reply,
+    validate_serve_request,
+    validate_serve_snapshot,
     validate_span_jsonl,
     validate_stream_item,
 )
@@ -138,6 +142,76 @@ def _self_test_live_plane(tmp: str) -> list:
                 json.load(f), "self-test bundle"
             )
     problems += _self_test_host_overhead()
+    problems += _self_test_serve()
+    return problems
+
+
+def _self_test_serve() -> list:
+    """Serving-plane producers vs their schema: the REAL ServeStats
+    engine's snapshot, the client's wire items, and the bench_serve
+    block shape — plus negative cases so a drifted validator can't
+    silently accept anything."""
+    from ray_lightning_tpu.serve.metrics import ServeStats
+
+    stats = ServeStats()
+    stats.bump("submitted")
+    stats.note_admitted(0.01)
+    stats.note_first_token(0.05)
+    stats.note_token_latency(0.004, n_tokens=3)
+    stats.note_completed(0.2)
+    stats.set_gauges(queue_depth=0, slots_active=1, num_slots=8,
+                     blocks_free=30, blocks_live=2, num_blocks=33)
+    problems = validate_serve_snapshot(
+        stats.snapshot(), "self-test serve snapshot"
+    )
+    problems += validate_serve_request(
+        {
+            "type": "serve_request", "rid": "abc", "prompt": [1, 2],
+            "max_new_tokens": 4, "temperature": 0.0,
+            "eos_token_id": None, "deadline_s": 0.5,
+            "reply": ["127.0.0.1", 12345],
+        },
+        "self-test serve request",
+    )
+    problems += validate_serve_reply(
+        {"type": "serve_token", "rid": "abc", "index": 0, "token": 7},
+        "self-test serve token",
+    )
+    problems += validate_serve_reply(
+        {"type": "serve_done", "rid": "abc", "status": "finished",
+         "reason": "length", "tokens": [7, 9]},
+        "self-test serve done",
+    )
+    problems += validate_bench_serve(
+        {
+            "requests_per_sec": 12.5,
+            "tokens_per_sec": 200.0,
+            "p50_token_latency_ms": 8.0,
+            "p99_token_latency_ms": 21.0,
+            "p50_ttft_ms": 30.0,
+            "p99_ttft_ms": 80.0,
+            "recompiles_steady_state": 0,
+            "continuous_vs_sequential": 2.1,
+            "sequential_requests_per_sec": 6.0,
+            "num_slots": 8, "block_size": 16, "num_blocks": 33,
+            "completed": 64, "preempted": 0, "rejected": 0, "expired": 0,
+            "rate_sweep": [{
+                "offered_rps": 4.0, "requests_per_sec": 3.9,
+                "p50_token_latency_ms": 9.0,
+                "p99_token_latency_ms": 30.0, "completed": 16,
+            }],
+        },
+        "self-test bench serve",
+    )
+    if not validate_bench_serve({"requests_per_sec": 1.0}):
+        problems.append(
+            "self-test bench serve: validator accepted a block missing "
+            "the latency percentiles"
+        )
+    if not validate_serve_reply({"type": "serve_weird", "rid": "x"}):
+        problems.append(
+            "self-test serve reply: validator accepted an unknown type"
+        )
     return problems
 
 
@@ -206,6 +280,9 @@ def scan_bench_files() -> list:
             problems += validate_bench_host_overhead(
                 host, f"{name}:host_overhead"
             )
+        serve = doc.get("serve")
+        if serve is not None:  # pre-serving rounds lack it
+            problems += validate_bench_serve(serve, f"{name}:serve")
     return problems
 
 

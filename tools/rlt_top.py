@@ -75,6 +75,9 @@ def load_snapshot(path: str) -> Optional[Dict[str, Any]]:
         live = os.path.join(path, "live.json")
         if os.path.exists(live):
             return _load_live_json(live)
+        serve = os.path.join(path, "serve-live.json")
+        if os.path.exists(serve):
+            return _load_live_json(serve)
         return _load_beats_jsonl(
             sorted(glob.glob(os.path.join(path, "heartbeats-rank*.jsonl")))
         )
@@ -93,11 +96,39 @@ def _fmt(value: Any, width: int) -> str:
     return text[:width].rjust(width)
 
 
+def _render_serve(serve: Dict[str, Any]) -> list:
+    """The serving pane (``serve-live.json`` / engine snapshots):
+    queue/slot/block occupancy and the SLO latency percentiles."""
+    g = serve.get("gauges", {})
+    c = serve.get("counters", {})
+    lines = [
+        "",
+        f"serve: queue {g.get('queue_depth', 0):.0f}  slots "
+        f"{g.get('slots_active', 0):.0f}/{g.get('num_slots', 0):.0f}  "
+        f"blocks {g.get('blocks_live', 0):.0f}/{g.get('num_blocks', 0):.0f}"
+        f"  done {c.get('completed', 0)}  rej {c.get('rejected', 0)}"
+        f"  preempt {c.get('preempted', 0)}",
+    ]
+    latency = serve.get("latency", {})
+    if latency:
+        lines.append(
+            "         " + "  ".join(
+                f"{family} p50/p99 "
+                f"{s.get('p50_ms', 0):.1f}/{s.get('p99_ms', 0):.1f}ms"
+                for family, s in sorted(latency.items())
+            )
+        )
+    return lines
+
+
 def render(snapshot: Optional[Dict[str, Any]], source: str) -> str:
     """One text frame (pure function — tested directly)."""
     stamp = time.strftime("%H:%M:%S")
     if not snapshot:
         return f"rlt_top {stamp} — no live data at {source} (yet?)\n"
+    if "serve" in snapshot and "ranks" not in snapshot:
+        return (f"rlt_top {stamp} — serving engine\n"
+                + "\n".join(_render_serve(snapshot["serve"])) + "\n")
     lines = [
         f"rlt_top {stamp} — {snapshot.get('ranks_reporting', 0)} rank(s), "
         f"{snapshot.get('beats', 0)} beats"
@@ -119,6 +150,8 @@ def render(snapshot: Optional[Dict[str, Any]], source: str) -> str:
             + "  " + str(b.get("phase", "-"))[:10].ljust(10)
             + "  " + str(b.get("status", "-"))
         )
+    if snapshot.get("serve"):
+        lines += _render_serve(snapshot["serve"])
     events = snapshot.get("events") or []
     if events:
         lines += ["", "recent events:"]
