@@ -450,6 +450,93 @@ def _kernel_paths(cfg: GPTConfig, on_tpu: bool) -> dict:
     return out
 
 
+def _bench_mpmd(on_tpu: bool) -> dict:
+    """The ``--mpmd`` A/B arm (schema: ``validate_bench_mpmd``): a
+    2-stage mesh-of-meshes fit (in-process harness — same StageRunner
+    code path the actor plane drives, minus spawn cost) vs the
+    single-mesh SPMD GPipe formulation of the SAME model, plus the
+    GPipe-vs-interleaved-1F1B bubble decomposition at measured per-op
+    costs (docs/PERFORMANCE.md "Pipeline bubbles")."""
+    from ray_lightning_tpu.models.gpt import GPTConfig as _Cfg
+    from ray_lightning_tpu.mpmd.inproc import run_inproc_pipeline_fit
+    from ray_lightning_tpu.mpmd.plan import _gpt_untie, gpt_mpmd_spec
+    from ray_lightning_tpu.mpmd.reference import gpipe_reference_fit
+    from ray_lightning_tpu.mpmd.schedule import (
+        fleet_pipeline_stats,
+        measured_schedule_bubble,
+        pool_op_costs,
+    )
+
+    cfg = _Cfg(vocab_size=256, n_layer=4, n_head=4, d_model=64,
+               seq_len=64, warmup_steps=2)
+    module = GPT(cfg, attn_impl="xla")
+    module.precision = "f32"
+    spec = gpt_mpmd_spec(module)
+    full = _gpt_untie(module.init_params(jax.random.PRNGKey(0)))
+    steps, bsz, n_micro, interleave = 5, 16, 8, 2
+    rng = np.random.default_rng(11)
+    data = [
+        {"tokens": rng.integers(
+            0, cfg.vocab_size, (bsz, cfg.seq_len + 1)).astype(np.int32)}
+        for _ in range(steps)
+    ]
+    devices = jax.devices()
+    groups = [devices[0:2], devices[2:4]] if len(devices) >= 4 else None
+    tokens_per_step = bsz * cfg.seq_len
+
+    arms = {}
+    for name, v in (("gpipe", 1), ("1f1b", interleave)):
+        res = run_inproc_pipeline_fit(
+            spec, full, spec.tx_factory, lambda s: data[s], steps,
+            n_workers=2, n_micro=n_micro, schedule=name, interleave=v,
+            device_groups=groups,
+        )
+        costs = pool_op_costs(res["op_costs"])
+        loss_stats = res["step_summaries"][-1][1:]  # loss worker, warm
+        wall = sum(s["wall_s"] for s in loss_stats)
+        arms[name] = {
+            "res": res,
+            "costs": costs,
+            "bubble": measured_schedule_bubble(name, 2, n_micro, v, costs),
+            "tps": tokens_per_step * len(loss_stats) / max(wall, 1e-9),
+        }
+
+    # Single-mesh SPMD GPipe reference: warm the compile, then time.
+    ref_devices = devices[:2]
+    gpipe_reference_fit(spec, full, spec.tx_factory(),
+                        lambda s: data[s], 1, 2, n_micro,
+                        devices=ref_devices)
+    t0 = time.perf_counter()
+    ref = gpipe_reference_fit(spec, full, spec.tx_factory(),
+                              lambda s: data[s], steps, 2, n_micro,
+                              devices=ref_devices)
+    ref_wall = time.perf_counter() - t0
+    ref_tps = tokens_per_step * steps / max(ref_wall, 1e-9)
+
+    head = arms["1f1b"]
+    parity = float(np.max(np.abs(
+        np.asarray(head["res"]["losses"]) - np.asarray(ref["losses"])
+    )))
+    fleet = fleet_pipeline_stats(head["res"]["per_stage_stats"])
+    return {
+        "schedule": "1f1b",
+        "interleave": interleave,
+        "n_stages": 2,
+        "n_micro": n_micro,
+        "bubble_fraction": round(head["bubble"], 4),
+        "gpipe_bubble_fraction": round(arms["gpipe"]["bubble"], 4),
+        "stage_occupancy": round(fleet["stage_occupancy"], 4),
+        "stage_skew_ms": round(fleet["stage_skew_ms"], 3),
+        "tokens_per_sec": round(head["tps"], 1),
+        "single_mesh_tokens_per_sec": round(ref_tps, 1),
+        "vs_single_mesh": round(head["tps"] / max(ref_tps, 1e-9), 3),
+        "loss_parity_max_diff": parity,
+        "op_costs_ms": {
+            k: round(v * 1e3, 3) for k, v in head["costs"].items()
+        },
+    }
+
+
 def _detect_backend() -> str:
     """Resolve the backend, degrading to CPU if the TPU runtime is
     unreachable (tunnel/service outage) — the harness must always get a
@@ -517,6 +604,12 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - same discipline
         sys.stderr.write(f"fault probes skipped: {e}\n")
         fault_block = None
+    mpmd_block = None
+    if "--mpmd" in sys.argv[1:]:
+        try:
+            mpmd_block = _bench_mpmd(on_tpu)
+        except Exception as e:  # noqa: BLE001 - same discipline
+            sys.stderr.write(f"mpmd probes skipped: {e}\n")
 
     peak = peak_flops_per_chip() if on_tpu else None
 
@@ -576,6 +669,10 @@ def main() -> None:
         # megastep on/off A/B (docs/PERFORMANCE.md "Host dispatch &
         # megastep").
         "host_overhead": host_overhead,
+        # MPMD pipeline A/B (--mpmd; schema-gated): mesh-of-meshes
+        # tokens/sec vs the single-mesh GPipe formulation + the
+        # GPipe-vs-interleaved-1F1B bubble decomposition.
+        **({"mpmd": mpmd_block} if mpmd_block is not None else {}),
         "windows": WINDOWS,
         "window_steps": WINDOW_STEPS,
         "bottleneck": "attention bwd kernel + scan residual-save HBM "

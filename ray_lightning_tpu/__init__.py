@@ -42,6 +42,7 @@ __all__ = [
     "RayStrategy",
     "HorovodRayStrategy",
     "RayShardedStrategy",
+    "MpmdStrategy",
     "RayPlugin",
     "HorovodRayPlugin",
     "RayShardedPlugin",
@@ -67,6 +68,7 @@ _STRATEGY_NAMES = (
     "RayStrategy",
     "HorovodRayStrategy",
     "RayShardedStrategy",
+    "MpmdStrategy",
     "RayPlugin",
     "HorovodRayPlugin",
     "RayShardedPlugin",

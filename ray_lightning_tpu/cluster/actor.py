@@ -626,6 +626,17 @@ class ProcessActor:
             self._conn.close()
         except OSError:
             pass
+        # Reclaim tmpfs the dead child may have leaked: a stage worker
+        # killed mid-transfer leaves rlt-seg segments whose owner pid is
+        # gone — sweeping at every kill keeps /dev/shm bounded even for
+        # crash-looping fleets (the next SegmentStore() would sweep too,
+        # but only if one is ever created again).
+        try:
+            from ray_lightning_tpu.cluster.shm import sweep_stale_segments
+
+            sweep_stale_segments()
+        except Exception:  # noqa: BLE001 - janitorial, never raises out
+            pass
 
 
 if __name__ == "__main__":

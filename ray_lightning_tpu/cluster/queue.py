@@ -34,14 +34,38 @@ __all__ = ["DriverQueue", "QueueHandle"]
 # path, which the caller's reconnect retry handles.
 _ACK_TIMEOUT_S = 60.0
 # The frame send gets a size-scaled budget instead: checkpoint thunks
-# can be GBs, and a Python socket timeout caps sendall's TOTAL duration
-# — a fixed 60s would hard-fail any payload needing longer on a slow
-# inter-host link.  Budget assumes worst-case ~1 MiB/s sustained.
+# and MPMD activations can be GBs/multi-MB, and a Python socket timeout
+# caps sendall's TOTAL duration — a fixed 60s would hard-fail any
+# payload needing longer on a slow inter-host link.  Budget assumes
+# worst-case ~1 MiB/s sustained.
 _MIN_SEND_THROUGHPUT = 1 << 20  # bytes/s
+# Frames above this are sent in chunks with a PER-CHUNK timeout: one
+# slow multi-MB activation then can't trip a whole-frame budget — as
+# long as each ~8MB chunk makes progress inside its own budget, the
+# send succeeds no matter how long the total takes (the MPMD transfer
+# lane's DCN contract).
+_SEND_CHUNK_BYTES = 8 << 20
 
 
 def _send_timeout_s(payload_bytes: int) -> float:
+    """Size-scaled socket budget.  Applied to every slow half of a
+    ``put``: connect (SYN retry storms on a congested DCN hop scale
+    with load too), each send chunk, and the post-send ack drain (the
+    server acks only after the full frame is read AND enqueued — for a
+    multi-MB payload that read itself takes payload/throughput)."""
     return max(_ACK_TIMEOUT_S, payload_bytes / _MIN_SEND_THROUGHPUT)
+
+
+def _sendall_chunked(sock: socket.socket, payload: bytes,
+                     chunk_bytes: int = _SEND_CHUNK_BYTES) -> None:
+    """``sendall`` in ``chunk_bytes`` slices, re-arming the size-scaled
+    timeout per slice — total duration is unbounded, per-slice progress
+    is not."""
+    view = memoryview(payload)
+    for off in range(0, len(view), chunk_bytes):
+        chunk = view[off:off + chunk_bytes]
+        sock.settimeout(_send_timeout_s(len(chunk)))
+        sock.sendall(chunk)
 
 
 class QueueHandle:
@@ -71,9 +95,11 @@ class QueueHandle:
         self._client_id = uuid.uuid4().hex
         self._seq = 0
 
-    def _connect(self) -> socket.socket:
+    def _connect(self, timeout: float = 60.0) -> socket.socket:
         if self._sock is None:
-            s = socket.create_connection((self.host, self.port), timeout=60)
+            s = socket.create_connection(
+                (self.host, self.port), timeout=timeout
+            )
             s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             self._sock = s
         return self._sock
@@ -117,11 +143,19 @@ class QueueHandle:
                 self._put_once(payload)
 
     def _put_once(self, payload: bytes) -> None:
-        sock = self._connect()
+        budget = _send_timeout_s(len(payload))
+        # Connect under the size-scaled budget too: a congested DCN hop
+        # that throttles the payload also drops SYNs, and a 60s cap
+        # would give up on exactly the links the scaling exists for.
+        sock = self._connect(timeout=budget)
         try:
-            sock.settimeout(_send_timeout_s(len(payload)))
-            rpc.send_frame(sock, payload)
-            sock.settimeout(_ACK_TIMEOUT_S)
+            sock.settimeout(budget)
+            # Length prefix, then the payload in per-timeout chunks.
+            sock.sendall(rpc.FRAME_HEADER.pack(len(payload)))
+            _sendall_chunked(sock, payload)
+            # The ack drains only after the server has READ the whole
+            # frame off its socket — scale the wait with the payload.
+            sock.settimeout(budget)
             ack = sock.recv(1)
         except Exception:
             # The frame may be half-sent or its ack still in flight; the

@@ -24,6 +24,9 @@ from typing import Any
 import cloudpickle
 
 _LEN = struct.Struct("!Q")
+# Public alias: callers that stream a frame in pieces (the queue's
+# chunked sender) must emit the exact same header this module parses.
+FRAME_HEADER = _LEN
 
 
 def dumps(obj: Any) -> bytes:

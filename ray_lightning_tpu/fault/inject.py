@@ -30,6 +30,14 @@ Grammar (``RLT_FAULT``)::
     keys:  point — injection point name (default "step"):
                    spawn | step | queue_put | ckpt_write | meta_write
            rank  — only this global rank (default: any)
+           stage — alias for ``rank`` on the MPMD pipeline plane: the
+                   stage WORKER index (= actor rank; under
+                   ``interleave=v`` worker ``p`` hosts the virtual
+                   stages ``{c*P+p}``, which cannot be pinned
+                   individually — they share a process).
+                   ``crash@stage:1,step:3`` kills stage worker 1's
+                   actor at optimizer step 3 (the stage-kill recovery
+                   acceptance pin)
            step  — only this micro-step (``step`` point only)
            epoch — only this epoch
            nth   — only the Nth matching occurrence (1-based; counted
@@ -153,6 +161,10 @@ def parse_faults(value: str) -> List[FaultSpec]:
                     kw["point"] = val
                 elif key in ("rank", "step", "epoch", "nth"):
                     kw[key] = int(val)
+                elif key == "stage":
+                    # MPMD alias: a stage worker's process rank IS its
+                    # stage index (StageRunner fires with rank=stage).
+                    kw["rank"] = int(val)
                 elif key == "secs":
                     kw[key] = float(val)
                 elif key == "once":

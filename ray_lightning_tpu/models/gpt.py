@@ -37,7 +37,8 @@ from ray_lightning_tpu.core.module import TpuModule
 from ray_lightning_tpu.ops import causal_attention
 
 __all__ = ["GPTConfig", "GPT", "SyntheticLMDataModule", "make_block_stage",
-           "merge_lora", "add_lora_adapters", "has_lora_adapters"]
+           "gpt_adamw", "merge_lora", "add_lora_adapters",
+           "has_lora_adapters"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -566,18 +567,7 @@ class GPT(TpuModule):
 
     def configure_optimizers(self):
         cfg = self.config
-        schedule = optax.warmup_cosine_decay_schedule(
-            0.0, cfg.lr, cfg.warmup_steps, max(10 * cfg.warmup_steps, 1000)
-        )
-        from ray_lightning_tpu.models.optim import decay_mask
-
-        # Decay matrices only (nanoGPT-style ndim rule): LN params and
-        # biases are exempt; decay_mask is aware of the stacked-blocks
-        # leading layer dim, so per-block biases/LN stay exempt too.
-        adamw = optax.adamw(schedule, b1=0.9, b2=0.95,
-                            weight_decay=cfg.weight_decay,
-                            mask=decay_mask,
-                            mu_dtype=jnp.dtype(cfg.mu_dtype))
+        adamw = gpt_adamw(cfg)
         if cfg.lora_rank > 0:
             # LoRA: only adapter params train.  The frozen base gets
             # set_to_zero (no Adam moments allocated for it — under
@@ -607,6 +597,28 @@ class GPT(TpuModule):
             )
         tx = optax.chain(optax.clip_by_global_norm(1.0), adamw)
         return tx
+
+
+def gpt_adamw(cfg: GPTConfig):
+    """The family's scheduled+masked AdamW WITHOUT the global-norm
+    clip.  Factored out for the MPMD pipeline plane: ``adamw`` is
+    elementwise, so per-stage application equals the single-program
+    fit exactly, whereas ``clip_by_global_norm`` couples leaves ACROSS
+    stages and does not decompose — the MPMD GPT adapter
+    (``mpmd/plan.py``) uses this as its per-stage optimizer and its
+    parity reference uses the same (docs/ARCHITECTURE.md round 12)."""
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, cfg.lr, cfg.warmup_steps, max(10 * cfg.warmup_steps, 1000)
+    )
+    from ray_lightning_tpu.models.optim import decay_mask
+
+    # Decay matrices only (nanoGPT-style naming rule): LN params and
+    # biases are exempt; decay_mask is aware of the stacked-blocks
+    # leading layer dim, so per-block biases/LN stay exempt too.
+    return optax.adamw(schedule, b1=0.9, b2=0.95,
+                       weight_decay=cfg.weight_decay,
+                       mask=decay_mask,
+                       mu_dtype=jnp.dtype(cfg.mu_dtype))
 
 
 def has_lora_adapters(params: Dict[str, Any]) -> bool:

@@ -1,0 +1,66 @@
+"""MPMD pipeline parallelism: mesh-of-meshes stages over the DCN queue
+plane.
+
+The SPMD GPipe flavor (:mod:`ray_lightning_tpu.parallel.pipeline`) keeps
+every stage inside ONE jitted program on ONE mesh — it cannot scale past
+a single pod.  This package implements the JaxPP-shaped alternative
+(PAPERS.md "Scaling Deep Learning Training with MPMD Pipeline
+Parallelism"): each pipeline stage is a **separately compiled program on
+its own mesh** inside its own :class:`~..cluster.actor.ProcessActor`,
+stages exchange activations/activation-gradients over an explicit
+transfer lane (shared-memory segments same-host, TCP queues across DCN),
+and a per-stage instruction stream (GPipe or 1F1B) schedules
+FWD/BWD/SEND/RECV/UPDATE.
+
+Modules:
+
+* :mod:`.plan` — :class:`StagePlan` (contiguous layer split) and
+  :class:`MpmdSpec` (the model-decomposition contract + GPT adapter);
+* :mod:`.schedule` — instruction streams, validation/simulation, and
+  the ``bubble_fraction`` / ``stage_occupancy`` accounting;
+* :mod:`.transfer` — the inter-stage data lane (double-buffered recv);
+* :mod:`.stage` — :class:`StageRunner`, the per-stage executor (runs
+  in-process for tests, inside an actor for real fits);
+* :mod:`.worker` — the actor-side entry point + checkpoint discovery;
+* :mod:`.reference` — the single-mesh SPMD GPipe reference fit the
+  MPMD plane is parity-gated against.
+
+The user-facing driver is
+:class:`ray_lightning_tpu.parallel.strategies.MpmdStrategy`.
+"""
+
+from ray_lightning_tpu.mpmd.plan import (  # noqa: F401
+    MpmdSpec,
+    StagePlan,
+    gpt_mpmd_spec,
+    resolve_mpmd_spec,
+)
+from ray_lightning_tpu.mpmd.schedule import (  # noqa: F401
+    Instr,
+    build_schedule,
+    build_streams,
+    bubble_from_timeline,
+    fleet_pipeline_stats,
+    gpipe_schedule,
+    interleaved_streams,
+    one_f_one_b_schedule,
+    simulate_streams,
+    validate_streams,
+)
+
+__all__ = [
+    "MpmdSpec",
+    "StagePlan",
+    "gpt_mpmd_spec",
+    "resolve_mpmd_spec",
+    "Instr",
+    "build_schedule",
+    "build_streams",
+    "interleaved_streams",
+    "gpipe_schedule",
+    "one_f_one_b_schedule",
+    "validate_streams",
+    "simulate_streams",
+    "bubble_from_timeline",
+    "fleet_pipeline_stats",
+]

@@ -1,0 +1,150 @@
+"""In-process MPMD pipeline: every stage worker a thread, one
+interpreter.
+
+The mesh-of-meshes execution plane without the actor plane: worker
+``p`` gets its own device subset (``jax.devices()`` sliced into
+disjoint groups), its own :class:`~.stage.StageRunner` with separately
+compiled programs, and a :class:`~.transfer.LocalChannel` transport
+along the worker ring.  Because the runners are transport-agnostic
+this is the SAME code path the actor plane drives — only the wire
+differs — which makes it the fast parity harness for tests and the
+``dryrun_multichip`` mpmd flavor (4 virtual CPU devices → 2 stages ×
+2-device meshes, no subprocess spawn).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_lightning_tpu.mpmd.plan import MpmdSpec, StagePlan
+from ray_lightning_tpu.mpmd.stage import StageRunner
+from ray_lightning_tpu.mpmd.transfer import LocalChannel, Mailbox
+
+__all__ = ["split_micro_batches", "run_inproc_pipeline_fit"]
+
+
+def split_micro_batches(batch: Any, n_micro: int) -> List[Any]:
+    """Row-split one full batch pytree into ``n_micro`` equal
+    micro-batches (leading axis; ragged counts are a loud error — a
+    silently smaller last micro-batch would break mean-of-means grad
+    parity)."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(batch)
+    if not leaves:
+        raise ValueError("empty batch")
+    rows = leaves[0].shape[0]
+    if rows % n_micro:
+        raise ValueError(
+            f"batch of {rows} rows not divisible into {n_micro} "
+            "micro-batches"
+        )
+    mb = rows // n_micro
+    return [
+        jax.tree_util.tree_map(
+            lambda a, i=i: a[i * mb:(i + 1) * mb], batch
+        )
+        for i in range(n_micro)
+    ]
+
+
+def run_inproc_pipeline_fit(
+    spec: MpmdSpec,
+    full_params: Any,
+    tx_factory: Callable[[], Any],
+    batches: Callable[[int], Any],
+    steps: int,
+    n_workers: int,
+    n_micro: int,
+    schedule: str = "1f1b",
+    interleave: int = 1,
+    device_groups: Optional[List[list]] = None,
+    recv_timeout_s: float = 120.0,
+) -> Dict[str, Any]:
+    """Run a full MPMD fit with stage workers as threads; returns
+    per-step losses (loss worker), per-worker steady-state stats, and
+    the reassembled final params."""
+    import jax
+
+    plan = StagePlan.split(spec.n_layers, n_workers * interleave)
+    if device_groups is not None and len(device_groups) != n_workers:
+        raise ValueError(
+            f"{len(device_groups)} device groups for {n_workers} workers"
+        )
+
+    meshes: List[Any] = []
+    for p in range(n_workers):
+        if device_groups is None:
+            meshes.append(None)
+        else:
+            import numpy as np
+            from jax.sharding import Mesh
+
+            meshes.append(
+                Mesh(np.asarray(device_groups[p]), ("data",))
+            )
+
+    mailboxes = [Mailbox() for _ in range(n_workers)]
+    runners: List[StageRunner] = []
+    for p in range(n_workers):
+        runners.append(StageRunner(
+            spec, plan, p, n_workers, schedule, n_micro, tx_factory(),
+            interleave=interleave,
+            mesh=meshes[p],
+            mailbox=mailboxes[p],
+            send_next=LocalChannel(mailboxes[(p + 1) % n_workers]),
+            send_prev=LocalChannel(mailboxes[(p - 1) % n_workers]),
+            recv_timeout_s=recv_timeout_s,
+        ))
+        runners[p].init_state(full_params)
+
+    # Pre-split every step's micro-batches once so the embed and loss
+    # workers consume identical rows without re-invoking the source.
+    step_micro = {
+        s: split_micro_batches(batches(s), n_micro) for s in range(steps)
+    }
+
+    errors: List[BaseException] = []
+    lock = threading.Lock()
+
+    def drive(runner: StageRunner) -> None:
+        try:
+            runner.run_fit(
+                steps,
+                lambda s: step_micro[s] if runner.needs_batches else None,
+            )
+        except BaseException as e:  # noqa: BLE001 - joined below
+            with lock:
+                errors.append(e)
+            # Unblock peers waiting on this worker's sends.
+            for box in mailboxes:
+                box.fail(e)
+
+    threads = [
+        threading.Thread(
+            target=drive, args=(r,), name=f"rlt-mpmd-w{r.worker}"
+        )
+        for r in runners
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+
+    # Reassemble: global stage g lives on worker g % P as chunk g // P.
+    parts = [
+        runners[g % n_workers].chunk_params_host()[g // n_workers]
+        for g in range(plan.n_stages)
+    ]
+    loss_worker = runners[(plan.n_stages - 1) % n_workers]
+    return {
+        "losses": loss_worker.losses,
+        "per_stage_stats": [r.fit_stats() for r in runners],
+        "step_summaries": [r.step_summaries for r in runners],
+        "op_costs": [r.op_costs() for r in runners],
+        "params": spec.assemble_params(parts, plan),
+        "final_step": int(jax.device_get(loss_worker.state.step)),
+    }

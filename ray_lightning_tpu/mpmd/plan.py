@@ -1,0 +1,240 @@
+"""Stage planning: how a stacked-layer model splits into MPMD stages.
+
+Two contracts live here:
+
+* :class:`StagePlan` — WHERE the model splits: ``P`` contiguous runs of
+  the stacked ``(L, ...)`` layer axis, reusing the layer-axis split math
+  of the SPMD pipeline (:func:`..parallel.pipeline.layer_splits`) so the
+  two pipeline flavors agree on stage boundaries by construction.
+  Non-divisible layer counts balance the remainder onto the earliest
+  stages.
+
+* :class:`MpmdSpec` — HOW one stage computes: the model decomposed into
+  ``embed_fn`` (prologue: raw batch → first activations, stage 0 only),
+  ``stage_fn`` (a contiguous run of stacked layers — the SAME signature
+  :func:`..parallel.pipeline.pipeline_apply` uses), and ``loss_fn``
+  (epilogue: last activations + batch → ``(loss, logs)``, last stage
+  only), plus the param split/assemble pair.  Everything is a pure
+  function of ``(params, ...)`` so each stage can jit its own programs.
+
+Optimizer note: each stage applies the module's optax transformation to
+ITS param shard only.  Elementwise transforms (sgd/adam/adamw + masks /
+schedules) then update identically to a single-program fit; transforms
+that couple leaves ACROSS stages (global-norm clipping) do not decompose
+— pass a per-stage-safe ``tx`` for exact parity (docs/ARCHITECTURE.md
+round 12).
+
+Tied embeddings: pipelining splits the first and last stage into
+different programs, so a weight shared between the embedding and the LM
+head would need a cross-stage gradient reduction every step.  The GPT
+adapter UNTIES instead: the last stage gets its own ``head_w``
+initialized from ``wte`` (standard MPMD practice; the reference fit in
+:mod:`.reference` unties identically so parity is apples-to-apples).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_lightning_tpu.parallel.pipeline import layer_splits
+
+__all__ = ["StagePlan", "MpmdSpec", "gpt_mpmd_spec", "resolve_mpmd_spec"]
+
+
+@dataclasses.dataclass(frozen=True)
+class StagePlan:
+    """``P`` contiguous stages over an ``n_layers``-deep stacked model."""
+
+    n_layers: int
+    n_stages: int
+    boundaries: Tuple[int, ...]
+
+    @classmethod
+    def split(cls, n_layers: int, n_stages: int) -> "StagePlan":
+        return cls(
+            n_layers=n_layers,
+            n_stages=n_stages,
+            boundaries=layer_splits(n_layers, n_stages),
+        )
+
+    def stage_bounds(self, stage: int) -> Tuple[int, int]:
+        """Layer interval ``[start, stop)`` owned by ``stage``."""
+        if not 0 <= stage < self.n_stages:
+            raise ValueError(
+                f"stage {stage} out of range for {self.n_stages} stages"
+            )
+        return self.boundaries[stage], self.boundaries[stage + 1]
+
+    def stage_layers(self, stage: int) -> int:
+        start, stop = self.stage_bounds(stage)
+        return stop - start
+
+    def is_first(self, stage: int) -> bool:
+        return stage == 0
+
+    def is_last(self, stage: int) -> bool:
+        return stage == self.n_stages - 1
+
+    def slice_stacked(self, stacked: Any, stage: int) -> Any:
+        """Slice every leaf of a stacked ``(L, ...)`` pytree to this
+        stage's layer run."""
+        import jax
+
+        start, stop = self.stage_bounds(stage)
+        return jax.tree_util.tree_map(lambda a: a[start:stop], stacked)
+
+
+@dataclasses.dataclass
+class MpmdSpec:
+    """Model-decomposition contract for the MPMD pipeline plane.
+
+    ``embed_fn(stage0_params, batch) -> x0`` · ``stage_fn(blocks, x) ->
+    x`` · ``loss_fn(last_params, x, batch) -> (loss, logs)``.  Per-stage
+    param pytrees come from ``split_params(full_params, plan, stage)``
+    and reassemble with ``assemble_params(stage_params_list, plan)``.
+    """
+
+    n_layers: int
+    embed_fn: Callable[[Any, Any], Any]
+    stage_fn: Callable[[Any, Any], Any]
+    loss_fn: Callable[[Any, Any, Any], Tuple[Any, Dict[str, Any]]]
+    split_params: Callable[[Any, StagePlan, int], Any]
+    assemble_params: Callable[[List[Any], StagePlan], Any]
+    # Optional per-stage optimizer factory; None = the module's
+    # configure_optimizers() applied per stage (see the module docstring
+    # for the cross-stage-coupling caveat).
+    tx_factory: Optional[Callable[[], Any]] = None
+
+
+def _gpt_untie(full_params: Dict[str, Any]) -> Dict[str, Any]:
+    """Add the untied LM head (``head_w`` := ``wte``) when absent."""
+    if "head_w" in full_params:
+        return full_params
+    out = dict(full_params)
+    out["head_w"] = full_params["wte"]
+    return out
+
+
+def gpt_mpmd_spec(module, compute_dtype=None) -> MpmdSpec:
+    """Decompose a dense :class:`~..models.gpt.GPT` module into MPMD
+    stages: ``wte``/``wpe`` embedding prologue on stage 0, the
+    :func:`~..models.gpt.make_block_stage` trunk per stage, and the
+    ``ln_f`` + untied-LM-head cross-entropy epilogue on the last stage.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models.gpt import (
+        _layer_norm,
+        gpt_adamw,
+        make_block_stage,
+    )
+
+    cfg = module.config
+    if compute_dtype is None:
+        compute_dtype = (
+            jnp.bfloat16 if module.precision in ("bf16", "bfloat16")
+            else jnp.float32
+        )
+    stage_fn = make_block_stage(cfg, compute_dtype=compute_dtype)
+
+    def embed_fn(params, batch):
+        tokens = batch["tokens"][:, :-1]
+        t = tokens.shape[1]
+        return (params["wte"][tokens] + params["wpe"][:t]).astype(
+            compute_dtype
+        )
+
+    def loss_fn(params, x, batch):
+        targets = batch["tokens"][:, 1:]
+        x = _layer_norm(x, params["ln_f_g"], params["ln_f_b"])
+        logits = jnp.einsum(
+            "btd,vd->btv",
+            x.astype(jnp.float32),
+            params["head_w"].astype(jnp.float32),
+        )
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        ll = jnp.take_along_axis(
+            logits, targets[..., None], axis=-1
+        )[..., 0]
+        loss = (logz - ll).mean()
+        return loss, {"loss": loss}
+
+    def split_params(full, plan: StagePlan, stage: int):
+        full = _gpt_untie(full)
+        out: Dict[str, Any] = {
+            "blocks": plan.slice_stacked(full["blocks"], stage)
+        }
+        if plan.is_first(stage):
+            out["wte"] = full["wte"]
+            out["wpe"] = full["wpe"]
+        if plan.is_last(stage):
+            out["ln_f_g"] = full["ln_f_g"]
+            out["ln_f_b"] = full["ln_f_b"]
+            out["head_w"] = full["head_w"]
+        return out
+
+    def assemble_params(stage_params: List[Any], plan: StagePlan):
+        if len(stage_params) != plan.n_stages:
+            raise ValueError(
+                f"{len(stage_params)} stage param trees for "
+                f"{plan.n_stages} stages"
+            )
+        import numpy as np
+
+        first, last = stage_params[0], stage_params[-1]
+        blocks = {
+            key: np.concatenate(
+                [np.asarray(sp["blocks"][key]) for sp in stage_params],
+                axis=0,
+            )
+            for key in first["blocks"]
+        }
+        return {
+            "wte": np.asarray(first["wte"]),
+            "wpe": np.asarray(first["wpe"]),
+            "blocks": blocks,
+            "ln_f_g": np.asarray(last["ln_f_g"]),
+            "ln_f_b": np.asarray(last["ln_f_b"]),
+            "head_w": np.asarray(last["head_w"]),
+        }
+
+    return MpmdSpec(
+        n_layers=cfg.n_layer,
+        embed_fn=embed_fn,
+        stage_fn=stage_fn,
+        loss_fn=loss_fn,
+        split_params=split_params,
+        assemble_params=assemble_params,
+        # The family's adamw WITHOUT the global-norm clip: the clip
+        # couples leaves across stages and does not decompose — per-
+        # stage clipping would be a silently different optimizer (the
+        # module docstring's cross-stage-coupling caveat, made real).
+        tx_factory=lambda: gpt_adamw(cfg),
+    )
+
+
+def resolve_mpmd_spec(module) -> MpmdSpec:
+    """The MpmdSpec for a module: an explicit ``module.mpmd_spec()``
+    wins; GPT modules get the built-in adapter; anything else is a
+    loud error (pipelining needs model knowledge no generic wrapper
+    can infer)."""
+    maker = getattr(module, "mpmd_spec", None)
+    if maker is not None:
+        spec = maker()
+        if not isinstance(spec, MpmdSpec):
+            raise TypeError(
+                f"{type(module).__name__}.mpmd_spec() returned "
+                f"{type(spec).__name__}, expected MpmdSpec"
+            )
+        return spec
+    from ray_lightning_tpu.models.gpt import GPT
+
+    if isinstance(module, GPT):
+        return gpt_mpmd_spec(module)
+    raise TypeError(
+        f"MpmdStrategy needs a stage decomposition for "
+        f"{type(module).__name__}: implement mpmd_spec() -> MpmdSpec "
+        "(see ray_lightning_tpu.mpmd.plan) or use a GPT module."
+    )

@@ -1,0 +1,75 @@
+"""Single-mesh SPMD GPipe reference fit — the MPMD parity oracle.
+
+Same model decomposition (:class:`~.plan.MpmdSpec`), same micro-batch
+count, same optimizer — but every stage lives inside ONE jitted program
+on ONE ``pipe``-axis mesh via
+:func:`~..parallel.pipeline.pipeline_apply`.  The MPMD plane must match
+this fit's per-step losses to ``atol 1e-5`` in f32 (micro-batch-mean
+gradients equal full-batch-mean gradients for equal micro sizes, adamw
+is elementwise, so the two formulations compute the same math up to
+float association order).  Exercised by ``tests/test_mpmd.py`` and the
+``dryrun_multichip`` mpmd flavor.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_lightning_tpu.mpmd.plan import MpmdSpec
+
+__all__ = ["gpipe_reference_fit"]
+
+
+def gpipe_reference_fit(
+    spec: MpmdSpec,
+    full_params: Any,
+    tx,
+    batches: Callable[[int], Any],
+    steps: int,
+    n_stages: int,
+    n_micro: int,
+    devices: Optional[list] = None,
+) -> Dict[str, Any]:
+    """Train ``steps`` optimizer steps of the single-program GPipe
+    formulation; returns ``{"losses": [...], "state": final}``.
+
+    ``full_params`` must already carry the spec's untied layout (for
+    GPT: ``head_w`` present — see :func:`~.plan.gpt_mpmd_spec`);
+    ``batches(step)`` yields the SAME full batch the MPMD fit splits
+    into micro-batches at that step.
+    """
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from ray_lightning_tpu.core.module import TrainState
+    from ray_lightning_tpu.parallel.pipeline import pipeline_apply
+
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < n_stages:
+        raise ValueError(
+            f"reference fit needs {n_stages} devices, have {len(devices)}"
+        )
+    mesh = Mesh(np.asarray(devices[:n_stages]), ("pipe",))
+
+    def loss_fn(params, batch):
+        x0 = spec.embed_fn(params, batch)
+        out = pipeline_apply(
+            spec.stage_fn, params["blocks"], x0, mesh,
+            num_microbatches=n_micro,
+        )
+        loss, _ = spec.loss_fn(params, out, batch)
+        return loss
+
+    @jax.jit
+    def train_step(state: TrainState, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, batch)
+        return state.apply_gradients(grads, tx), loss
+
+    state = TrainState.create(full_params, tx)
+    losses: List[float] = []
+    for step in range(steps):
+        state, loss = train_step(state, batches(step))
+        losses.append(float(jax.device_get(loss)))
+    return {"losses": losses, "state": jax.device_get(state)}

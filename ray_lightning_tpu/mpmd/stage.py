@@ -1,0 +1,549 @@
+"""StageRunner: one pipeline worker as its own compiled programs on its
+own mesh.
+
+The MPMD dual of the megastep scan: where the SPMD pipeline compiles
+every stage into ONE program, a ``StageRunner`` owns a *stage-local*
+device mesh and separately compiled fwd/bwd/update programs built from
+the model's :class:`~.plan.MpmdSpec` decomposition, and walks an
+explicit :mod:`~.schedule` instruction stream — receiving activations
+from the previous worker, sending to the next, accumulating gradients
+on device, and applying the optimizer at ``UPDATE``.
+
+Under interleaving a worker hosts ``v`` model **chunks** (global stage
+``g = chunk * P + worker``), each with its own programs and gradient
+accumulator; one combined optimizer update covers all chunks (adamw is
+elementwise, so the per-chunk updates equal the single-program fit's).
+
+Backward follows the JaxPP recompute shape: ``FWD`` stashes only the
+chunk's INPUT activation per in-flight micro-batch (not the full
+residual set); ``BWD`` re-runs the chunk forward inside ``jax.vjp`` —
+~⅓ more chunk FLOPs for a P×-smaller stash, and fwd/bwd stay separately
+schedulable programs.
+
+The runner is deliberately transport- and process-agnostic: handed a
+:class:`~.transfer.Mailbox` + ring-channel pair it runs identically as
+a thread in one process (the fast parity tests), inside a
+:class:`~..cluster.actor.ProcessActor` (the real plane), or single-
+worker with no transport at all (P=1 degenerate pipe).
+
+Every executed instruction lands in a timeline record; per-optimizer-
+step summaries (:func:`~.schedule.bubble_from_timeline`) are the
+``bubble_fraction`` / ``stage_occupancy`` metric family the telemetry
+plane exports.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_lightning_tpu.mpmd import schedule as sched
+from ray_lightning_tpu.mpmd.plan import MpmdSpec, StagePlan
+
+__all__ = ["StageRunner", "stage_ckpt_name", "STAGE_CKPT_RE"]
+
+# mpmd-step<N>-stage<P>.ckpt — one single-file crc-framed checkpoint per
+# worker per retained optimizer step (utils/state_stream framing).
+STAGE_CKPT_RE = re.compile(
+    r"^mpmd-step(?P<step>\d+)-stage(?P<stage>\d+)\.ckpt$"
+)
+
+
+def stage_ckpt_name(step: int, worker: int) -> str:
+    return f"mpmd-step{step:08d}-stage{worker}.ckpt"
+
+
+class StageRunner:
+    """Execute one worker's instruction stream over its own mesh."""
+
+    def __init__(
+        self,
+        spec: MpmdSpec,
+        plan: StagePlan,
+        worker: int,
+        n_workers: int,
+        schedule: str,
+        n_micro: int,
+        tx,
+        interleave: int = 1,
+        mesh=None,
+        mailbox=None,
+        send_next=None,
+        send_prev=None,
+        recv_timeout_s: float = 120.0,
+        keep_ckpts: int = 2,
+    ):
+        if plan.n_stages != n_workers * interleave:
+            raise ValueError(
+                f"plan has {plan.n_stages} stages; {n_workers} workers x "
+                f"interleave {interleave} needs {n_workers * interleave}"
+            )
+        self.spec = spec
+        self.plan = plan
+        self.worker = worker
+        self.n_workers = n_workers
+        self.interleave = interleave
+        self.schedule_name = schedule
+        self.n_micro = n_micro
+        self.tx = tx
+        self.mesh = mesh
+        self.mailbox = mailbox
+        self.send_next = send_next
+        self.send_prev = send_prev
+        self.recv_timeout_s = recv_timeout_s
+        self.keep_ckpts = keep_ckpts
+        self.n_stages = plan.n_stages
+        # Global stage ids hosted here, by chunk.
+        self.stages = [
+            c * n_workers + worker for c in range(interleave)
+        ]
+        self.hosts_embed = 0 in self.stages
+        self.hosts_loss = (self.n_stages - 1) in self.stages
+        self.needs_batches = self.hosts_embed or self.hosts_loss
+        needs_recv = any(g > 0 for g in self.stages)
+        needs_send = any(g < self.n_stages - 1 for g in self.stages)
+        if (needs_recv or needs_send) and mailbox is None:
+            raise ValueError(f"worker {worker} needs a mailbox")
+        if needs_send and send_next is None:
+            raise ValueError(f"worker {worker} needs a send_next channel")
+        if any(g > 0 for g in self.stages) and send_prev is None:
+            raise ValueError(f"worker {worker} needs a send_prev channel")
+        self.stream = sched.build_streams(
+            schedule, n_workers, n_micro, interleave
+        )[worker]
+        self.state = None
+        self.step_summaries: List[Dict[str, float]] = []
+        self.losses: List[float] = []
+        # Per-op durations from steady-state steps (the first executed
+        # step carries compiles and is excluded) — feeds the
+        # measured-cost schedule-bubble decomposition.
+        self._op_durs: Dict[str, List[float]] = {}
+        self._steps_run = 0
+        self._acc: Optional[List[Any]] = None
+        self._compiled = False
+
+    # -- program construction ----------------------------------------------
+    def _build_programs(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        spec = self.spec
+        self._fwd: List[Any] = []
+        self._bwd: List[Any] = []
+        for c, g in enumerate(self.stages):
+            first = g == 0
+            last = g == self.n_stages - 1
+
+            def fwd_first(params, batch):
+                return spec.stage_fn(
+                    params["blocks"], spec.embed_fn(params, batch)
+                )
+
+            def fwd_mid(params, x):
+                return spec.stage_fn(params["blocks"], x)
+
+            def loss_last(params, x, batch):
+                return spec.loss_fn(
+                    params, spec.stage_fn(params["blocks"], x), batch
+                )
+
+            def loss_single(params, batch):
+                x = spec.stage_fn(
+                    params["blocks"], spec.embed_fn(params, batch)
+                )
+                return spec.loss_fn(params, x, batch)
+
+            if first and last:
+                fwd = jax.jit(loss_single)
+
+                def bwd(params, batch, _f=loss_single):
+                    return jax.grad(lambda p: _f(p, batch)[0])(params)
+
+                bwd = jax.jit(bwd)
+            elif first:
+                fwd = jax.jit(fwd_first)
+
+                def bwd(params, batch, dy, _f=fwd_first):
+                    _, vjp = jax.vjp(lambda p: _f(p, batch), params)
+                    (dp,) = vjp(dy)
+                    return dp
+
+                bwd = jax.jit(bwd)
+            elif last:
+                fwd = jax.jit(loss_last)
+
+                def bwd(params, x, batch, _f=loss_last):
+                    return jax.grad(
+                        lambda p, xx: _f(p, xx, batch)[0], argnums=(0, 1)
+                    )(params, x)
+
+                bwd = jax.jit(bwd)
+            else:
+                fwd = jax.jit(fwd_mid)
+
+                def bwd(params, x, dy, _f=fwd_mid):
+                    _, vjp = jax.vjp(_f, params, x)
+                    return vjp(dy)  # (dparams, dx)
+
+                bwd = jax.jit(bwd)
+            self._fwd.append(fwd)
+            self._bwd.append(bwd)
+
+        self._acc_add = jax.jit(
+            lambda acc, g: jax.tree_util.tree_map(jnp.add, acc, g)
+        )
+        self._zeros_like = jax.jit(
+            lambda p: jax.tree_util.tree_map(jnp.zeros_like, p)
+        )
+        n = float(self.n_micro)
+        tx = self.tx
+
+        def apply_update(state, acc_chunks):
+            grads = {
+                "chunks": [
+                    jax.tree_util.tree_map(lambda g: g / n, acc)
+                    for acc in acc_chunks
+                ]
+            }
+            return state.apply_gradients(grads, tx)
+
+        self._apply = jax.jit(apply_update, donate_argnums=(0,))
+        self._compiled = True
+
+    # -- placement -----------------------------------------------------------
+    def _replicated(self, tree):
+        import jax
+
+        if self.mesh is None:
+            return tree
+        from ray_lightning_tpu.parallel import sharding as shardlib
+
+        return jax.device_put(tree, shardlib.replicated(self.mesh))
+
+    def _batch_placed(self, tree):
+        """Intra-stage GSPMD: batch rows sharded over the stage mesh's
+        data axes (activations and raw batches share the leading-axis
+        contract)."""
+        import jax
+
+        if self.mesh is None:
+            return jax.tree_util.tree_map(jax.numpy.asarray, tree)
+        from ray_lightning_tpu.parallel import sharding as shardlib
+
+        return jax.device_put(tree, shardlib.batch_sharding(self.mesh))
+
+    # -- state ----------------------------------------------------------------
+    def init_state(self, full_params) -> None:
+        """Slice this worker's chunk params out of a full host param
+        tree and build the local optimizer state (every worker inits
+        from the same deterministic full init, so stages agree on
+        boundary shapes without communicating)."""
+        import numpy as np
+
+        import jax
+
+        from ray_lightning_tpu.core.module import TrainState
+
+        chunks = [
+            self.spec.split_params(full_params, self.plan, g)
+            for g in self.stages
+        ]
+        # Host-copy before placement: the update program donates the
+        # state, and device_put may alias the caller's buffers as
+        # shards — donating an alias would delete the caller's params
+        # (the inproc harness hands the SAME full tree to every stage).
+        chunks = jax.tree_util.tree_map(lambda a: np.array(a), chunks)
+        params = self._replicated({"chunks": chunks})
+        self.state = TrainState.create(params, self.tx)
+        if not self._compiled:
+            self._build_programs()
+        self._acc = [
+            self._zeros_like(p) for p in self.state.params["chunks"]
+        ]
+
+    def load_state(self, state) -> None:
+        """Adopt a (host) TrainState — the resume path."""
+        self.state = self._replicated(state)
+        if not self._compiled:
+            self._build_programs()
+        self._acc = [
+            self._zeros_like(p) for p in self.state.params["chunks"]
+        ]
+
+    def host_state(self):
+        import jax
+
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_get(a), self.state
+        )
+
+    def chunk_params_host(self) -> List[Any]:
+        """Per-GLOBAL-stage host param trees (ordered by this worker's
+        chunk index — the strategy reassembles across workers)."""
+        import jax
+
+        return [
+            jax.device_get(p) for p in self.state.params["chunks"]
+        ]
+
+    # -- checkpointing --------------------------------------------------------
+    def write_checkpoint(self, restart_dir: str, step: int) -> str:
+        from ray_lightning_tpu.utils.state_stream import (
+            state_stream_to_file,
+            to_state_stream,
+        )
+
+        os.makedirs(restart_dir, exist_ok=True)
+        path = os.path.join(
+            restart_dir, stage_ckpt_name(step, self.worker)
+        )
+        state_stream_to_file(
+            to_state_stream({"state": self.host_state(), "step": step}),
+            path,
+        )
+        self._prune_checkpoints(restart_dir)
+        return path
+
+    def _prune_checkpoints(self, restart_dir: str) -> None:
+        """Keep the newest ``keep_ckpts`` steps of THIS worker
+        (previous-good fallback needs one older survivor, same
+        retention contract as the SPMD restart dir)."""
+        mine = []
+        try:
+            entries = os.listdir(restart_dir)
+        except OSError:
+            return
+        for entry in entries:
+            m = STAGE_CKPT_RE.match(entry)
+            if m and int(m.group("stage")) == self.worker:
+                mine.append((int(m.group("step")), entry))
+        for _, entry in sorted(mine)[:-self.keep_ckpts]:
+            try:
+                os.unlink(os.path.join(restart_dir, entry))
+            except OSError:
+                pass
+
+    def load_checkpoint(self, prefix: str) -> int:
+        """Load ``<prefix>-stage<k>.ckpt`` (driver-brokered resume
+        prefix, see :func:`~.worker.latest_mpmd_checkpoint`); returns
+        the optimizer step to resume FROM."""
+        from ray_lightning_tpu.utils.state_stream import (
+            load_state_stream,
+            state_stream_from_file,
+        )
+
+        path = f"{prefix}-stage{self.worker}.ckpt"
+        payload = load_state_stream(state_stream_from_file(path))
+        self.load_state(payload["state"])
+        return int(payload["step"])
+
+    # -- execution ------------------------------------------------------------
+    def run_fit(
+        self,
+        steps: int,
+        micro_batches_for: Callable[[int], Optional[List[Any]]],
+        start_step: int = 0,
+        restart_dir: Optional[str] = None,
+        ckpt_every: int = 1,
+        on_step: Optional[Callable[[int, Dict[str, Any]], None]] = None,
+        drain_check: Optional[Callable[[], Optional[str]]] = None,
+    ) -> Dict[str, Any]:
+        """Drive optimizer steps ``start_step .. steps-1`` of the
+        stream.
+
+        ``micro_batches_for(step)`` returns this worker's micro-batch
+        list (embed/loss workers) or ``None`` (interior workers).  A
+        pending drain request (``drain_check`` returning a reason) is
+        honored at the step boundary: the worker writes its drain
+        checkpoint and raises :class:`~..fault.drain.PreemptedError` —
+        the per-stage half of the graceful-drain contract.
+        """
+        from ray_lightning_tpu.fault import inject as _chaos
+        from ray_lightning_tpu.fault.drain import PreemptedError
+
+        if self.state is None:
+            raise RuntimeError("init_state/load_state must run first")
+        for step in range(start_step, steps):
+            reason = drain_check() if drain_check is not None else None
+            if reason:
+                ckpt = None
+                if restart_dir is not None:
+                    self.write_checkpoint(restart_dir, step)
+                    ckpt = os.path.join(
+                        restart_dir, f"mpmd-step{step:08d}"
+                    )
+                raise PreemptedError(
+                    f"stage worker {self.worker} drained at step {step}",
+                    checkpoint=ckpt, step=step, rank=self.worker,
+                    reason=reason,
+                )
+            _chaos.fire("step", step=step, epoch=0, rank=self.worker)
+            logs = self._run_opt_step(step, micro_batches_for(step))
+            if self.hosts_loss:
+                self.losses.append(float(logs.get("loss", float("nan"))))
+            if (restart_dir is not None
+                    and (step + 1) % max(ckpt_every, 1) == 0):
+                self.write_checkpoint(restart_dir, step + 1)
+            if on_step is not None:
+                on_step(step, logs)
+        return {
+            "losses": self.losses,
+            "step_summaries": self.step_summaries,
+            "stats": self.fit_stats(),
+        }
+
+    def _run_opt_step(
+        self, step: int, micro: Optional[List[Any]]
+    ) -> Dict[str, Any]:
+        import jax
+        import numpy as np
+
+        if self.needs_batches:
+            if micro is None or len(micro) != self.n_micro:
+                raise ValueError(
+                    f"worker {self.worker} needs {self.n_micro} "
+                    f"micro-batches at step {step}, got "
+                    f"{None if micro is None else len(micro)}"
+                )
+            micro = [self._batch_placed(m) for m in micro]
+        timeline: List[Dict[str, Any]] = []
+        stash_x: Dict[Any, Any] = {}
+        stash_y: Dict[Any, Any] = {}
+        stash_dy: Dict[Any, Any] = {}
+        stash_dx: Dict[Any, Any] = {}
+        mb_losses: List[float] = []
+        n_workers = self.n_workers
+
+        for instr in self.stream:
+            op, mb, c = instr.op, instr.mb, instr.chunk
+            blocked = 0.0
+            t0 = time.perf_counter()
+            if op == sched.RECV_ACT:
+                tree, blocked = self.mailbox.recv(
+                    ("act", step, mb, c), timeout=self.recv_timeout_s
+                )
+                stash_x[(c, mb)] = self._batch_placed(tree)
+            elif op == sched.RECV_GRAD:
+                tree, blocked = self.mailbox.recv(
+                    ("grad", step, mb, c), timeout=self.recv_timeout_s
+                )
+                stash_dy[(c, mb)] = self._batch_placed(tree)
+            elif op == sched.FWD:
+                g = self.stages[c]
+                params = self.state.params["chunks"][c]
+                first, last = g == 0, g == self.n_stages - 1
+                if first and last:
+                    loss, _ = self._fwd[c](params, micro[mb])
+                    mb_losses.append(float(jax.device_get(loss)))
+                elif first:
+                    y = self._fwd[c](params, micro[mb])
+                    jax.block_until_ready(y)
+                    stash_y[(c, mb)] = y
+                elif last:
+                    loss, _ = self._fwd[c](
+                        params, stash_x[(c, mb)], micro[mb]
+                    )
+                    mb_losses.append(float(jax.device_get(loss)))
+                else:
+                    y = self._fwd[c](params, stash_x[(c, mb)])
+                    jax.block_until_ready(y)
+                    stash_y[(c, mb)] = y
+            elif op == sched.SEND_ACT:
+                y = stash_y.pop((c, mb))
+                g = self.stages[c]
+                self.send_next.send(
+                    "act", step, mb, jax.device_get(y),
+                    chunk=(g + 1) // n_workers,
+                )
+            elif op == sched.BWD:
+                g = self.stages[c]
+                params = self.state.params["chunks"][c]
+                first, last = g == 0, g == self.n_stages - 1
+                if first and last:
+                    dp = self._bwd[c](params, micro[mb])
+                elif first:
+                    dp = self._bwd[c](
+                        params, micro[mb], stash_dy.pop((c, mb))
+                    )
+                elif last:
+                    dp, dx = self._bwd[c](
+                        params, stash_x.pop((c, mb)), micro[mb]
+                    )
+                    stash_dx[(c, mb)] = dx
+                else:
+                    dp, dx = self._bwd[c](
+                        params, stash_x.pop((c, mb)),
+                        stash_dy.pop((c, mb)),
+                    )
+                    stash_dx[(c, mb)] = dx
+                self._acc[c] = self._acc_add(self._acc[c], dp)
+                jax.block_until_ready(self._acc[c])
+            elif op == sched.SEND_GRAD:
+                dx = stash_dx.pop((c, mb))
+                g = self.stages[c]
+                self.send_prev.send(
+                    "grad", step, mb, jax.device_get(dx),
+                    chunk=(g - 1) // n_workers,
+                )
+            elif op == sched.UPDATE:
+                self.state = self._apply(self.state, self._acc)
+                jax.block_until_ready(self.state.params)
+                self._acc = [
+                    self._zeros_like(p)
+                    for p in self.state.params["chunks"]
+                ]
+            t1 = time.perf_counter()
+            timeline.append({
+                "op": op, "mb": mb, "t0": t0, "t1": t1,
+                "blocked_s": blocked,
+            })
+            if self._steps_run > 0 and op in (
+                    sched.FWD, sched.BWD, sched.SEND_ACT,
+                    sched.SEND_GRAD):
+                key = "SEND" if op.startswith("SEND") else op
+                self._op_durs.setdefault(key, []).append(t1 - t0)
+        self._steps_run += 1
+        summary = sched.bubble_from_timeline(timeline)
+        summary["step"] = step
+        self.step_summaries.append(summary)
+        logs: Dict[str, Any] = dict(summary)
+        if self.hosts_loss and mb_losses:
+            logs["loss"] = float(np.mean(mb_losses))
+        return logs
+
+    def op_costs(self) -> Dict[str, float]:
+        """Median steady-state per-op durations (seconds) — the inputs
+        of :func:`~.schedule.measured_schedule_bubble`."""
+        import numpy as np
+
+        return {
+            op: float(np.median(durs))
+            for op, durs in self._op_durs.items() if durs
+        }
+
+    def fit_stats(self) -> Dict[str, float]:
+        """Steady-state worker summary: the first optimizer step
+        carries every program's compile and is excluded when later
+        steps exist (a compile-dominated bubble number would be
+        meaningless for schedule A/Bs)."""
+        window = (
+            self.step_summaries[1:]
+            if len(self.step_summaries) > 1
+            else self.step_summaries
+        )
+        if not window:
+            return {
+                "bubble_fraction": 0.0,
+                "stage_occupancy": 0.0,
+                "busy_s": 0.0,
+                "blocked_s": 0.0,
+                "wall_s": 0.0,
+            }
+        keys = ("bubble_fraction", "stage_occupancy", "busy_s",
+                "blocked_s", "wall_s")
+        return {
+            k: float(sum(s[k] for s in window) / len(window))
+            for k in keys
+        }

@@ -1,0 +1,238 @@
+"""Inter-stage transfer lane: activations/activation-grads between
+stage actors.
+
+Reuses the cluster control-plane primitives instead of inventing a new
+wire: each stage process owns a :class:`~..cluster.queue.DriverQueue`
+**inbox** (TCP server), and its neighbors hold plain
+:class:`~..cluster.queue.QueueHandle` clients to it — the same
+machinery that crosses DCN between hosts of a pod, with the
+payload-scaled + chunked send timeouts ``cluster/queue.py`` grew for
+exactly these multi-MB tensors.  Same-host stages skip the TCP payload
+entirely: the tensor bytes go through the shared-memory
+:class:`~..cluster.shm.SegmentStore` (write once to tmpfs, read at
+page-cache speed) and only the segment path rides the queue.
+
+**Double-buffered recv**: the inbox's pump thread drains the socket
+into a keyed :class:`Mailbox` *continuously*, so micro-batch ``i+1``'s
+activation streams in while the stage computes on ``i`` — a
+``RECV(mb)`` instruction only blocks when the payload has not fully
+arrived yet, and that blocked time is measured and reported as pipeline
+bubble.
+
+Wire item shape (schema-pinned in ``telemetry/schema.py`` as
+``mpmd_xfer``)::
+
+    {"type": "mpmd_xfer", "kind": "act"|"grad", "step": int, "mb": int,
+     "data": bytes} | {..., "shm": path}
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from ray_lightning_tpu.cluster import rpc
+from ray_lightning_tpu.cluster.queue import DriverQueue, QueueHandle
+
+__all__ = [
+    "Mailbox",
+    "StageInbox",
+    "LocalChannel",
+    "QueueChannel",
+    "encode_tree",
+    "decode_tree",
+    "SHM_THRESHOLD_BYTES",
+]
+
+# Same-host payloads above this ride tmpfs segments instead of the TCP
+# loopback (one copy + page cache vs kernel socket buffers both ways).
+SHM_THRESHOLD_BYTES = 256 << 10
+
+
+def encode_tree(tree: Any) -> bytes:
+    """Host-ify and serialize an array pytree (activations are numpy by
+    the time they leave a stage — ``StageRunner`` device_gets first)."""
+    import jax
+
+    host = jax.tree_util.tree_map(np.asarray, tree)
+    return rpc.dumps(host)
+
+
+def decode_tree(payload: bytes) -> Any:
+    return rpc.loads(payload)
+
+
+class Mailbox:
+    """Keyed rendezvous: the pump thread ``deliver``s payloads as they
+    arrive; ``recv`` blocks until its key shows up (and reports how long
+    it actually waited — the bubble signal)."""
+
+    def __init__(self):
+        self._items: Dict[Tuple, Any] = {}
+        self._cond = threading.Condition()
+        self._error: Optional[BaseException] = None
+
+    def deliver(self, key: Tuple, payload: Any) -> None:
+        with self._cond:
+            self._items[key] = payload
+            self._cond.notify_all()
+
+    def fail(self, exc: BaseException) -> None:
+        """Poison the mailbox: every current and future recv raises."""
+        with self._cond:
+            self._error = exc
+            self._cond.notify_all()
+
+    def ready(self, key: Tuple) -> bool:
+        with self._cond:
+            return key in self._items
+
+    def recv(self, key: Tuple, timeout: float = 120.0) -> Tuple[Any, float]:
+        """Blocking receive → ``(payload, blocked_seconds)``."""
+        deadline = time.monotonic() + timeout
+        t0 = time.perf_counter()
+        with self._cond:
+            while key not in self._items:
+                if self._error is not None:
+                    raise RuntimeError(
+                        f"transfer lane failed while waiting for {key}"
+                    ) from self._error
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"transfer recv timed out after {timeout:.0f}s "
+                        f"waiting for {key} (peer stage dead or wedged?)"
+                    )
+                self._cond.wait(min(remaining, 1.0))
+            payload = self._items.pop(key)
+        return payload, time.perf_counter() - t0
+
+
+class StageInbox:
+    """A stage's receive plane: a DriverQueue server + the pump thread
+    that files decoded payloads into the :class:`Mailbox` (this thread
+    IS the comm/compute overlap)."""
+
+    def __init__(self, host: str = "127.0.0.1",
+                 advertise_host: Optional[str] = None):
+        self.queue = DriverQueue(host=host, advertise_host=advertise_host)
+        self.mailbox = Mailbox()
+        self._closed = threading.Event()
+        self._pump = threading.Thread(
+            target=self._pump_loop, name="rlt-mpmd-inbox", daemon=True
+        )
+        self._pump.start()
+
+    @property
+    def handle(self) -> QueueHandle:
+        return self.queue.handle
+
+    def _pump_loop(self) -> None:
+        import queue as _pyqueue
+
+        while not self._closed.is_set():
+            try:
+                item = self.queue.get(timeout=0.2)
+            except _pyqueue.Empty:
+                continue
+            except Exception as e:  # noqa: BLE001 - server torn down
+                if not self._closed.is_set():
+                    self.mailbox.fail(e)
+                return
+            try:
+                self._file(item)
+            except Exception as e:  # noqa: BLE001 - a malformed frame
+                # must poison recvs loudly, not vanish in a daemon thread
+                self.mailbox.fail(e)
+                return
+
+    def _file(self, item: Any) -> None:
+        if not (isinstance(item, dict) and item.get("type") == "mpmd_xfer"):
+            raise ValueError(f"unexpected item on stage inbox: {type(item)}")
+        key = (
+            item["kind"], int(item["step"]), int(item["mb"]),
+            int(item.get("chunk", 0)),
+        )
+        shm_path = item.get("shm")
+        if shm_path is not None:
+            from ray_lightning_tpu.cluster.shm import SegmentStore
+
+            payload = SegmentStore.get(shm_path)
+            # Write-once/read-once: the consumer reclaims tmpfs as soon
+            # as the bytes are out (the producer's teardown sweep is the
+            # crash backstop).
+            try:
+                os.unlink(shm_path)
+            except OSError:
+                pass
+        else:
+            payload = item["data"]
+        self.mailbox.deliver(key, decode_tree(payload))
+
+    def close(self) -> None:
+        self._closed.set()
+        self.queue.shutdown()
+
+
+class LocalChannel:
+    """In-process channel straight into a :class:`Mailbox` — the
+    transport of the threaded in-process pipeline (tests, the inline
+    parity harness)."""
+
+    def __init__(self, mailbox: Mailbox):
+        self._mailbox = mailbox
+        self.bytes_sent = 0
+
+    def send(self, kind: str, step: int, mb: int, tree: Any,
+             chunk: int = 0) -> None:
+        # Round-trip through the real encoder: in-process parity runs
+        # must exercise the same host-ification the wire path does.
+        payload = encode_tree(tree)
+        self.bytes_sent += len(payload)
+        self._mailbox.deliver(
+            (kind, step, mb, chunk), decode_tree(payload)
+        )
+
+
+class QueueChannel:
+    """Cross-process channel to a neighbor stage's :class:`StageInbox`.
+
+    ``same_host=True`` routes payloads above ``shm_threshold`` through
+    the segment store; the TCP frame then carries only the path.
+    """
+
+    def __init__(self, handle: QueueHandle, same_host: bool = False,
+                 shm_threshold: int = SHM_THRESHOLD_BYTES):
+        self._handle = handle
+        self._store = None
+        if same_host:
+            from ray_lightning_tpu.cluster.shm import SegmentStore
+
+            self._store = SegmentStore(prefix="rlt-seg")
+        self._shm_threshold = shm_threshold
+        self.bytes_sent = 0
+        self.shm_sends = 0
+
+    def send(self, kind: str, step: int, mb: int, tree: Any,
+             chunk: int = 0) -> None:
+        payload = encode_tree(tree)
+        self.bytes_sent += len(payload)
+        item: Dict[str, Any] = {
+            "type": "mpmd_xfer", "kind": kind, "step": int(step),
+            "mb": int(mb), "chunk": int(chunk),
+        }
+        if self._store is not None and len(payload) >= self._shm_threshold:
+            item["shm"] = self._store.put(payload)
+            self.shm_sends += 1
+        else:
+            item["data"] = payload
+        self._handle.put(item)
+
+    def close(self) -> None:
+        self._handle.close()
+        if self._store is not None:
+            self._store.unlink_all()

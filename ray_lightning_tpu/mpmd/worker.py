@@ -1,0 +1,301 @@
+"""Actor-side entry points + checkpoint discovery for the MPMD plane.
+
+One :class:`~..cluster.actor.ProcessActor` per pipeline stage worker:
+the driver first asks each actor to open its transfer inbox
+(:func:`_remote_create_inbox` — the handle is brokered back and
+distributed to the ring neighbors), then submits
+:func:`_stage_execute_remote`, which builds the stage-local mesh,
+splits the model, and drives the :class:`~.stage.StageRunner` through
+the fit.  Everything here is top-level and import-light so cloudpickle
+ships it by reference.
+
+Fault plane: the worker honors the process-wide drain flag at step
+boundaries (writes its ``mpmd-step*-stage*.ckpt`` drain checkpoint and
+raises :class:`~..fault.drain.PreemptedError`), and crashed workers'
+shared-memory segments are reclaimed by the sweep the strategy runs on
+kill (``cluster/shm.py``).  Restart discovery
+(:func:`latest_mpmd_checkpoint`) resumes at the newest optimizer step
+for which EVERY stage has a crc-verified checkpoint — stages must agree
+on the step or the pipeline would train skewed params.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_lightning_tpu.mpmd.stage import STAGE_CKPT_RE, StageRunner
+from ray_lightning_tpu.mpmd.transfer import QueueChannel, StageInbox
+
+__all__ = [
+    "latest_mpmd_checkpoint",
+    "_remote_create_inbox",
+    "_stage_execute_remote",
+]
+
+# The actor process's live inbox (module-global: it must outlive the
+# _remote_create_inbox call and be found by _stage_execute_remote).
+_INBOX: Optional[StageInbox] = None
+
+
+def _remote_create_inbox(loopback: bool = True) -> Tuple[str, int]:
+    """Open (or re-open) this actor's transfer inbox; returns the
+    (host, port) its neighbors dial.  Re-invocation closes the previous
+    inbox — each fit attempt gets a fresh lane (a respawned peer must
+    never read a dead attempt's frames)."""
+    global _INBOX
+    if _INBOX is not None:
+        _INBOX.close()
+        _INBOX = None
+    if loopback:
+        _INBOX = StageInbox(host="127.0.0.1")
+    else:
+        from ray_lightning_tpu.cluster import rpc
+
+        _INBOX = StageInbox(
+            host="0.0.0.0", advertise_host=rpc.get_node_ip()
+        )
+    handle = _INBOX.handle
+    return handle.host, handle.port
+
+
+def _collect_batches(datamodule, config,
+                     max_needed: Optional[int] = None) -> List[Any]:
+    """Materialize the deterministic batch sequence every batch-consuming
+    stage worker replays (embed and loss workers must see identical
+    rows; both build the shipped datamodule from the same seed).
+
+    ``max_needed`` (the resolved step count, when known) bounds the
+    buffer: the fit indexes ``batches[step % len]``, so more than
+    ``steps`` batches are never read — without the cap a max_steps fit
+    over a large (or streaming/unbounded) loader would buffer the whole
+    epoch per stage worker before the first optimizer step."""
+    datamodule.setup("fit")
+    loader = datamodule.train_dataloader()
+    limit = getattr(config, "limit_train_batches", -1)
+    batches: List[Any] = []
+    for i, batch in enumerate(loader):
+        if limit is not None and 0 <= limit <= i:
+            break
+        if max_needed is not None and len(batches) >= max_needed:
+            break
+        batches.append(batch)
+    if not batches:
+        raise ValueError("train dataloader yielded no batches")
+    return batches
+
+
+def _resolve_steps(config, n_batches: int) -> int:
+    max_steps = getattr(config, "max_steps", -1)
+    if max_steps and max_steps > 0:
+        return max_steps
+    return n_batches * max(getattr(config, "max_epochs", 1), 1)
+
+
+def _stage_execute_remote(
+    task_ref,
+    worker_rank: int,
+    queue_handle,
+    prev_addr: Optional[Tuple[str, int]],
+    next_addr: Optional[Tuple[str, int]],
+) -> Dict[str, Any]:
+    """Run one stage worker's whole fit inside its actor."""
+    global _INBOX
+    task = task_ref.get()
+    n_workers = task["n_workers"]
+    interleave = task["interleave"]
+    n_micro = task["n_micro"]
+    config = task["config"]
+
+    from ray_lightning_tpu.cluster.queue import QueueHandle
+    from ray_lightning_tpu.fault import drain as drain_mod
+    from ray_lightning_tpu.fault import inject as _chaos
+    from ray_lightning_tpu.mpmd.inproc import split_micro_batches
+    from ray_lightning_tpu.mpmd.plan import StagePlan, resolve_mpmd_spec
+    from ray_lightning_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    _chaos.set_rank(worker_rank)
+    _chaos.fire("spawn", rank=worker_rank)
+    drain_mod.reset_drain()
+    drain_mod.set_fit_active(True)
+
+    module = task["module"]
+    spec = resolve_mpmd_spec(module)
+    plan = StagePlan.split(spec.n_layers, n_workers * interleave)
+    mesh = build_mesh(MeshSpec(task.get("mesh_axes")))
+
+    class _Ctx:  # modules read trainer.mesh for sharding hints
+        grad_sync_active = False
+
+    _Ctx.mesh = mesh
+    module.trainer = _Ctx()
+
+    tx_factory = task.get("tx_factory") or spec.tx_factory
+    tx = tx_factory() if tx_factory is not None else (
+        module.configure_optimizers()
+    )
+    # The (tx, lr_schedule) convention — but optax transformations ARE
+    # NamedTuples, so "has no init" is the discriminator, not tuple-ness.
+    if isinstance(tx, tuple) and not hasattr(tx, "init"):
+        tx = tx[0]
+
+    def channel(addr):
+        if addr is None:
+            return None
+        return QueueChannel(
+            QueueHandle(addr[0], addr[1]),
+            same_host=task.get("same_host", False),
+        )
+
+    send_next = channel(next_addr)
+    send_prev = channel(prev_addr)
+
+    runner = StageRunner(
+        spec, plan, worker_rank, n_workers,
+        task["schedule"], n_micro, tx,
+        interleave=interleave,
+        mesh=mesh,
+        mailbox=None if _INBOX is None else _INBOX.mailbox,
+        send_next=send_next,
+        send_prev=send_prev,
+        recv_timeout_s=task.get("recv_timeout_s", 120.0),
+    )
+
+    start_step = 0
+    resume_prefix = task.get("resume_prefix")
+    if resume_prefix:
+        start_step = runner.load_checkpoint(resume_prefix)
+    else:
+        import jax
+
+        runner.init_state(
+            module.init_params(jax.random.PRNGKey(config.seed))
+        )
+
+    batches = None
+    if runner.needs_batches:
+        batches = _collect_batches(
+            task["datamodule"], config, max_needed=task.get("steps")
+        )
+    steps = task.get("steps")  # driver-resolved (max_steps) when set
+    if steps is None:
+        if batches is None:
+            raise ValueError(
+                f"interior stage worker {worker_rank} cannot derive the "
+                "step count from data it never loads; set "
+                "Trainer(max_steps=...) for pipelines deeper than 2 "
+                "workers"
+            )
+        steps = _resolve_steps(config, len(batches))
+
+    micro_cache: Dict[int, List[Any]] = {}
+
+    def micro_for(step: int):
+        if batches is None:
+            return None
+        if step not in micro_cache:
+            micro_cache.clear()  # one step in flight at a time
+            micro_cache[step] = split_micro_batches(
+                batches[step % len(batches)], n_micro
+            )
+        return micro_cache[step]
+
+    def on_step(step: int, logs: Dict[str, Any]) -> None:
+        item = {
+            "type": "mpmd_stage",
+            "stage": worker_rank,
+            "step": step,
+            "bubble_fraction": float(logs.get("bubble_fraction", 0.0)),
+            "stage_occupancy": float(logs.get("stage_occupancy", 0.0)),
+            "busy_s": float(logs.get("busy_s", 0.0)),
+            "blocked_s": float(logs.get("blocked_s", 0.0)),
+        }
+        if "loss" in logs:
+            item["loss"] = float(logs["loss"])
+        try:
+            queue_handle.put(item)
+        except Exception:  # noqa: BLE001 - telemetry must not kill a fit
+            pass
+
+    def drain_check() -> Optional[str]:
+        return "preempt" if drain_mod.drain_requested() else None
+
+    try:
+        runner.run_fit(
+            steps,
+            micro_for,
+            start_step=start_step,
+            restart_dir=task.get("restart_dir"),
+            ckpt_every=task.get("ckpt_every", 1),
+            on_step=on_step,
+            drain_check=drain_check,
+        )
+    finally:
+        drain_mod.set_fit_active(False)
+        for ch in (send_next, send_prev):
+            if ch is not None:
+                try:
+                    ch.close()
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    pass
+
+    import jax
+
+    last_logs: Dict[str, float] = {}
+    if runner.hosts_loss and runner.losses:
+        last_logs = {
+            "loss": runner.losses[-1], "train_loss": runner.losses[-1],
+        }
+    return {
+        "rank": worker_rank,
+        "chunks": runner.chunk_params_host(),
+        "losses": list(runner.losses),
+        "stats": runner.fit_stats(),
+        "op_costs": runner.op_costs(),
+        "final_step": int(jax.device_get(runner.state.step)),
+        "callback_metrics": last_logs,
+        "hosts_loss": runner.hosts_loss,
+        "steps": steps,
+    }
+
+
+def latest_mpmd_checkpoint(
+    restart_dir: Optional[str], n_workers: int
+) -> Dict[str, Any]:
+    """Newest optimizer step with a COMPLETE, crc-verified checkpoint
+    set (one file per stage worker).  Steps with missing or corrupt
+    members are walked past — and reported, so silent storage problems
+    become ``ckpt_corrupt`` events like the SPMD plane's."""
+    corrupt: List[Dict[str, Any]] = []
+    if restart_dir is None:
+        return {"path": None, "corrupt": corrupt}
+    try:
+        entries = os.listdir(restart_dir)
+    except OSError:
+        return {"path": None, "corrupt": corrupt}
+    by_step: Dict[int, Dict[int, str]] = {}
+    for entry in entries:
+        m = STAGE_CKPT_RE.match(entry)
+        if m:
+            by_step.setdefault(int(m.group("step")), {})[
+                int(m.group("stage"))
+            ] = os.path.join(restart_dir, entry)
+    from ray_lightning_tpu.utils.state_stream import verify_stream_file
+
+    for step in sorted(by_step, reverse=True):
+        members = by_step[step]
+        if set(members) != set(range(n_workers)):
+            continue  # incomplete set (a stage died mid-write)
+        problems = []
+        for stage, path in sorted(members.items()):
+            errs = verify_stream_file(path)
+            if errs:
+                problems.append({"path": path, "problems": errs[:3]})
+        if problems:
+            corrupt.extend(problems)
+            continue
+        return {
+            "path": os.path.join(restart_dir, f"mpmd-step{step:08d}"),
+            "corrupt": corrupt,
+        }
+    return {"path": None, "corrupt": corrupt}

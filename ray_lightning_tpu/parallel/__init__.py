@@ -32,6 +32,7 @@ __all__ = [
     "RayStrategy",
     "HorovodRayStrategy",
     "RayShardedStrategy",
+    "MpmdStrategy",
     "RayPlugin",
     "HorovodRayPlugin",
     "RayShardedPlugin",
@@ -43,6 +44,7 @@ _STRATEGY_NAMES = (
     "RayStrategy",
     "HorovodRayStrategy",
     "RayShardedStrategy",
+    "MpmdStrategy",
     "RayPlugin",
     "HorovodRayPlugin",
     "RayShardedPlugin",

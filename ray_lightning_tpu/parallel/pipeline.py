@@ -40,7 +40,42 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ray_lightning_tpu.utils.jax_compat import pcast
 
-__all__ = ["pipeline_apply", "pipelined_scan"]
+__all__ = ["pipeline_apply", "pipelined_scan", "layer_splits"]
+
+
+def layer_splits(
+    n_layers: int, n_stages: int, *, require_divisible: bool = False
+) -> tuple:
+    """Contiguous stage boundaries over a stacked ``(L, ...)`` layer axis.
+
+    Returns ``(b_0, ..., b_P)`` with stage ``p`` owning layers
+    ``[b_p, b_{p+1})``.  The single source of the layer-axis split math:
+    the SPMD GPipe flavor here requires an even split (the sharded axis
+    is one leaf — ``require_divisible=True``), while the MPMD plane
+    (:mod:`ray_lightning_tpu.mpmd`) slices per stage and balances a
+    remainder onto the EARLIEST stages (front-loaded: stage 0 also owns
+    the embedding prologue, but the alternative — a fat LAST stage —
+    would stack the remainder on top of the loss/LM-head epilogue, the
+    heavier end for LM shapes).
+    """
+    if n_stages < 1:
+        raise ValueError(f"n_stages must be >= 1, got {n_stages}")
+    if n_layers < n_stages:
+        raise ValueError(
+            f"{n_layers} layers cannot fill {n_stages} pipeline stages "
+            "(every stage needs at least one layer)"
+        )
+    if n_layers % n_stages:
+        if require_divisible:
+            raise ValueError(
+                f"layer axis has {n_layers} layers, not divisible into "
+                f"{n_stages} pipeline stages"
+            )
+    base, extra = divmod(n_layers, n_stages)
+    bounds = [0]
+    for p in range(n_stages):
+        bounds.append(bounds[-1] + base + (1 if p < extra else 0))
+    return tuple(bounds)
 
 
 def pipelined_scan(
@@ -141,12 +176,12 @@ def pipeline_apply(
         )
     for path, leaf in jax.tree_util.tree_flatten_with_path(
             stacked_params)[0]:
-        if leaf.shape[0] % n_stages:
+        try:
+            layer_splits(leaf.shape[0], n_stages, require_divisible=True)
+        except ValueError as err:
             raise ValueError(
-                f"layer axis of {jax.tree_util.keystr(path)} has "
-                f"{leaf.shape[0]} layers, not divisible into "
-                f"{n_stages} pipeline stages"
-            )
+                f"layer axis of {jax.tree_util.keystr(path)}: {err}"
+            ) from None
     x_micro = x.reshape(m, b // m, *x.shape[1:])
 
     # Layer axis (leading) sharded over pipe; everything else replicated.

@@ -62,6 +62,7 @@ __all__ = [
     "RayStrategy",
     "HorovodRayStrategy",
     "RayShardedStrategy",
+    "MpmdStrategy",
     # Reference-name aliases for drop-in familiarity:
     "RayPlugin",
     "HorovodRayPlugin",
@@ -496,6 +497,19 @@ class TpuStrategy:
                     "%s: kill of worker rank %d (%s) failed: %r",
                     why, rank, getattr(w, "name", "?"), e,
                 )
+        # Crashed/killed workers (kill -9, monitor aborts, respawns)
+        # can't run their own teardown: sweep their orphaned shared-
+        # memory segments here so elastic restarts don't leak tmpfs
+        # fit-over-fit (ProcessActor.kill sweeps too; this covers
+        # backend adapters whose kill path never reaches it).
+        try:
+            from ray_lightning_tpu.cluster.shm import sweep_stale_segments
+
+            swept = sweep_stale_segments()
+            if swept:
+                log.debug("%s: swept %d stale shm segments", why, swept)
+        except Exception as e:  # noqa: BLE001 - janitorial only
+            log.debug("%s: shm sweep failed: %r", why, e)
 
     def _respawn_workers(self) -> None:
         """Kill every current worker (peers of a dead one may be stuck in
@@ -1187,6 +1201,350 @@ class RayShardedStrategy(TpuStrategy):
             )
             zero_stage = 1
         self.zero_stage = zero_stage
+
+
+class MpmdStrategy(TpuStrategy):
+    """MPMD pipeline parallelism: one actor per pipeline stage, each
+    with its OWN mesh and separately compiled programs (mesh-of-meshes,
+    the JaxPP shape — docs/ARCHITECTURE.md round 12).
+
+    Unlike the SPMD strategies there is no shared jitted program and no
+    ``jax.distributed`` world: stage workers exchange activations and
+    activation-gradients over the :mod:`~ray_lightning_tpu.mpmd.transfer`
+    lane (shared-memory segments same-host, TCP queues across DCN) and
+    follow explicit per-worker instruction streams
+    (:mod:`~ray_lightning_tpu.mpmd.schedule`).
+
+    Knobs: ``num_stages`` (= worker actors), ``schedule`` ("gpipe" |
+    "1f1b"), ``num_microbatches``, ``interleave`` (model chunks per
+    worker — the 1F1B-interleaved bubble shrink), ``devices_per_stage``
+    (CPU simulation: virtual device count per stage actor),
+    ``ckpt_every_n_steps`` (per-stage restart checkpoints — the
+    restart governor resumes at the newest step EVERY stage persisted).
+
+    The elastic machinery is inherited: a dead stage actor raises
+    ``ActorDiedError`` into the same sliding-window restart governor,
+    and a drain request makes every stage write a step-exact drain
+    checkpoint and exit with ``PreemptedError``.
+
+    Fit-only: eval/predict have no pipeline formulation here yet (run
+    them through an SPMD strategy on the reassembled params).
+    """
+
+    mode = "mpmd"
+
+    def __init__(
+        self,
+        num_stages: int = 2,
+        schedule: str = "1f1b",
+        num_microbatches: int = 8,
+        interleave: int = 1,
+        devices_per_stage: Optional[int] = None,
+        recv_timeout_s: float = 120.0,
+        ckpt_every_n_steps: int = 1,
+        tx_factory: Optional[Callable[[], Any]] = None,
+        **kwargs: Any,
+    ):
+        from ray_lightning_tpu.mpmd.schedule import SCHEDULES
+
+        if num_stages < 1:
+            raise ValueError("num_stages must be >= 1")
+        if schedule not in SCHEDULES:
+            raise ValueError(
+                f"unknown schedule {schedule!r} (expected one of "
+                f"{SCHEDULES})"
+            )
+        if interleave < 1:
+            raise ValueError("interleave must be >= 1")
+        if interleave > 1 and schedule != "1f1b":
+            raise ValueError(
+                "interleave > 1 requires schedule='1f1b' (interleaved "
+                "GPipe would deepen the pipe without shrinking the "
+                "bubble)"
+            )
+        if interleave > 1 and num_stages < 2:
+            raise ValueError(
+                "interleave > 1 needs num_stages >= 2: a single worker "
+                "has no pipeline to overlap, and its chunk handoffs "
+                "would need a self-loop transfer lane the actor plane "
+                "does not wire"
+            )
+        if num_microbatches < 1:
+            raise ValueError("num_microbatches must be >= 1")
+        if ckpt_every_n_steps < 1:
+            raise ValueError("ckpt_every_n_steps must be >= 1")
+        kwargs.setdefault("use_tpu", devices_per_stage is None)
+        super().__init__(num_workers=num_stages, **kwargs)
+        self.schedule = schedule
+        self.num_microbatches = num_microbatches
+        self.interleave = interleave
+        self.devices_per_stage = devices_per_stage
+        self.recv_timeout_s = recv_timeout_s
+        self.ckpt_every_n_steps = ckpt_every_n_steps
+        self.tx_factory = tx_factory
+        # Post-fit pipeline report (schedule, per-stage occupancy, the
+        # measured-cost bubble decomposition) — the mpmd analogue of
+        # trainer.telemetry_report.
+        self.mpmd_report: Dict[str, Any] = {}
+        self._live_stage_items: Dict[int, Dict[str, Any]] = {}
+        self._live_written_at = 0.0
+        self._live_dir: Optional[str] = None
+        if devices_per_stage is not None:
+            # CPU-simulated stage meshes: each stage ACTOR gets its own
+            # virtual device count (its private "mesh"), replacing any
+            # inherited test-harness value.
+            import re as _re
+
+            flags = os.environ.get("XLA_FLAGS", "")
+            flags = _re.sub(
+                r"--xla_force_host_platform_device_count=\d+", "", flags
+            ).strip()
+            self.env_per_worker.setdefault(
+                "XLA_FLAGS",
+                (f"{flags} --xla_force_host_platform_device_count="
+                 f"{devices_per_stage}").strip(),
+            )
+
+    # The live monitor rides run_fit's heartbeat publisher, which stage
+    # workers do not run — the mpmd_stage stream is their live plane.
+    def _build_monitor(self, kind, config, trainer):
+        return None
+
+    def run(self, kind, module, datamodule, config, callbacks,
+            trainer=None, params_stream=None, ckpt_path=None):
+        if kind != "fit":
+            raise NotImplementedError(
+                "MpmdStrategy supports fit only; run validate/test/"
+                "predict through an SPMD strategy on the trained params"
+            )
+        return super().run(
+            kind, module, datamodule, config, callbacks, trainer=trainer,
+            params_stream=params_stream, ckpt_path=ckpt_path,
+        )
+
+    def _latest_restart_checkpoint(self, restart_dir) -> Dict[str, Any]:
+        from ray_lightning_tpu.mpmd.worker import latest_mpmd_checkpoint
+
+        return latest_mpmd_checkpoint(restart_dir, self.num_workers)
+
+    # -- live export ---------------------------------------------------------
+    def _on_mpmd_item(self, item: Any) -> None:
+        if not (isinstance(item, dict)
+                and item.get("type") == "mpmd_stage"):
+            return
+        self._live_stage_items[int(item.get("stage", -1))] = item
+        now = time.monotonic()
+        if self._live_dir is None or now - self._live_written_at < 0.5:
+            return
+        self._live_written_at = now
+        self._write_live_snapshot()
+
+    def _live_snapshot(self) -> Dict[str, Any]:
+        stages = [
+            self._live_stage_items[k]
+            for k in sorted(self._live_stage_items)
+        ]
+        return {
+            "ts": time.time(),
+            "mpmd": {
+                "schedule": self.schedule,
+                "interleave": self.interleave,
+                "n_micro": self.num_microbatches,
+                "n_stages": self.num_workers,
+                "stages": stages,
+            },
+        }
+
+    def _write_live_snapshot(self) -> None:
+        import json
+
+        if self._live_dir is None:
+            return
+        try:
+            os.makedirs(self._live_dir, exist_ok=True)
+            path = os.path.join(self._live_dir, "mpmd-live.json")
+            tmp = f"{path}.tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(self._live_snapshot(), f)
+            os.replace(tmp, path)
+        except OSError as e:
+            log.debug("mpmd live snapshot write failed: %r", e)
+
+    def _run_once(
+        self,
+        kind: str,
+        module,
+        datamodule,
+        config: FitConfig,
+        callbacks: List,
+        trainer=None,
+        params_stream: Optional[bytes] = None,
+        ckpt_path: Optional[str] = None,
+    ) -> List[Dict[str, Any]]:
+        import numpy as np
+
+        from ray_lightning_tpu.mpmd import worker as mpmd_worker
+        from ray_lightning_tpu.mpmd.plan import (
+            StagePlan,
+            resolve_mpmd_spec,
+        )
+        from ray_lightning_tpu.mpmd.schedule import (
+            fleet_pipeline_stats,
+            measured_schedule_bubble,
+            pool_op_costs,
+        )
+
+        spec = resolve_mpmd_spec(module)  # fail fast, driver-side
+        plan = StagePlan.split(
+            spec.n_layers, self.num_workers * self.interleave
+        )
+        self._live_stage_items = {}
+        self._live_dir = os.path.join(
+            config.default_root_dir, "telemetry"
+        )
+
+        is_local = isinstance(self._backend, backend_mod.LocalBackend)
+        addrs = [
+            w.execute(mpmd_worker._remote_create_inbox, is_local)
+            for w in self._workers
+        ]
+        task = {
+            "module": module,
+            "datamodule": datamodule,
+            "config": config,
+            "n_workers": self.num_workers,
+            "interleave": self.interleave,
+            "n_micro": self.num_microbatches,
+            "schedule": self.schedule,
+            "mesh_axes": self.mesh_axes,
+            "same_host": is_local,
+            "recv_timeout_s": self.recv_timeout_s,
+            "restart_dir": config.restart_dir,
+            "resume_prefix": config.resume_from_checkpoint,
+            "ckpt_every": self.ckpt_every_n_steps,
+            "steps": (
+                config.max_steps if config.max_steps
+                and config.max_steps > 0 else None
+            ),
+            "tx_factory": self.tx_factory,
+        }
+        task_ref = self._backend.put(task)
+        queue = self._backend.create_queue()
+        on_item_trainer = getattr(trainer, "_on_stream_item", None)
+
+        def on_item(item):
+            self._on_mpmd_item(item)
+            if on_item_trainer is not None:
+                on_item_trainer(item)
+
+        def _tick() -> None:
+            self._maybe_broadcast_drain()
+
+        futures = []
+        try:
+            futures = [
+                w.submit(
+                    mpmd_worker._stage_execute_remote, task_ref, rank,
+                    queue.handle,
+                    addrs[(rank - 1) % self.num_workers]
+                    if self.num_workers > 1 else None,
+                    addrs[(rank + 1) % self.num_workers]
+                    if self.num_workers > 1 else None,
+                )
+                for rank, w in enumerate(self._workers)
+            ]
+            results = process_results(
+                futures, queue, on_item=on_item, on_tick=_tick
+            )
+        except RemoteError as err:
+            # A dead stage wedges its PEERS' transfer lanes: a peer's
+            # recv-timeout/send-failure can resolve BEFORE the driver
+            # notices the death, surfacing as RemoteError — which would
+            # bypass the restart governor.  If any worker is actually
+            # dead, the death is the root cause: raise it as such.
+            dead = next(
+                (
+                    rank for rank, w in enumerate(self._workers)
+                    if not w.is_alive()
+                ),
+                None,
+            )
+            if dead is not None:
+                raise ActorDiedError(
+                    f"stage worker {dead} died mid-fit (peer error: "
+                    f"{err.args[0].splitlines()[0] if err.args else err})",
+                    rank=dead,
+                ) from err
+            self._enrich_failure(err, futures, None)
+            raise
+        except ActorDiedError as err:
+            self._enrich_failure(err, futures, None)
+            raise
+        finally:
+            queue.shutdown()
+            task_ref.release()
+
+        # -- assemble the rank-0-shaped result package -------------------
+        results = sorted(results, key=lambda r: r["rank"])
+        n_stages = plan.n_stages
+        parts = [
+            results[g % self.num_workers]["chunks"][g // self.num_workers]
+            for g in range(n_stages)
+        ]
+        full_params = spec.assemble_params(parts, plan)
+        loss_result = next(r for r in results if r.get("hosts_loss"))
+        final_step = int(loss_result["final_step"])
+
+        per_stage = [r["stats"] for r in results]
+        costs = pool_op_costs([r["op_costs"] for r in results])
+        report = {
+            "schedule": self.schedule,
+            "interleave": self.interleave,
+            "n_stages": self.num_workers,
+            "n_micro": self.num_microbatches,
+            "steps": final_step,
+            "losses": list(loss_result["losses"]),
+            "per_stage": per_stage,
+            "op_costs_ms": {
+                k: v * 1e3 for k, v in costs.items()
+            },
+            **fleet_pipeline_stats(per_stage),
+        }
+        if costs:
+            report["bubble_fraction"] = measured_schedule_bubble(
+                self.schedule, self.num_workers, self.num_microbatches,
+                self.interleave, costs,
+            )
+        self.mpmd_report = report
+        self._write_live_snapshot()
+
+        from ray_lightning_tpu.core.module import TrainState
+        from ray_lightning_tpu.utils.state_stream import to_state_stream
+
+        state = TrainState(
+            params=full_params,
+            opt_state=None,  # per-stage moments stay with their stages
+            step=np.int32(final_step),
+        )
+        metrics = dict(loss_result["callback_metrics"])
+        metrics.update({
+            "bubble_fraction": report.get("bubble_fraction", 0.0),
+            "stage_occupancy": report["stage_occupancy"],
+        })
+        package = {
+            "rank": 0,
+            "state_stream": to_state_stream(state),
+            "callback_metrics": metrics,
+            "logged_metrics": dict(metrics),
+            "best_model_path": "",
+            "epochs_run": 1,
+            "global_step": final_step,
+            "micro_step": final_step * self.num_microbatches,
+            "callback_states": [],
+            "comm_stats": {},
+            "telemetry": None,
+        }
+        return [package]
 
 
 # Reference-name aliases (≙ ray_lightning's public exports, __init__.py:1-5)

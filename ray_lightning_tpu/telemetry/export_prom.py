@@ -89,8 +89,54 @@ def render_openmetrics(snapshot: Dict[str, Any],
     serve = snapshot.get("serve")
     if serve:
         lines.extend(_render_serve(serve))
+    mpmd = snapshot.get("mpmd")
+    if mpmd:
+        lines.extend(_render_mpmd(mpmd))
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
+
+
+def _render_mpmd(mpmd: Dict[str, Any]) -> list:
+    """The MPMD pipeline plane's section (``mpmd-live.json`` shape —
+    ``telemetry/schema.py::validate_mpmd_snapshot``): per-stage
+    occupancy/bubble gauges plus the pipeline shape."""
+    lines = []
+    for name, help_, key in (
+        ("mpmd_stages", "pipeline stage workers", "n_stages"),
+        ("mpmd_microbatches", "micro-batches per optimizer step",
+         "n_micro"),
+        ("mpmd_interleave", "model chunks per stage worker",
+         "interleave"),
+    ):
+        if key in mpmd:
+            lines.append(f"# TYPE {_PREFIX}_{name} gauge")
+            lines.append(f"# HELP {_PREFIX}_{name} {help_}")
+            lines.append(f"{_PREFIX}_{name} {mpmd[key]}")
+    stages = mpmd.get("stages", [])
+    for metric, help_, key in (
+        ("mpmd_stage_step", "last completed optimizer step", "step"),
+        ("mpmd_stage_bubble_fraction",
+         "idle fraction of the stage's pipeline wall", "bubble_fraction"),
+        ("mpmd_stage_occupancy",
+         "compute fraction of the stage's pipeline wall",
+         "stage_occupancy"),
+        ("mpmd_stage_loss", "last micro-batch-mean loss (loss stage)",
+         "loss"),
+    ):
+        samples = [
+            (item.get("stage"), item[key])
+            for item in stages
+            if isinstance(item.get(key), (int, float))
+        ]
+        if not samples:
+            continue
+        lines.append(f"# TYPE {_PREFIX}_{metric} gauge")
+        lines.append(f"# HELP {_PREFIX}_{metric} {help_}")
+        for stage, value in samples:
+            lines.append(
+                f'{_PREFIX}_{metric}{{stage="{_esc(stage)}"}} {value}'
+            )
+    return lines
 
 
 def _render_serve(serve: Dict[str, Any]) -> list:

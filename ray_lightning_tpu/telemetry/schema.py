@@ -35,6 +35,10 @@ __all__ = [
     "validate_serve_reply",
     "validate_serve_snapshot",
     "validate_bench_serve",
+    "validate_mpmd_stage_item",
+    "validate_mpmd_xfer",
+    "validate_mpmd_snapshot",
+    "validate_bench_mpmd",
     "FLIGHT_BUNDLE_SCHEMA_ID",
 ]
 
@@ -279,6 +283,8 @@ def validate_stream_item(item: Any, where: str = "item") -> List[str]:
         return validate_log_item(item, where)
     if kind == "metrics":
         return []
+    if kind == "mpmd_stage":
+        return validate_mpmd_stage_item(item, where)
     return [f"{where}: unknown stream item type {kind!r}"]
 
 
@@ -468,6 +474,139 @@ def validate_bench_serve(block: Any, where: str = "serve") -> List[str]:
             arm, _BENCH_SERVE_SWEEP_REQUIRED, _BENCH_SERVE_SWEEP_OPTIONAL,
             f"{where}.rate_sweep[{i}]",
         )
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# MPMD pipeline plane (mpmd/): stream items, transfer frames, live
+# snapshot, bench block
+# ---------------------------------------------------------------------------
+
+# Per-optimizer-step stage beat on the worker→driver queue (the MPMD
+# plane's live signal — stage workers run no heartbeat publisher).
+_MPMD_STAGE_REQUIRED = {
+    "type": str,          # always "mpmd_stage"
+    "stage": int,
+    "step": int,
+    "bubble_fraction": (int, float),
+    "stage_occupancy": (int, float),
+}
+_MPMD_STAGE_OPTIONAL = {
+    "loss": (int, float),         # loss-hosting worker only
+    "busy_s": (int, float),
+    "blocked_s": (int, float),
+}
+
+# The inter-stage transfer frame (mpmd/transfer.py wire contract):
+# exactly one of ``data`` (inline payload) / ``shm`` (segment path).
+_MPMD_XFER_REQUIRED = {
+    "type": str,          # always "mpmd_xfer"
+    "kind": str,          # "act" | "grad"
+    "step": int,
+    "mb": int,
+    "chunk": int,
+}
+_MPMD_XFER_OPTIONAL = {
+    "data": bytes,
+    "shm": str,
+}
+
+# mpmd-live.json (MpmdStrategy's live export, the rlt_top mpmd pane).
+_MPMD_SNAPSHOT_REQUIRED = {
+    "schedule": str,
+    "interleave": int,
+    "n_micro": int,
+    "n_stages": int,
+    "stages": list,       # per-stage mpmd_stage items
+}
+
+
+def validate_mpmd_stage_item(item: Any,
+                             where: str = "mpmd_stage") -> List[str]:
+    problems = _validate_typed(
+        item, "mpmd_stage", _MPMD_STAGE_REQUIRED, _MPMD_STAGE_OPTIONAL,
+        where,
+    )
+    if not problems:
+        if item["stage"] < 0:
+            problems.append(f"{where}: negative stage")
+        if not 0.0 <= item["bubble_fraction"] <= 1.0:
+            problems.append(
+                f"{where}: bubble_fraction {item['bubble_fraction']} "
+                "outside [0, 1]"
+            )
+    return problems
+
+
+def validate_mpmd_xfer(item: Any, where: str = "mpmd_xfer") -> List[str]:
+    problems = _validate_typed(
+        item, "mpmd_xfer", _MPMD_XFER_REQUIRED, _MPMD_XFER_OPTIONAL, where
+    )
+    if problems:
+        return problems
+    if item["kind"] not in ("act", "grad"):
+        problems.append(f"{where}: unknown kind {item['kind']!r}")
+    if ("data" in item) == ("shm" in item):
+        problems.append(
+            f"{where}: exactly one of data/shm payload required"
+        )
+    for key in ("step", "mb", "chunk"):
+        if item[key] < 0:
+            problems.append(f"{where}: negative {key}")
+    return problems
+
+
+def validate_mpmd_snapshot(doc: Any,
+                           where: str = "mpmd_snapshot") -> List[str]:
+    """Validate the ``mpmd`` block of a live snapshot document."""
+    problems = _check_fields(doc, _MPMD_SNAPSHOT_REQUIRED, {}, where)
+    if problems:
+        return problems
+    for i, item in enumerate(doc["stages"]):
+        problems += validate_mpmd_stage_item(
+            item, f"{where}.stages[{i}]"
+        )
+    return problems
+
+
+# The bench mpmd block: the pipeline A/B becomes round-over-round
+# comparable only if bubble/throughput are spelled the same way.
+# Headline identification is required; each probe arm is nullable.
+_BENCH_MPMD_REQUIRED = {
+    "schedule": str,
+    "n_stages": int,
+    "n_micro": int,
+}
+_BENCH_MPMD_OPTIONAL = {
+    "interleave": int,
+    "bubble_fraction": (int, float, type(None)),
+    "gpipe_bubble_fraction": (int, float, type(None)),
+    "stage_occupancy": (int, float, type(None)),
+    "stage_skew_ms": (int, float, type(None)),
+    "tokens_per_sec": (int, float, type(None)),
+    "single_mesh_tokens_per_sec": (int, float, type(None)),
+    "vs_single_mesh": (int, float, type(None)),
+    "loss_parity_max_diff": (int, float, type(None)),
+    "op_costs_ms": dict,
+}
+
+
+def validate_bench_mpmd(block: Any, where: str = "mpmd") -> List[str]:
+    """Validate the ``mpmd`` block of a ``BENCH_*.json`` artifact
+    (absent on pre-MPMD rounds)."""
+    problems = _check_fields(
+        block, _BENCH_MPMD_REQUIRED, _BENCH_MPMD_OPTIONAL, where
+    )
+    if problems:
+        return problems
+    if block["n_stages"] < 1:
+        problems.append(f"{where}: n_stages must be >= 1")
+    if block["n_micro"] < 1:
+        problems.append(f"{where}: n_micro must be >= 1")
+    for key in ("bubble_fraction", "gpipe_bubble_fraction"):
+        value = block.get(key)
+        if isinstance(value, (int, float)) and not 0 <= value <= 1:
+            problems.append(f"{where}: {key} {value} outside [0, 1]")
     return problems
 
 

@@ -1,0 +1,822 @@
+"""MPMD pipeline plane (ISSUE 7): plans, schedules, transfer lane,
+stage execution, parity, fault integration.
+
+Layer map:
+
+* **plan/schedule units** — split math (incl. non-divisible), stream
+  structure, deadlock-freedom by simulation, the interleaved-1F1B
+  bubble win, measured-bubble accounting;
+* **transfer units** — mailbox rendezvous, TCP inbox round-trips, shm
+  payload routing, the chunked/size-scaled queue sends (satellite);
+* **integration (all slow-marked — the 870s tier-1 budget barely fits
+  the pre-existing sweep on this container)** — the in-process
+  2-worker pipeline fits (1f1b / gpipe / interleaved / P=1 / M<P)
+  matching the single-mesh SPMD GPipe reference to atol 1e-5, and the
+  real actor plane: MpmdStrategy fit parity and the chaos stage-kill →
+  restart-governor → step-exact-resume acceptance.  The same parity
+  gates also run on every driver pass via the ``dryrun_multichip``
+  mpmd flavor.
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ray_lightning_tpu.mpmd.plan import StagePlan
+from ray_lightning_tpu.mpmd.schedule import (
+    BWD,
+    FWD,
+    Instr,
+    build_schedule,
+    build_streams,
+    bubble_from_timeline,
+    fleet_pipeline_stats,
+    measured_schedule_bubble,
+    pool_op_costs,
+    simulate_streams,
+    validate_streams,
+)
+from ray_lightning_tpu.mpmd.transfer import (
+    LocalChannel,
+    Mailbox,
+    QueueChannel,
+    StageInbox,
+)
+from ray_lightning_tpu.parallel.pipeline import layer_splits
+
+pytestmark = pytest.mark.mpmd
+
+
+# ---------------------------------------------------------------------------
+# Plan / split math
+# ---------------------------------------------------------------------------
+
+def test_layer_splits_divisible():
+    assert layer_splits(8, 4) == (0, 2, 4, 6, 8)
+    assert layer_splits(4, 1) == (0, 4)
+
+
+def test_layer_splits_remainder_front_loaded():
+    assert layer_splits(7, 3) == (0, 3, 5, 7)
+    assert layer_splits(5, 4) == (0, 2, 3, 4, 5)
+
+
+def test_layer_splits_errors():
+    with pytest.raises(ValueError, match="not divisible"):
+        layer_splits(7, 3, require_divisible=True)
+    with pytest.raises(ValueError, match="cannot fill"):
+        layer_splits(2, 3)
+    with pytest.raises(ValueError, match="n_stages"):
+        layer_splits(4, 0)
+
+
+def test_stage_plan_bounds_and_slice():
+    import jax.numpy as jnp
+
+    plan = StagePlan.split(7, 3)
+    assert plan.stage_bounds(0) == (0, 3)
+    assert plan.stage_bounds(2) == (5, 7)
+    tree = {"w": jnp.arange(7)}
+    assert list(plan.slice_stacked(tree, 1)["w"]) == [3, 4]
+    with pytest.raises(ValueError, match="out of range"):
+        plan.stage_bounds(3)
+
+
+def _tiny_gpt(n_layer=2):
+    from ray_lightning_tpu.models.gpt import GPT, GPTConfig
+
+    cfg = GPTConfig(vocab_size=32, n_layer=n_layer, n_head=2,
+                    d_model=16, seq_len=8, warmup_steps=2)
+    module = GPT(cfg, attn_impl="xla")
+    module.precision = "f32"
+    return module, cfg
+
+
+def test_gpt_spec_split_assemble_roundtrip():
+    import jax
+
+    from ray_lightning_tpu.mpmd.plan import _gpt_untie, gpt_mpmd_spec
+
+    module, _ = _tiny_gpt()
+    spec = gpt_mpmd_spec(module)
+    full = _gpt_untie(module.init_params(jax.random.PRNGKey(0)))
+    plan = StagePlan.split(spec.n_layers, 2)
+    parts = [spec.split_params(full, plan, p) for p in range(2)]
+    assert "wte" in parts[0] and "wte" not in parts[1]
+    assert "head_w" in parts[1] and "head_w" not in parts[0]
+    rebuilt = spec.assemble_params(parts, plan)
+    for key in ("wte", "wpe", "ln_f_g", "ln_f_b", "head_w"):
+        np.testing.assert_array_equal(
+            np.asarray(rebuilt[key]), np.asarray(full[key])
+        )
+    for key, leaf in full["blocks"].items():
+        np.testing.assert_array_equal(
+            np.asarray(rebuilt["blocks"][key]), np.asarray(leaf)
+        )
+
+
+def test_resolve_spec_rejects_unknown_module():
+    from ray_lightning_tpu.mpmd.plan import resolve_mpmd_spec
+
+    with pytest.raises(TypeError, match="mpmd_spec"):
+        resolve_mpmd_spec(object())
+
+
+# ---------------------------------------------------------------------------
+# Schedules
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", ["gpipe", "1f1b"])
+@pytest.mark.parametrize("n_stages,n_micro", [(1, 4), (2, 8), (4, 3)])
+def test_streams_validate_and_simulate(name, n_stages, n_micro):
+    streams = build_streams(name, n_stages, n_micro)
+    assert validate_streams(streams, n_micro) == []
+    sim = simulate_streams(streams, transfer_s=0.1)
+    assert sim["makespan"] > 0
+
+
+def test_1f1b_warmup_counts():
+    streams = build_streams("1f1b", 4, 8)
+    for p, stream in enumerate(streams):
+        # Forwards before the first BWD = the stage's warmup depth plus
+        # the first steady-state forward.
+        first_bwd = next(
+            i for i, instr in enumerate(stream) if instr.op == BWD
+        )
+        fwds_before = sum(
+            1 for instr in stream[:first_bwd] if instr.op == FWD
+        )
+        assert fwds_before == min(4 - 1 - p, 8) + 1
+
+
+def test_gpipe_peak_stash_is_m_and_1f1b_is_bounded():
+    """The memory story: count in-flight forwarded-not-backwarded
+    micro-batches along each stream."""
+    def peak_live(stream):
+        live = peak = 0
+        for instr in stream:
+            if instr.op == FWD:
+                live += 1
+                peak = max(peak, live)
+            elif instr.op == BWD:
+                live -= 1
+        return peak
+
+    gpipe0 = build_schedule("gpipe", 0, 4, 8)
+    f1b0 = build_schedule("1f1b", 0, 4, 8)
+    assert peak_live(gpipe0) == 8          # all M stashed
+    assert peak_live(f1b0) == 4            # bounded by P
+    assert peak_live(build_schedule("1f1b", 3, 4, 8)) == 1
+
+
+@pytest.mark.parametrize("n_workers,interleave", [(2, 2), (2, 4), (3, 2)])
+def test_interleaved_streams_structurally_valid(n_workers, interleave):
+    streams = build_streams("1f1b", n_workers, 8, interleave=interleave)
+    assert validate_streams(streams, 8, interleave=interleave) == []
+    # Deadlock-freedom is timing-independent for fixed total orders:
+    # one successful simulation certifies the stream.
+    simulate_streams(streams, transfer_s=0.3, interleave=interleave)
+
+
+def test_interleaved_bubble_beats_gpipe_structurally():
+    costs = {FWD: 1.0, BWD: 2.0, "SEND_ACT": 0.05}
+    g = simulate_streams(build_streams("gpipe", 2, 8), costs,
+                         transfer_s=0.1)
+    i = simulate_streams(
+        build_streams("1f1b", 2, 8, interleave=2),
+        {FWD: 0.5, BWD: 1.0, "SEND_ACT": 0.05},
+        transfer_s=0.1, interleave=2,
+    )
+    assert i["bubble_fraction"] < g["bubble_fraction"]
+    # And through the measured-cost entry point the dryrun/bench use:
+    mi = measured_schedule_bubble(
+        "1f1b", 2, 8, 2, {"FWD": 0.5, "BWD": 1.0, "SEND": 0.05}
+    )
+    mg = measured_schedule_bubble(
+        "gpipe", 2, 8, 1, {"FWD": 1.0, "BWD": 2.0, "SEND": 0.05}
+    )
+    assert mi < mg
+
+
+def test_simulate_detects_deadlock():
+    # Two workers that each RECV before anyone sends: a cyclic wait.
+    streams = [
+        [Instr("RECV_GRAD", 0), Instr(FWD, 0), Instr("SEND_ACT", 0),
+         Instr(BWD, 0), Instr("UPDATE")],
+        [Instr("RECV_ACT", 0), Instr(FWD, 0), Instr(BWD, 0),
+         Instr("SEND_GRAD", 0), Instr("UPDATE")],
+    ]
+    with pytest.raises(RuntimeError, match="deadlock"):
+        simulate_streams(streams)
+
+
+def test_build_streams_rejects_bad_shapes():
+    with pytest.raises(ValueError, match="unknown pipeline schedule"):
+        build_streams("zigzag", 2, 4)
+    with pytest.raises(ValueError, match="requires the '1f1b'"):
+        build_streams("gpipe", 2, 4, interleave=2)
+    with pytest.raises(ValueError, match="n_micro"):
+        build_schedule("gpipe", 0, 2, 0)
+
+
+def test_bubble_from_timeline_math():
+    # 2s wall (t=0..2 to UPDATE), 1.2s busy -> bubble 0.4.
+    timeline = [
+        {"op": FWD, "mb": 0, "t0": 0.0, "t1": 0.7, "blocked_s": 0.0},
+        {"op": "RECV_GRAD", "mb": 0, "t0": 0.7, "t1": 1.5,
+         "blocked_s": 0.8},
+        {"op": BWD, "mb": 0, "t0": 1.5, "t1": 2.0, "blocked_s": 0.0},
+        {"op": "UPDATE", "mb": -1, "t0": 2.0, "t1": 2.3,
+         "blocked_s": 0.0},
+    ]
+    s = bubble_from_timeline(timeline)
+    assert s["bubble_fraction"] == pytest.approx(0.4)
+    assert s["stage_occupancy"] == pytest.approx(0.6)
+    assert s["blocked_s"] == pytest.approx(0.8)
+    assert bubble_from_timeline([])["bubble_fraction"] == 0.0
+
+
+def test_fleet_pipeline_stats_skew():
+    stats = fleet_pipeline_stats([
+        {"bubble_fraction": 0.1, "stage_occupancy": 0.9, "busy_s": 1.0},
+        {"bubble_fraction": 0.3, "stage_occupancy": 0.7, "busy_s": 1.5},
+    ])
+    assert stats["bubble_fraction"] == pytest.approx(0.2)
+    assert stats["stage_skew_ms"] == pytest.approx(500.0)
+
+
+def test_pool_op_costs_median():
+    pooled = pool_op_costs([
+        {"FWD": 1.0, "BWD": 2.0}, {"FWD": 3.0}, {"FWD": 2.0},
+    ])
+    assert pooled["FWD"] == 2.0
+    assert pooled["BWD"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# Transfer lane
+# ---------------------------------------------------------------------------
+
+def test_mailbox_rendezvous_and_blocked_accounting():
+    box = Mailbox()
+
+    def deliver_later():
+        time.sleep(0.15)
+        box.deliver(("act", 0, 1, 0), {"x": 1})
+
+    threading.Thread(target=deliver_later).start()
+    payload, blocked = box.recv(("act", 0, 1, 0), timeout=5.0)
+    assert payload == {"x": 1}
+    assert blocked >= 0.1
+
+
+def test_mailbox_timeout_and_poison():
+    box = Mailbox()
+    with pytest.raises(TimeoutError, match="peer stage"):
+        box.recv(("act", 0, 0, 0), timeout=0.1)
+    box.fail(RuntimeError("peer died"))
+    with pytest.raises(RuntimeError, match="transfer lane failed"):
+        box.recv(("act", 0, 0, 0), timeout=1.0)
+
+
+def test_inbox_queue_channel_roundtrip_tcp():
+    inbox = StageInbox()
+    try:
+        chan = QueueChannel(inbox.handle, same_host=False)
+        tree = {"a": np.arange(6, dtype=np.float32).reshape(2, 3)}
+        chan.send("act", 2, 1, tree, chunk=1)
+        got, _ = inbox.mailbox.recv(("act", 2, 1, 1), timeout=10.0)
+        np.testing.assert_array_equal(got["a"], tree["a"])
+        assert chan.bytes_sent > 0 and chan.shm_sends == 0
+        chan.close()
+    finally:
+        inbox.close()
+
+
+def test_inbox_shm_payload_routing():
+    from ray_lightning_tpu.cluster.shm import segment_dir
+
+    inbox = StageInbox()
+    try:
+        chan = QueueChannel(inbox.handle, same_host=True, shm_threshold=64)
+        tree = {"a": np.ones((64, 64), np.float32)}
+        chan.send("grad", 0, 3, tree)
+        got, _ = inbox.mailbox.recv(("grad", 0, 3, 0), timeout=10.0)
+        np.testing.assert_array_equal(got["a"], tree["a"])
+        assert chan.shm_sends == 1
+        # The consumer unlinks the segment after the read.
+        time.sleep(0.1)
+        leftovers = [
+            e for e in os.listdir(segment_dir())
+            if e.startswith(f"rlt-seg-{os.getpid()}-")
+        ]
+        assert leftovers == []
+        chan.close()
+    finally:
+        inbox.close()
+
+
+def test_local_channel_chunk_keys():
+    box = Mailbox()
+    chan = LocalChannel(box)
+    chan.send("act", 1, 2, {"x": np.float32(3.0)}, chunk=1)
+    assert not box.ready(("act", 1, 2, 0))
+    got, _ = box.recv(("act", 1, 2, 1), timeout=1.0)
+    assert float(got["x"]) == 3.0
+
+
+# ---------------------------------------------------------------------------
+# Queue satellite: chunked sends + size-scaled budgets
+# ---------------------------------------------------------------------------
+
+def test_send_timeout_scales_with_payload():
+    from ray_lightning_tpu.cluster import queue as queue_mod
+
+    assert queue_mod._send_timeout_s(0) == queue_mod._ACK_TIMEOUT_S
+    big = 512 << 20
+    assert queue_mod._send_timeout_s(big) == pytest.approx(
+        big / queue_mod._MIN_SEND_THROUGHPUT
+    )
+    assert queue_mod._send_timeout_s(big) > queue_mod._ACK_TIMEOUT_S
+
+
+def test_chunked_send_survives_throttled_reader(monkeypatch):
+    """A slow consumer that would trip a single whole-payload timeout
+    must NOT trip the per-chunk budgets (satellite: one slow multi-MB
+    activation can't kill the lane)."""
+    from ray_lightning_tpu.cluster import queue as queue_mod
+
+    # Shrink the world: 64 KiB chunks, ~0.2 s per-chunk budget.
+    monkeypatch.setattr(queue_mod, "_ACK_TIMEOUT_S", 0.2)
+    chunk = 64 << 10
+    payload = os.urandom(6 * chunk)
+    a, b = socket.socketpair()
+    a.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, 32 << 10)
+    b.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 32 << 10)
+    got = []
+
+    def slow_reader():
+        while sum(len(c) for c in got) < len(payload):
+            data = b.recv(16 << 10)
+            if not data:
+                return
+            got.append(data)
+            time.sleep(0.02)  # ~8x slower than the per-chunk budget
+            # would allow for the WHOLE payload in one timeout window
+
+    t = threading.Thread(target=slow_reader)
+    t.start()
+    try:
+        # Control: the whole payload under ONE per-chunk-sized timeout
+        # budget cannot finish against this reader...
+        total_budget = queue_mod._send_timeout_s(chunk)
+        assert total_budget < 0.3
+        # ...but the chunked path re-arms the clock per slice.
+        queue_mod._sendall_chunked(a, payload, chunk_bytes=chunk)
+    finally:
+        t.join(timeout=30)
+        a.close()
+        b.close()
+    assert sum(len(c) for c in got) == len(payload)
+    assert b"".join(got) == payload
+
+
+def test_queue_put_chunked_roundtrip(monkeypatch):
+    """A multi-chunk payload arrives intact through the real
+    DriverQueue server (frame header + chunked body must reassemble)."""
+    from ray_lightning_tpu.cluster import queue as queue_mod
+
+    monkeypatch.setattr(queue_mod, "_SEND_CHUNK_BYTES", 32 << 10)
+    q = queue_mod.DriverQueue()
+    try:
+        handle = q.handle
+        blob = os.urandom(300 << 10)  # ~10 chunks
+        handle.put({"blob": blob})
+        item = q.get(timeout=30)
+        assert item["blob"] == blob
+        handle.close()
+    finally:
+        q.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# shm sweep satellite
+# ---------------------------------------------------------------------------
+
+def test_sweep_reclaims_killed_producer_segments(tmp_path):
+    """kill -9 a segment producer; the sweep must reclaim its tmpfs."""
+    from ray_lightning_tpu.cluster.shm import (
+        segment_dir,
+        sweep_stale_segments,
+    )
+
+    code = (
+        "from ray_lightning_tpu.cluster.shm import SegmentStore\n"
+        "import sys, time\n"
+        "store = SegmentStore(prefix='rlt-seg')\n"
+        "path = store.put(b'x' * 4096)\n"
+        "print(path, flush=True)\n"
+        "time.sleep(60)\n"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code], stdout=subprocess.PIPE,
+        env={**os.environ, "PYTHONPATH": os.pathsep.join(sys.path)},
+    )
+    try:
+        path = proc.stdout.readline().decode().strip()
+        assert os.path.exists(path), "producer failed to create a segment"
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+        # atexit never ran (SIGKILL): the segment is orphaned until the
+        # sweep runs.
+        assert os.path.exists(path)
+        assert sweep_stale_segments() >= 1
+        assert not os.path.exists(path)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert segment_dir()  # smoke: helper stays importable
+
+
+def test_kill_workers_sweeps_stale_segments():
+    """The strategy's kill path reclaims segments of dead pids even
+    when no worker objects survive to tear down."""
+    from ray_lightning_tpu.cluster.shm import segment_dir
+    from ray_lightning_tpu.parallel.strategies import MpmdStrategy
+
+    # Fabricate a stale segment owned by a definitely-dead pid (the
+    # name format is what the sweeper matches).
+    dead_pid = 2 ** 22 + 12345  # beyond pid_max on this container
+    path = os.path.join(
+        segment_dir(), f"rlt-seg-{dead_pid}-{'0' * 32}"
+    )
+    with open(path, "wb") as f:
+        f.write(b"stale")
+    try:
+        strategy = MpmdStrategy(num_stages=1, devices_per_stage=1)
+        strategy._kill_workers(why="test")
+        assert not os.path.exists(path)
+    finally:
+        if os.path.exists(path):
+            os.unlink(path)
+
+
+# ---------------------------------------------------------------------------
+# Chaos grammar stage pin + strategy validation
+# ---------------------------------------------------------------------------
+
+def test_fault_grammar_stage_alias():
+    from ray_lightning_tpu.fault.inject import parse_faults
+
+    (spec,) = parse_faults("crash@stage:1,step:3")
+    assert spec.rank == 1 and spec.step == 3
+
+
+def test_mpmd_strategy_eager_validation():
+    from ray_lightning_tpu.parallel.strategies import MpmdStrategy
+
+    with pytest.raises(ValueError, match="unknown schedule"):
+        MpmdStrategy(schedule="zigzag")
+    with pytest.raises(ValueError, match="requires schedule='1f1b'"):
+        MpmdStrategy(schedule="gpipe", interleave=2)
+    with pytest.raises(ValueError, match="num_microbatches"):
+        MpmdStrategy(num_microbatches=0)
+    strategy = MpmdStrategy(num_stages=2, devices_per_stage=2)
+    with pytest.raises(NotImplementedError, match="fit only"):
+        strategy.run("validation", None, None, None, [])
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint discovery
+# ---------------------------------------------------------------------------
+
+def _write_stage_ckpt(tmp_path, step, stage, payload=b"ok"):
+    from ray_lightning_tpu.mpmd.stage import stage_ckpt_name
+    from ray_lightning_tpu.utils.state_stream import (
+        state_stream_to_file,
+        to_state_stream,
+    )
+
+    path = tmp_path / stage_ckpt_name(step, stage)
+    state_stream_to_file(
+        to_state_stream({"state": {"x": np.zeros(2)}, "step": step}),
+        str(path),
+    )
+    return path
+
+
+def test_latest_mpmd_checkpoint_walks_back(tmp_path):
+    from ray_lightning_tpu.mpmd.worker import latest_mpmd_checkpoint
+
+    assert latest_mpmd_checkpoint(str(tmp_path), 2)["path"] is None
+    # Step 2: complete and valid.  Step 3: stage 1 missing (died
+    # mid-write).  Step 4: complete but stage 0's file is corrupt.
+    for stage in (0, 1):
+        _write_stage_ckpt(tmp_path, 2, stage)
+    _write_stage_ckpt(tmp_path, 3, 0)
+    for stage in (0, 1):
+        _write_stage_ckpt(tmp_path, 4, stage)
+    bad = tmp_path / "mpmd-step00000004-stage0.ckpt"
+    blob = bytearray(bad.read_bytes())
+    blob[len(blob) // 2] ^= 0x01
+    bad.write_bytes(bytes(blob))
+
+    info = latest_mpmd_checkpoint(str(tmp_path), 2)
+    assert info["path"].endswith("mpmd-step00000002")
+    assert any("stage0" in c["path"] for c in info["corrupt"])
+
+
+# ---------------------------------------------------------------------------
+# Telemetry surfaces
+# ---------------------------------------------------------------------------
+
+def test_prom_and_rlt_top_render_mpmd():
+    import importlib.util
+
+    from ray_lightning_tpu.telemetry.export_prom import render_openmetrics
+
+    beat = {
+        "type": "mpmd_stage", "stage": 0, "step": 5,
+        "bubble_fraction": 0.125, "stage_occupancy": 0.875,
+        "busy_s": 0.2, "blocked_s": 0.01, "loss": 3.5,
+    }
+    snapshot = {
+        "ranks_reporting": 0, "ranks": {},
+        "mpmd": {
+            "schedule": "1f1b", "interleave": 2, "n_micro": 8,
+            "n_stages": 2, "stages": [beat],
+        },
+    }
+    text = render_openmetrics(snapshot)
+    assert 'rlt_mpmd_stage_bubble_fraction{stage="0"} 0.125' in text
+    assert "rlt_mpmd_stages 2" in text
+    assert text.rstrip().endswith("# EOF")
+
+    spec = importlib.util.spec_from_file_location(
+        "rlt_top", os.path.join(
+            os.path.dirname(__file__), "..", "tools", "rlt_top.py"
+        )
+    )
+    rlt_top = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rlt_top)
+    frame = rlt_top.render({"mpmd": snapshot["mpmd"]}, "x")
+    assert "mpmd pipeline" in frame
+    assert "1f1b x2" in frame
+
+
+def test_mpmd_schema_validators():
+    from ray_lightning_tpu.telemetry.schema import (
+        validate_bench_mpmd,
+        validate_mpmd_xfer,
+        validate_stream_item,
+    )
+
+    beat = {
+        "type": "mpmd_stage", "stage": 1, "step": 0,
+        "bubble_fraction": 0.2, "stage_occupancy": 0.8,
+    }
+    assert validate_stream_item(beat) == []
+    assert validate_stream_item({**beat, "bubble_fraction": 2.0})
+    xfer = {"type": "mpmd_xfer", "kind": "act", "step": 0, "mb": 1,
+            "chunk": 0, "data": b"x"}
+    assert validate_mpmd_xfer(xfer) == []
+    assert validate_mpmd_xfer({**xfer, "kind": "weird"})
+    assert validate_bench_mpmd(
+        {"schedule": "gpipe", "n_stages": 2, "n_micro": 8}
+    ) == []
+    assert validate_bench_mpmd({"schedule": "gpipe"})
+
+
+# ---------------------------------------------------------------------------
+# In-process pipeline fit: the fast parity gate
+# ---------------------------------------------------------------------------
+
+def _parity_setup(n_layer=2):
+    import jax
+
+    from ray_lightning_tpu.mpmd.plan import _gpt_untie, gpt_mpmd_spec
+
+    module, cfg = _tiny_gpt(n_layer)
+    spec = gpt_mpmd_spec(module)
+    full = _gpt_untie(module.init_params(jax.random.PRNGKey(0)))
+    rng = np.random.default_rng(7)
+    steps, bsz = 2, 8
+    data = [
+        {"tokens": rng.integers(
+            0, cfg.vocab_size, (bsz, cfg.seq_len + 1)).astype(np.int32)}
+        for _ in range(steps)
+    ]
+    return module, spec, full, data, steps
+
+
+def _reference_losses(spec, full, data, steps, n_micro, devices):
+    from ray_lightning_tpu.mpmd.reference import gpipe_reference_fit
+
+    return gpipe_reference_fit(
+        spec, full, spec.tx_factory(), lambda s: data[s], steps,
+        n_stages=2, n_micro=n_micro, devices=devices,
+    )
+
+
+@pytest.mark.slow
+def test_inproc_pipeline_fit_matches_single_mesh_gpipe(cpu_mesh_devices):
+    from ray_lightning_tpu.mpmd.inproc import run_inproc_pipeline_fit
+
+    module, spec, full, data, steps = _parity_setup()
+    devices = cpu_mesh_devices
+    res = run_inproc_pipeline_fit(
+        spec, full, spec.tx_factory, lambda s: data[s], steps,
+        n_workers=2, n_micro=4, schedule="1f1b",
+        device_groups=[devices[0:2], devices[2:4]],
+    )
+    ref = _reference_losses(spec, full, data, steps, 4, devices[:2])
+    np.testing.assert_allclose(
+        res["losses"], ref["losses"], rtol=0, atol=1e-5
+    )
+    assert res["final_step"] == steps
+    # Reassembled params match the single-program fit too.
+    np.testing.assert_allclose(
+        np.asarray(res["params"]["wte"]),
+        np.asarray(ref["state"].params["wte"]),
+        atol=1e-5,
+    )
+    # Every stage produced steady-state stats.
+    assert len(res["per_stage_stats"]) == 2
+    assert all(
+        0 <= s["bubble_fraction"] <= 1 for s in res["per_stage_stats"]
+    )
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("schedule,interleave", [
+    ("gpipe", 1), ("1f1b", 2),
+])
+def test_inproc_schedule_flavors_parity(cpu_mesh_devices, schedule,
+                                        interleave):
+    from ray_lightning_tpu.mpmd.inproc import run_inproc_pipeline_fit
+
+    # interleave=2 over 2 workers needs >= 4 stacked layers.
+    module, spec, full, data, steps = _parity_setup(n_layer=4)
+    devices = cpu_mesh_devices
+    res = run_inproc_pipeline_fit(
+        spec, full, spec.tx_factory, lambda s: data[s], steps,
+        n_workers=2, n_micro=4, schedule=schedule, interleave=interleave,
+        device_groups=[devices[0:2], devices[2:4]],
+    )
+    ref = _reference_losses(spec, full, data, steps, 4, devices[:2])
+    np.testing.assert_allclose(
+        res["losses"], ref["losses"], rtol=0, atol=1e-5
+    )
+
+
+@pytest.mark.slow
+def test_inproc_single_stage_degenerate_pipe(cpu_mesh_devices):
+    """P=1: no transport at all, still the same math."""
+    from ray_lightning_tpu.mpmd.inproc import run_inproc_pipeline_fit
+
+    module, spec, full, data, steps = _parity_setup()
+    res = run_inproc_pipeline_fit(
+        spec, full, spec.tx_factory, lambda s: data[s], steps,
+        n_workers=1, n_micro=4, schedule="gpipe",
+        device_groups=[cpu_mesh_devices[0:2]],
+    )
+    ref = _reference_losses(
+        spec, full, data, steps, 4, cpu_mesh_devices[:2]
+    )
+    np.testing.assert_allclose(
+        res["losses"], ref["losses"], rtol=0, atol=1e-5
+    )
+
+
+@pytest.mark.slow
+def test_micro_batches_fewer_than_stages(cpu_mesh_devices):
+    """M < P: the pipeline degrades to mostly-bubble but stays correct
+    (the MPMD analogue of the SPMD edge the parity tests lean on)."""
+    from ray_lightning_tpu.mpmd.inproc import run_inproc_pipeline_fit
+
+    module, spec, full, data, steps = _parity_setup()
+    res = run_inproc_pipeline_fit(
+        spec, full, spec.tx_factory, lambda s: data[s], steps,
+        n_workers=2, n_micro=1, schedule="gpipe",
+        device_groups=None,  # meshless: plain per-stage devices
+    )
+    ref = _reference_losses(
+        spec, full, data, steps, 1, cpu_mesh_devices[:2]
+    )
+    np.testing.assert_allclose(
+        res["losses"], ref["losses"], rtol=0, atol=1e-5
+    )
+
+
+def test_split_micro_batches_rejects_ragged():
+    from ray_lightning_tpu.mpmd.inproc import split_micro_batches
+
+    with pytest.raises(ValueError, match="not divisible"):
+        split_micro_batches({"tokens": np.zeros((7, 4))}, 2)
+
+
+# ---------------------------------------------------------------------------
+# The real actor plane (slow: multi-process fits)
+# ---------------------------------------------------------------------------
+
+def _actor_fit_pieces(tmp_path, max_steps=3, **strategy_kwargs):
+    from ray_lightning_tpu.core.trainer import Trainer
+    from ray_lightning_tpu.models.gpt import SyntheticLMDataModule
+    from ray_lightning_tpu.parallel.strategies import MpmdStrategy
+
+    module, cfg = _tiny_gpt()
+    dm = SyntheticLMDataModule(cfg, batch_size=8, num_batches=4, seed=3)
+    strategy = MpmdStrategy(
+        num_stages=2, schedule="1f1b", num_microbatches=4,
+        devices_per_stage=2, **strategy_kwargs,
+    )
+    trainer = Trainer(
+        strategy=strategy, max_steps=max_steps, max_epochs=1,
+        default_root_dir=str(tmp_path), enable_checkpointing=False,
+    )
+    return module, cfg, dm, strategy, trainer
+
+
+@pytest.mark.slow
+@pytest.mark.remote
+def test_mpmd_strategy_actor_fit_parity(tmp_path):
+    import jax
+
+    from ray_lightning_tpu.mpmd.plan import _gpt_untie, gpt_mpmd_spec
+
+    module, cfg, dm, strategy, trainer = _actor_fit_pieces(tmp_path)
+    trainer.fit(module, dm)
+    assert trainer.global_step == 3
+
+    spec = gpt_mpmd_spec(module)
+    full = _gpt_untie(module.init_params(jax.random.PRNGKey(0)))
+    dm2 = type(dm)(cfg, batch_size=8, num_batches=4, seed=3)
+    dm2.setup("fit")
+    batches = list(dm2.train_dataloader())
+    ref = _reference_losses(
+        spec, full, batches, 3, 4, jax.devices()[:2]
+    )
+    np.testing.assert_allclose(
+        strategy.mpmd_report["losses"], ref["losses"], rtol=0, atol=1e-5
+    )
+    # The report carries the full pipeline story.
+    report = strategy.mpmd_report
+    assert report["schedule"] == "1f1b"
+    assert 0 <= report["bubble_fraction"] <= 1
+    assert "FWD" in report["op_costs_ms"]
+    # Trainer adopted the reassembled params.
+    np.testing.assert_allclose(
+        np.asarray(trainer.params["wte"]),
+        np.asarray(ref["state"].params["wte"]),
+        atol=1e-5,
+    )
+    # Live snapshot landed for rlt_top.
+    live = os.path.join(str(tmp_path), "telemetry", "mpmd-live.json")
+    assert os.path.exists(live)
+    import json
+
+    from ray_lightning_tpu.telemetry.schema import validate_mpmd_snapshot
+
+    with open(live) as f:
+        doc = json.load(f)
+    assert validate_mpmd_snapshot(doc["mpmd"]) == []
+
+
+@pytest.mark.slow
+@pytest.mark.remote
+@pytest.mark.chaos
+def test_mpmd_stage_kill_drives_restart_governor(tmp_path, monkeypatch):
+    """The ISSUE-7 fault acceptance: kill one stage actor mid-fit; the
+    restart governor must respawn the set and resume step-exactly."""
+    state_dir = tmp_path / "fault-state"
+    monkeypatch.setenv("RLT_FAULT", "crash@step:2,stage:1")
+    monkeypatch.setenv("RLT_FAULT_STATE", str(state_dir))
+    module, cfg, dm, strategy, trainer = _actor_fit_pieces(
+        tmp_path / "chaos", max_steps=4, max_restarts=2,
+        restart_backoff_s=0.1,
+    )
+    trainer.fit(module, dm)
+    assert trainer.global_step == 4
+    assert strategy.restarts_used == 1
+    kinds = [e["kind"] for e in strategy.recovery_events]
+    assert "elastic_restart" in kinds
+
+    # Step-exact continuation: the post-resume losses equal an
+    # uninterrupted fit's bitwise (same data, same seeds, same ckpt).
+    monkeypatch.delenv("RLT_FAULT")
+    module2, cfg2, dm2, strategy2, trainer2 = _actor_fit_pieces(
+        tmp_path / "clean", max_steps=4,
+    )
+    trainer2.fit(module2, dm2)
+    resumed = strategy.mpmd_report["losses"]
+    clean = strategy2.mpmd_report["losses"]
+    np.testing.assert_allclose(
+        resumed, clean[-len(resumed):], rtol=0, atol=1e-6
+    )

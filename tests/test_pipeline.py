@@ -99,6 +99,42 @@ def test_pipeline_rejects_ragged_microbatches(data):
         pipeline_apply(_stage, params, x, mesh, num_microbatches=3)
 
 
+def test_pipeline_fewer_microbatches_than_stages(data):
+    """M < P: the drain dominates (bubble (P-1)/(M+P-1)) but the math
+    must stay exact — the MPMD parity tests lean on this edge."""
+    params, x = data
+    mesh = Mesh(np.asarray(jax.devices()[:4]), ("pipe",))
+    out = pipeline_apply(_stage, params, x, mesh, num_microbatches=2)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_reference(params, x)),
+        rtol=1e-6, atol=1e-6,
+    )
+
+
+def test_pipeline_single_stage_degenerate(data):
+    """P=1: the pipeline collapses to the plain scan (plus the
+    micro-batch loop).  Forward-only here for tier-1 budget; gradients
+    through the degenerate pipe ride the MPMD P=1 parity fit
+    (tests/test_mpmd.py)."""
+    params, x = data
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("pipe",))
+    out = pipeline_apply(_stage, params, x, mesh, num_microbatches=4)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(_reference(params, x)),
+        rtol=1e-6, atol=1e-6,
+    )
+
+
+def test_pipeline_rejects_nondivisible_layer_count(data):
+    """8 layers over 3 stages: the SPMD flavor shards ONE stacked leaf
+    and must refuse (the MPMD plane balances the remainder instead —
+    parallel/pipeline.py::layer_splits is the shared split math)."""
+    params, x = data
+    mesh = Mesh(np.asarray(jax.devices()[:3]), ("pipe",))
+    with pytest.raises(ValueError, match="pipeline stages"):
+        pipeline_apply(_stage, params, x, mesh, num_microbatches=4)
+
+
 def test_pipeline_gpt_blocks():
     """The flagship model's stacked block tree pipelines as-is: run the
     GPT-tiny transformer trunk (dense blocks, XLA attention) through a

@@ -31,10 +31,13 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from ray_lightning_tpu.telemetry.schema import (  # noqa: E402
     validate_bench_fault,
     validate_bench_host_overhead,
+    validate_bench_mpmd,
     validate_bench_serve,
     validate_bench_telemetry,
     validate_chrome_trace,
     validate_flight_bundle,
+    validate_mpmd_snapshot,
+    validate_mpmd_xfer,
     validate_serve_reply,
     validate_serve_request,
     validate_serve_snapshot,
@@ -143,6 +146,73 @@ def _self_test_live_plane(tmp: str) -> list:
             )
     problems += _self_test_host_overhead()
     problems += _self_test_serve()
+    problems += _self_test_mpmd()
+    return problems
+
+
+def _self_test_mpmd() -> list:
+    """MPMD-plane producers vs their schema: a REAL transfer frame (the
+    QueueChannel encoder feeding a stub queue), the per-step stage beat,
+    the live snapshot, and the bench block — plus negative cases."""
+    from ray_lightning_tpu.mpmd.transfer import QueueChannel
+
+    sent = []
+
+    class _StubHandle:
+        def put(self, item):
+            sent.append(item)
+
+        def close(self):
+            pass
+
+    chan = QueueChannel.__new__(QueueChannel)
+    chan._handle = _StubHandle()
+    chan._store = None
+    chan._shm_threshold = 1 << 30
+    chan.bytes_sent = 0
+    chan.shm_sends = 0
+    chan.send("act", 3, 1, {"x": [1.0, 2.0]}, chunk=1)
+    problems = validate_mpmd_xfer(sent[0], "self-test mpmd xfer")
+
+    beat = {
+        "type": "mpmd_stage", "stage": 1, "step": 4,
+        "bubble_fraction": 0.12, "stage_occupancy": 0.88,
+        "busy_s": 0.4, "blocked_s": 0.05, "loss": 4.2,
+    }
+    problems += validate_stream_item(beat, "self-test mpmd beat")
+    problems += validate_mpmd_snapshot(
+        {
+            "schedule": "1f1b", "interleave": 2, "n_micro": 8,
+            "n_stages": 2, "stages": [beat],
+        },
+        "self-test mpmd snapshot",
+    )
+    problems += validate_bench_mpmd(
+        {
+            "schedule": "1f1b", "n_stages": 2, "n_micro": 8,
+            "interleave": 2, "bubble_fraction": 0.08,
+            "gpipe_bubble_fraction": 0.13, "stage_occupancy": 0.9,
+            "stage_skew_ms": 1.2, "tokens_per_sec": 1000.0,
+            "single_mesh_tokens_per_sec": 1100.0, "vs_single_mesh": 0.91,
+            "loss_parity_max_diff": 1e-6,
+            "op_costs_ms": {"FWD": 1.2, "BWD": 4.0, "SEND": 0.5},
+        },
+        "self-test bench mpmd",
+    )
+    if not validate_mpmd_xfer({**sent[0], "shm": "/dev/shm/x"}):
+        problems.append(
+            "self-test mpmd xfer: validator accepted data AND shm"
+        )
+    if not validate_bench_mpmd({"schedule": "1f1b"}):
+        problems.append(
+            "self-test bench mpmd: validator accepted a block missing "
+            "the pipeline shape"
+        )
+    if not validate_stream_item(
+            {**beat, "bubble_fraction": 1.5}, "neg"):
+        problems.append(
+            "self-test mpmd beat: validator accepted bubble > 1"
+        )
     return problems
 
 
@@ -283,6 +353,9 @@ def scan_bench_files() -> list:
         serve = doc.get("serve")
         if serve is not None:  # pre-serving rounds lack it
             problems += validate_bench_serve(serve, f"{name}:serve")
+        mpmd = doc.get("mpmd")
+        if mpmd is not None:  # pre-MPMD rounds lack it
+            problems += validate_bench_mpmd(mpmd, f"{name}:mpmd")
     return problems
 
 

@@ -5,7 +5,9 @@ Reads either artifact the live plane produces (docs/OBSERVABILITY.md):
 * ``live.json`` — the RunMonitor's driver-side snapshot (remote
   strategies; refreshed ~1/s under ``<root>/telemetry/``);
 * ``heartbeats-rank<k>.jsonl`` — a worker/local fit's raw beat stream
-  (queue-less LocalStrategy runs; pass the file or the telemetry dir).
+  (queue-less LocalStrategy runs; pass the file or the telemetry dir);
+* ``mpmd-live.json`` — the MPMD pipeline strategy's per-stage
+  occupancy/bubble snapshot (MpmdStrategy fits).
 
 Renders a per-rank table (step, progress, step/data-wait ms, heartbeat
 age, phase, status) plus the monitor's recent events, repainted with
@@ -72,12 +74,19 @@ def _load_beats_jsonl(paths) -> Optional[Dict[str, Any]]:
 def load_snapshot(path: str) -> Optional[Dict[str, Any]]:
     """live.json file, a beats .jsonl, or a directory holding either."""
     if os.path.isdir(path):
-        live = os.path.join(path, "live.json")
-        if os.path.exists(live):
-            return _load_live_json(live)
-        serve = os.path.join(path, "serve-live.json")
-        if os.path.exists(serve):
-            return _load_live_json(serve)
+        # Newest-mtime wins among the live artifacts: a stale
+        # live.json from an earlier SPMD fit in the same root must not
+        # shadow the actively-refreshed mpmd/serve snapshot (each
+        # producer rewrites its own file every refresh).
+        candidates = []
+        for name in ("live.json", "serve-live.json", "mpmd-live.json"):
+            full = os.path.join(path, name)
+            try:
+                candidates.append((os.path.getmtime(full), full))
+            except OSError:
+                continue
+        if candidates:
+            return _load_live_json(max(candidates)[1])
         return _load_beats_jsonl(
             sorted(glob.glob(os.path.join(path, "heartbeats-rank*.jsonl")))
         )
@@ -121,11 +130,40 @@ def _render_serve(serve: Dict[str, Any]) -> list:
     return lines
 
 
+def _render_mpmd(mpmd: Dict[str, Any]) -> list:
+    """The MPMD pipeline pane (``mpmd-live.json``): schedule shape plus
+    per-stage step/occupancy/bubble — the pipeline-balance view."""
+    lines = [
+        "",
+        f"mpmd: {mpmd.get('schedule', '?')}"
+        + (f" x{mpmd['interleave']}" if mpmd.get("interleave", 1) > 1
+           else "")
+        + f"  stages {mpmd.get('n_stages', '?')}"
+        f"  micro {mpmd.get('n_micro', '?')}",
+        "stage   step    occ%  bubble%    busy_ms     loss",
+    ]
+    for item in mpmd.get("stages", []):
+        occ = item.get("stage_occupancy")
+        bub = item.get("bubble_fraction")
+        lines.append(
+            f"{item.get('stage', '?'):>5}"
+            + _fmt(item.get("step"), 7)
+            + _fmt(None if occ is None else 100 * occ, 8)
+            + _fmt(None if bub is None else 100 * bub, 9)
+            + _fmt(1e3 * item.get("busy_s", 0.0), 11)
+            + _fmt(item.get("loss"), 9)
+        )
+    return lines
+
+
 def render(snapshot: Optional[Dict[str, Any]], source: str) -> str:
     """One text frame (pure function — tested directly)."""
     stamp = time.strftime("%H:%M:%S")
     if not snapshot:
         return f"rlt_top {stamp} — no live data at {source} (yet?)\n"
+    if "mpmd" in snapshot and "ranks" not in snapshot:
+        return (f"rlt_top {stamp} — mpmd pipeline\n"
+                + "\n".join(_render_mpmd(snapshot["mpmd"])) + "\n")
     if "serve" in snapshot and "ranks" not in snapshot:
         return (f"rlt_top {stamp} — serving engine\n"
                 + "\n".join(_render_serve(snapshot["serve"])) + "\n")
@@ -152,6 +190,8 @@ def render(snapshot: Optional[Dict[str, Any]], source: str) -> str:
         )
     if snapshot.get("serve"):
         lines += _render_serve(snapshot["serve"])
+    if snapshot.get("mpmd"):
+        lines += _render_mpmd(snapshot["mpmd"])
     events = snapshot.get("events") or []
     if events:
         lines += ["", "recent events:"]
