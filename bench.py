@@ -305,7 +305,8 @@ def _bench_fault_block() -> dict:
     from ray_lightning_tpu.parallel.strategies import RayStrategy
 
     block: dict = {"drain_checkpoint_s": None, "time_to_recover_s": None,
-                   "backoff_s": None}
+                   "backoff_s": None, "resize_time_to_recover_s": None,
+                   "resize_old_world": None, "resize_new_world": None}
 
     class _DrainAt(_CB):
         def on_train_batch_end(self, trainer, module, logs, batch_idx):
@@ -364,6 +365,62 @@ def _bench_fault_block() -> dict:
             block["backoff_s"] = backoff.get("delay_s")
     except Exception as e:  # noqa: BLE001
         sys.stderr.write(f"recovery probe skipped: {e}\n")
+
+    # Elastic shrink probe (docs/FAULT_TOLERANCE.md "Elastic resume"):
+    # a 2-worker fit loses worker 1 at spawn (lose_worker fault), the
+    # governor respawns with the survivor, and the cost of the whole
+    # detour — doomed attempt, kill, resize, re-discovery, recompile —
+    # is the wall delta against the same fit run at 1 worker cleanly.
+    def _shrink_fit() -> tuple:
+        with tempfile.TemporaryDirectory(prefix="rlt_bench_resize_") as d:
+            os.environ["RLT_FAULT"] = "lose_worker@point:spawn,rank:1"
+            os.environ["RLT_FAULT_STATE"] = os.path.join(d, "chaos")
+            try:
+                strategy = RayStrategy(
+                    num_workers=2, max_restarts=1,
+                    restart_backoff_s=0.05, elastic_min_workers=1,
+                )
+                trainer = Trainer(
+                    strategy=strategy, max_epochs=3, default_root_dir=d,
+                    limit_train_batches=2, limit_val_batches=0,
+                    enable_checkpointing=False,
+                )
+                t0 = time.perf_counter()
+                trainer.fit(BoringModel(), BoringDataModule(batch_size=16))
+                wall = time.perf_counter() - t0
+                assert trainer.global_step == 6, trainer.global_step
+                assert strategy.active_workers == 1
+                return wall, strategy.recovery_events
+            finally:
+                os.environ.pop("RLT_FAULT", None)
+                os.environ.pop("RLT_FAULT_STATE", None)
+
+    def _clean_one_worker_fit() -> float:
+        with tempfile.TemporaryDirectory(prefix="rlt_bench_resize_") as d:
+            strategy = RayStrategy(num_workers=1)
+            trainer = Trainer(
+                strategy=strategy, max_epochs=3, default_root_dir=d,
+                limit_train_batches=2, limit_val_batches=0,
+                enable_checkpointing=False,
+            )
+            t0 = time.perf_counter()
+            trainer.fit(BoringModel(), BoringDataModule(batch_size=16))
+            return time.perf_counter() - t0
+
+    try:
+        clean_wall = _clean_one_worker_fit()
+        shrink_wall, events = _shrink_fit()
+        block["resize_time_to_recover_s"] = round(
+            max(shrink_wall - clean_wall, 0.0), 3
+        )
+        resize = next(
+            (e for e in events if e.get("kind") == "resize"), None
+        )
+        if resize is not None:
+            block["resize_old_world"] = resize.get("old_world")
+            block["resize_new_world"] = resize.get("new_world")
+    except Exception as e:  # noqa: BLE001
+        sys.stderr.write(f"resize probe skipped: {e}\n")
     return block
 
 

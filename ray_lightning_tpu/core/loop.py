@@ -571,6 +571,179 @@ def _build_accum_flush(inner_tx, mesh, state_shardings):
     )
 
 
+def _rederive_accum(old_world: int, old_accum: int,
+                    new_world: int) -> Optional[int]:
+    """The accumulation factor that keeps the GLOBAL batch per optimizer
+    step invariant under an elastic world-size change: each host feeds
+    ``b`` rows per micro-batch, so ``world × accum × b`` rows reach every
+    optimizer update — resuming N→M must scale accum by N/M.  The LR
+    schedule indexes optimizer steps, so with the global batch invariant
+    it needs no rescaling.  Returns ``None`` when the product does not
+    divide (the caller keeps the old accum and warns loudly)."""
+    rows = int(old_world) * int(old_accum)
+    if new_world <= 0 or rows % int(new_world):
+        return None
+    return rows // int(new_world)
+
+
+def _elastic_resume_info(path: str, world_size: int,
+                         cfg_accum: int) -> Optional[Dict[str, Any]]:
+    """World-size delta between a sharded checkpoint and THIS fit, read
+    from META alone (no shard bytes touched).  ``None`` when the
+    checkpoint predates the elastic plane (no recorded ``world_size``)
+    or the world is unchanged."""
+    from ray_lightning_tpu.utils import sharded_ckpt
+
+    try:
+        extra = sharded_ckpt.load_meta(path).get("extra", {})
+    except Exception:  # noqa: BLE001 - a corrupt META fails later, in
+        # load_sharded, with the full verify story
+        return None
+    old_world = extra.get("world_size")
+    if not old_world:
+        return None
+    old_world = int(old_world)
+    recorded_accum = extra.get("accum")
+    old_accum = int(recorded_accum or cfg_accum)
+    if old_world == int(world_size):
+        if recorded_accum is None or int(recorded_accum) == int(cfg_accum):
+            return None
+        # Same world, but the checkpoint's trajectory ran a DIFFERENT
+        # accum — a previous elastic resize re-derived it (shrink at 2
+        # writes world_size=1/accum=2; a later same-world crash resume
+        # must not silently revert to the config's 1, which would both
+        # change the global batch mid-trajectory and hand the
+        # congruence-dependent reconciliations a structurally
+        # mismatched opt_state).  The recorded value wins, loudly.
+        return {
+            "old_world": old_world,
+            "new_world": int(world_size),
+            "old_accum": int(recorded_accum),
+            "accum": int(recorded_accum),
+            "exact": True,
+            "ckpt": path,
+        }
+    new_accum = _rederive_accum(old_world, old_accum, world_size)
+    return {
+        "old_world": old_world,
+        "new_world": int(world_size),
+        "old_accum": old_accum,
+        "accum": new_accum if new_accum is not None else old_accum,
+        "exact": new_accum is not None,
+        "ckpt": path,
+    }
+
+
+def _reconcile_multisteps(host_state: Any, template: Any) -> Any:
+    """Elastic accum re-derivation can cross the ``accum == 1``
+    boundary, changing the opt_state WRAPPER: accum > 1 wraps the inner
+    optimizer state in ``optax.MultiStepsState``.  A checkpoint from
+    the other side of the boundary is re-wrapped here so the resumed
+    tree stays congruent with this run's state template:
+
+    * bare → MultiSteps (shrink drove accum past 1): fresh window —
+      ``mini_step = 0``, zero ``acc_grads``, ``gradient_step`` carried
+      from the train step counter;
+    * MultiSteps → bare (grow collapsed accum to 1): the inner state is
+      unwrapped; a PARTIAL accumulation window is dropped with a loud
+      warning (its micro-grads never reached the params — at most
+      ``accum - 1`` micro-batches of gradient signal).
+    """
+    import optax
+
+    from ray_lightning_tpu.core.module import TrainState
+
+    if not isinstance(host_state, TrainState) or not isinstance(
+        template, TrainState
+    ):
+        return host_state
+    have = isinstance(host_state.opt_state, optax.MultiStepsState)
+    want = isinstance(template.opt_state, optax.MultiStepsState)
+    if have == want:
+        return host_state
+    if want:
+        step32 = np.asarray(
+            jax.device_get(host_state.step), np.int32
+        )
+        ms = optax.MultiStepsState(
+            mini_step=np.zeros((), np.int32),
+            gradient_step=step32,
+            inner_opt_state=host_state.opt_state,
+            acc_grads=jax.tree_util.tree_map(
+                lambda p: np.zeros(
+                    getattr(p, "shape", ()),
+                    getattr(p, "dtype", np.float32),
+                ),
+                jax.device_get(host_state.params),
+            ),
+        )
+        return TrainState(
+            host_state.params, ms, host_state.step,
+            host_state.grad_residual,
+        )
+    ms = host_state.opt_state
+    mini = int(np.asarray(jax.device_get(ms.mini_step)))
+    if mini:
+        import warnings
+
+        warnings.warn(
+            f"elastic resume collapsed accum to 1: the checkpoint's "
+            f"partial accumulation window ({mini} micro-grad(s)) is "
+            "dropped"
+        )
+    return TrainState(
+        host_state.params, ms.inner_opt_state, host_state.step,
+        host_state.grad_residual,
+    )
+
+
+def _announce_resize(info: Dict[str, Any], tel: Telemetry, queue,
+                     global_rank: int) -> None:
+    """Make an elastic N→M resume LOUD: a warning on every rank, an
+    ``elastic_resizes`` counter, and (rank 0) a schema-shaped ``resize``
+    event on the driver queue — the old/new world sizes flow through
+    the monitor into ``trainer.monitor_report``, OpenMetrics and
+    ``rlt_top`` like every other recovery event."""
+    import warnings
+
+    from ray_lightning_tpu.telemetry.monitor import make_event
+
+    if info["old_world"] == info["new_world"]:
+        # No world change — an accum-continuity override (the recorded
+        # accum beats the config's): warn, but no resize event.
+        warnings.warn(
+            f"elastic resume: honoring the checkpoint's recorded "
+            f"accum {info['accum']} over the configured value — the "
+            f"state's optimizer trajectory (and the global batch per "
+            f"optimizer step) continues what a previous elastic "
+            f"resize established"
+        )
+        return
+    msg = (
+        f"elastic resume: checkpoint from world size {info['old_world']}"
+        f" (accum {info['old_accum']}) resuming on {info['new_world']}"
+        f" with accum {info['accum']}"
+    )
+    if not info["exact"]:
+        msg += (
+            " — old_world*accum does not divide the new world size; the"
+            " GLOBAL batch per optimizer step changes and the LR"
+            " schedule is no longer step-equivalent"
+        )
+    warnings.warn(msg)
+    tel.add_counter("elastic_resizes", 1)
+    if queue is not None and global_rank == 0:
+        try:
+            queue.put(make_event(
+                "resize", global_rank,
+                old_world=info["old_world"],
+                new_world=info["new_world"],
+                message=msg, ckpt=info["ckpt"],
+            ))
+        except Exception:  # noqa: BLE001 - queue may be mid-teardown
+            pass
+
+
 def _log_lr(ctx: "LoopContext", lr_schedule) -> None:
     """Log the learning rate that the MOST RECENT optimizer step applied
     (Lightning's LearningRateMonitor convention).  An optax schedule is
@@ -1079,6 +1252,25 @@ def _run_fit_inner(
     if isinstance(tx, tuple) and not hasattr(tx, "init"):
         tx, lr_schedule = tx[0], (tx[1] if len(tx) > 1 else None)
     accum = max(int(config.accumulate_grad_batches), 1)
+    # Elastic resume (reshard-on-load): a sharded checkpoint records the
+    # world size and accumulation factor it was trained at; resuming on
+    # a DIFFERENT world size re-derives accum here — before the
+    # optimizer wraps in MultiSteps — so the global batch per optimizer
+    # step (and therefore the LR schedule, which indexes optimizer
+    # steps) is invariant under N→M.  Per-step RNG needs no such fix:
+    # it folds the resumed micro-step into the base key
+    # (``fold_in(base_rng, micro_step)`` below), which never saw the
+    # world size.
+    resize_info = None
+    if config.resume_from_checkpoint:
+        from ray_lightning_tpu.utils import sharded_ckpt as _sc
+
+        if _sc.is_sharded_ckpt(config.resume_from_checkpoint):
+            resize_info = _elastic_resume_info(
+                config.resume_from_checkpoint, world_size, accum
+            )
+    if resize_info is not None:
+        accum = resize_info["accum"]
     inner_tx = tx
     if accum > 1:
         import optax
@@ -1109,6 +1301,8 @@ def _run_fit_inner(
     tel_stats = tel.step_stats
     if tel_stats is not None:
         tel_stats.configure_model(module)
+    if resize_info is not None:
+        _announce_resize(resize_info, tel, queue, global_rank)
 
     # Live observability plane (docs/OBSERVABILITY.md "Live monitoring"):
     # a heartbeat publisher thread (queue sink on workers, JSONL sink on
@@ -1179,11 +1373,18 @@ def _run_fit_inner(
         from ray_lightning_tpu.utils import sharded_ckpt
 
         if sharded_ckpt.is_sharded_ckpt(config.resume_from_checkpoint):
-            # Sharded restart checkpoint: reassembled on host, then
-            # re-placed below onto THIS run's shardings — resume works on
-            # any topology, including fewer workers than wrote it.
+            # Sharded restart checkpoint, reshard-on-load: with this
+            # run's shardings the index-selective reader places each
+            # leaf straight onto the M-device mesh, each host reading
+            # only the shard-file byte ranges overlapping its own
+            # addressable shards (no full-model reassembly on ZeRO-3).
+            # A structure mismatch (EF residual present on one side
+            # only) falls back to the full host read; either way resume
+            # works on any topology, including fewer workers than
+            # wrote it.
             payload = sharded_ckpt.load_sharded(
-                config.resume_from_checkpoint
+                config.resume_from_checkpoint,
+                shardings=state_shardings,
             )
         else:
             payload = load_state_stream(
@@ -1202,6 +1403,12 @@ def _run_fit_inner(
             host_state = _TS(
                 host_state.params, host_state.opt_state, host_state.step
             )
+        if resize_info is not None:
+            # Accum re-derivation may have crossed the accum==1
+            # boundary (the optax.MultiSteps wrapper appears or
+            # vanishes) — re-wrap before the congruence-dependent
+            # reconciliations below.
+            host_state = _reconcile_multisteps(host_state, state)
         # Reconcile checkpoint dtypes with THIS run's state template: a
         # dtype-policy change between runs (e.g. AdamW mu f32 → bf16,
         # models/gpt.py ``mu_dtype``) must not leak the old dtype into
@@ -1228,6 +1435,22 @@ def _run_fit_inner(
             # (loaders are epoch-seeded, so the order replays exactly).
             start_epoch = payload["epoch"]
             resume_skip_batches = int(payload.get("batch_in_epoch", 0))
+            if (resize_info is not None and world_size != 1
+                    and resize_info["old_world"]
+                    != resize_info["new_world"]):
+                import warnings
+
+                # Per-host loader shards are keyed off the world size:
+                # under N→M the epoch's row→host partition changes, so
+                # position-based skipping cannot replay the exact
+                # global rows.  Counters stay step-exact; data replay
+                # is exact only at equal world size (or world 1).
+                warnings.warn(
+                    "mid-epoch elastic resume at a different world "
+                    "size: this epoch's remaining rows are re-sharded "
+                    "over the new worker set — some rows may repeat "
+                    "or be skipped within the epoch"
+                )
         else:
             start_epoch = payload["epoch"] + 1
             resume_skip_batches = 0
@@ -1363,6 +1586,12 @@ def _run_fit_inner(
                             "micro_step": ctx.micro_step,
                             "mid_epoch": mid_epoch,
                             "batch_in_epoch": batch_in_epoch,
+                            # Elastic-resume contract: the world size
+                            # and accum this state was trained at, so a
+                            # resume on M != N devices can re-derive
+                            # accum for global-batch invariance.
+                            "world_size": world_size,
+                            "accum": accum,
                             "drain_reason": reason,
                             "callback_metrics": dict(
                                 ctx.callback_metrics
@@ -1788,6 +2017,8 @@ def _run_fit_inner(
                         "epoch": ctx.current_epoch,
                         "global_step": ctx.global_step,
                         "micro_step": ctx.micro_step,
+                        "world_size": world_size,
+                        "accum": accum,
                         "callback_metrics": dict(ctx.callback_metrics),
                         "callback_states": [
                             cb.state_dict() for cb in callbacks

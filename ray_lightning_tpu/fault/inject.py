@@ -14,6 +14,14 @@ Grammar (``RLT_FAULT``)::
 
     kinds: crash   — os._exit(13): hard process death (OOM/preemption
                      without grace)
+           lose_worker — crash, PLUS a fleet-capacity loss recorded in
+                     the ``RLT_FAULT_STATE`` dir: the restart governor's
+                     capacity oracle (:func:`lost_worker_count`) then
+                     reports one fewer available worker, driving the
+                     elastic shrink path deterministically.  ``secs``
+                     is the regain time — the lost host "comes back"
+                     after that many seconds (omit it for a permanent
+                     loss), exercising grow-back
            exc     — raise FaultInjected (the deterministic-user-bug
                      path: must fail fast, never burn restart budget)
            hang    — sleep ``secs`` (default 3600) on the calling
@@ -83,13 +91,16 @@ __all__ = [
     "fire",
     "set_rank",
     "step_fault_in_range",
+    "record_worker_loss",
+    "lost_worker_count",
     "POINTS",
     "KINDS",
 ]
 
 log = logging.getLogger(__name__)
 
-KINDS = ("crash", "exc", "hang", "slow", "sigterm", "torn", "bitflip")
+KINDS = ("crash", "exc", "hang", "slow", "sigterm", "torn", "bitflip",
+         "lose_worker")
 POINTS = ("spawn", "step", "queue_put", "ckpt_write", "meta_write")
 
 _CRASH_EXIT_CODE = 13
@@ -287,10 +298,80 @@ def _corrupt_bitflip(path: str) -> None:
         log.warning("bitflip fault on %s failed: %r", path, e)
 
 
+# ---------------------------------------------------------------------------
+# Fleet-capacity oracle (the elastic shrink/grow test plane)
+# ---------------------------------------------------------------------------
+
+def record_worker_loss(rank: Optional[int],
+                       regain_s: Optional[float] = None,
+                       state_dir: Optional[str] = None) -> None:
+    """Record a fleet-capacity loss in the shared ``RLT_FAULT_STATE``
+    dir: the host carrying ``rank`` is gone, coming back after
+    ``regain_s`` seconds (``None`` = permanently).  The restart
+    governor's default capacity oracle reads these markers, so a
+    ``lose_worker`` chaos fault drives the whole shrink→grow path
+    deterministically with no real fleet."""
+    import json
+
+    state_dir = state_dir or os.environ.get("RLT_FAULT_STATE") or None
+    if state_dir is None:
+        log.warning(
+            "lose_worker fired without RLT_FAULT_STATE — capacity loss "
+            "not recorded (the governor will respawn at full size)"
+        )
+        return
+    try:
+        os.makedirs(state_dir, exist_ok=True)
+        path = os.path.join(
+            state_dir, f"lost-worker-{rank if rank is not None else 0}.json"
+        )
+        with open(path, "w") as f:
+            json.dump({"ts": time.time(), "regain_s": regain_s}, f)
+    except OSError:
+        log.warning("lost-worker marker in %s could not be written",
+                    state_dir)
+
+
+def lost_worker_count(now: Optional[float] = None,
+                      state_dir: Optional[str] = None) -> int:
+    """Workers currently lost per the ``RLT_FAULT_STATE`` markers (a
+    marker whose ``regain_s`` has elapsed no longer counts — the
+    replacement host arrived).  0 with no chaos state configured."""
+    import json
+
+    state_dir = state_dir or os.environ.get("RLT_FAULT_STATE") or None
+    if not state_dir or not os.path.isdir(state_dir):
+        return 0
+    now = time.time() if now is None else now
+    n = 0
+    for name in os.listdir(state_dir):
+        if not name.startswith("lost-worker-"):
+            continue
+        try:
+            with open(os.path.join(state_dir, name)) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        regain = doc.get("regain_s")
+        if regain is None or now - float(doc.get("ts", 0.0)) < float(regain):
+            n += 1
+    return n
+
+
 def _execute(spec: FaultSpec, point: str, path: Optional[str]) -> None:
     log.warning("chaos: firing %s@%s (spec #%d)", spec.kind, point,
                 spec.index)
     if spec.kind == "crash":
+        os._exit(_CRASH_EXIT_CODE)
+    if spec.kind == "lose_worker":
+        # A preempted HOST: record the capacity loss (``secs`` = when a
+        # replacement arrives), then die exactly like ``crash`` — the
+        # governor sees an ActorDiedError whose capacity oracle now
+        # reports one fewer worker, and shrinks instead of respawning
+        # into the hole.
+        record_worker_loss(
+            _ctx_rank if _ctx_rank is not None else spec.rank, spec.secs
+        )
         os._exit(_CRASH_EXIT_CODE)
     if spec.kind == "exc":
         raise FaultInjected(

@@ -308,6 +308,7 @@ class GradSync:
         stats dict: ``grad_sync_bytes`` then appears in the fleet report
         (``trainer.telemetry_report``) next to step timings, and a
         grad-sync metadata span marks the plan in exported traces."""
+        self._telemetry = telemetry
         for key, value in self.stats().items():
             if isinstance(value, bool) or value is None:
                 telemetry.set_meta(key, value)
@@ -360,7 +361,15 @@ class GradSync:
         layout: a stream written without EF (or from a different world
         size) gets a fresh zero residual — dropping at most one step of
         compression error; a stream written with EF resuming into a
-        full/int8 run sheds it."""
+        full/int8 run sheds it.
+
+        The EF residual is **per-device** state (one row per sync
+        participant): restored under a changed device count its rows no
+        longer correspond to this run's devices, so a shape-mismatched
+        residual is VALIDATED here and dropped — loudly (warning +
+        ``grad_residual_dropped`` telemetry counter), never silently
+        misapplied as another device's error history.
+        """
         from ray_lightning_tpu.core.module import TrainState
 
         if not isinstance(host_state, TrainState):
@@ -373,8 +382,20 @@ class GradSync:
                 host_state.params, host_state.opt_state, host_state.step
             )
         want = (self.n_shards, self.plan.total_padded)
-        if resid is not None and tuple(getattr(resid, "shape", ())) == want:
+        got = tuple(getattr(resid, "shape", ()))
+        if resid is not None and got == want:
             return host_state
+        if resid is not None:
+            warnings.warn(
+                f"checkpoint error-feedback residual has shape {got} "
+                f"but this run syncs over {self.n_shards} devices "
+                f"(want {want}) — the per-device residual does not "
+                "survive an elastic world-size change; resetting to "
+                "zero (at most one step of compression error is lost)"
+            )
+            tel = getattr(self, "_telemetry", None)
+            if tel is not None:
+                tel.add_counter("grad_residual_dropped", 1)
         return TrainState(
             host_state.params,
             host_state.opt_state,

@@ -56,6 +56,10 @@ from ray_lightning_tpu.util import process_results
 
 log = logging.getLogger(__name__)
 
+# Distinguishes "no resize happened yet" from "last resize resumed from
+# scratch (None)" in the flap guard's progress comparison.
+_RESIZE_CKPT_UNSET = object()
+
 __all__ = [
     "TpuStrategy",
     "LocalStrategy",
@@ -132,6 +136,15 @@ def _execute_remote(task_ref, global_rank: int, queue_handle) -> Dict[str, Any]:
             build_mesh,
         )
 
+        # Chaos injection point — BEFORE the collective boundary, so a
+        # spawn-pinned fault (crash / lose_worker) kills this worker
+        # while its peers can still be detected + killed by the driver
+        # instead of wedging inside jax.distributed.initialize.
+        from ray_lightning_tpu.fault import inject as _chaos
+
+        _chaos.set_rank(global_rank)
+        _chaos.fire("spawn", rank=global_rank)
+
         # ═══ collective boundary (≙ init_process_group, ray_ddp.py:430) ═══
         bootstrap_distributed(
             task.get("coordinator"), world_size, global_rank
@@ -153,12 +166,6 @@ def _execute_remote(task_ref, global_rank: int, queue_handle) -> Dict[str, Any]:
             world_size=world_size,
             mesh=mesh,
         )
-        # Chaos injection point: a crash/hang at actor-spawn/task-start
-        # exercises the startup half of elastic recovery.
-        from ray_lightning_tpu.fault import inject as _chaos
-
-        _chaos.set_rank(global_rank)
-        _chaos.fire("spawn", rank=global_rank)
         if kind == "fit":
             try:
                 return run_fit(
@@ -238,6 +245,11 @@ class TpuStrategy:
 
     mode: str = "gspmd"
     zero_stage: int = 0
+    # Whether this strategy's world may be elastically resized; subclasses
+    # with a STRUCTURAL world (MpmdStrategy: the layer split is baked into
+    # every stage's program) set False, and the fleet-wide RLT_ELASTIC_*
+    # env bus is then ignored instead of crashing their constructors.
+    supports_elastic_resize: bool = True
 
     def __init__(
         self,
@@ -258,6 +270,9 @@ class TpuStrategy:
         telemetry=None,
         monitor=None,
         megastep=None,
+        elastic_min_workers: Optional[int] = None,
+        elastic_grow_after_s: Optional[float] = None,
+        elastic_capacity_fn: Optional[Callable[[], int]] = None,
     ):
         if num_workers < 1:
             raise ValueError("num_workers must be >= 1")
@@ -388,6 +403,52 @@ class TpuStrategy:
         # (they are the normal case, not an error — Podracer); counted
         # separately so dashboards can tell churn from failure.
         self.preempt_restarts_used = 0
+        # Elastic world sizing (docs/FAULT_TOLERANCE.md "Elastic
+        # resume"): with ``elastic_min_workers`` set, the governor may
+        # deliberately respawn with M < N SURVIVORS when the fleet lost
+        # capacity — a preempted host becomes a shrink, not a wait —
+        # and grows back once capacity has been available again for
+        # ``elastic_grow_after_s`` seconds (a deliberate drain at the
+        # next sync boundary, budget-free).  Capacity comes from
+        # ``elastic_capacity_fn`` (a fleet-API probe in production;
+        # default: the chaos plane's lost-worker markers, so the whole
+        # path is deterministically testable via ``lose_worker@...``).
+        if elastic_min_workers is None and self.supports_elastic_resize:
+            env = os.environ.get("RLT_ELASTIC_MIN_WORKERS")
+            elastic_min_workers = int(env) if env else None
+            if elastic_min_workers is not None:
+                # The env bus serves fleets of MIXED sizes: clamp into
+                # [1, num_workers] rather than reject, so one exported
+                # floor never crashes a strategy it doesn't fit.
+                elastic_min_workers = min(
+                    max(elastic_min_workers, 1), num_workers
+                )
+        if elastic_grow_after_s is None and self.supports_elastic_resize:
+            env = os.environ.get("RLT_ELASTIC_GROW_AFTER_S")
+            elastic_grow_after_s = float(env) if env else None
+        if elastic_min_workers is not None and not (
+                1 <= elastic_min_workers <= num_workers):
+            raise ValueError(
+                f"elastic_min_workers must be in [1, num_workers="
+                f"{num_workers}], got {elastic_min_workers}"
+            )
+        if elastic_grow_after_s is not None and elastic_grow_after_s < 0:
+            raise ValueError("elastic_grow_after_s must be >= 0")
+        self.elastic_min_workers = elastic_min_workers
+        self.elastic_grow_after_s = elastic_grow_after_s
+        self.elastic_capacity_fn = elastic_capacity_fn
+        # The CURRENT world size: num_workers is the requested ceiling,
+        # active_workers what the governor is actually running.
+        self.active_workers = num_workers
+        self.resizes_used = 0
+        self.last_resize_recover_s: Optional[float] = None
+        # Flap-guard progress proxy: a SENTINEL, not None — the first
+        # shrink of a fit with no checkpoint yet (resume None) must not
+        # pre-seed the streak.
+        self._last_resize_ckpt: Any = _RESIZE_CKPT_UNSET
+        self._resize_streak = 0
+        self._grow_pending = False
+        self._capacity_ok_since: Optional[float] = None
         # Recovery events of the fit in flight (backoff delays, restart
         # attempts, checkpoint-corruption fallbacks, preempt restarts):
         # seeded into each attempt's RunMonitor so the final
@@ -405,7 +466,9 @@ class TpuStrategy:
     # -- rank/world properties (driver side; ≙ ray_ddp.py:525-541) ----------
     @property
     def world_size(self) -> int:
-        return self.num_workers
+        # The governor's CURRENT size: equals num_workers unless an
+        # elastic resize shrank (or re-grew) the fleet mid-fit.
+        return self.active_workers
 
     @property
     def global_rank(self) -> int:
@@ -436,7 +499,7 @@ class TpuStrategy:
         gen = getattr(self, "_spawn_generation", 0)
         self._spawn_generation = gen + 1
         suffix = "" if gen == 0 else f"-r{gen}"
-        for i in range(self.num_workers):
+        for i in range(self.active_workers):
             worker = self._backend.create_actor(
                 name=f"rlt-worker-{i}{suffix}",
                 env=self.env_per_worker or None,
@@ -521,7 +584,7 @@ class TpuStrategy:
     def _broker_coordinator(self) -> Optional[str]:
         """Worker-0-node coordinator address (≙ MASTER_ADDR/PORT brokering,
         reference ``ray_ddp.py:215-228``)."""
-        if self.num_workers <= 1:
+        if self.active_workers <= 1:
             return None
         if isinstance(self._backend, backend_mod.LocalBackend):
             # All actors share this host; loopback is always routable
@@ -595,6 +658,10 @@ class TpuStrategy:
             self._last_monitor = None
             self._drain_broadcast = False
             self._drain_broadcast_at = 0.0
+            self._grow_pending = False
+            self._capacity_ok_since = None
+            self._resize_streak = 0
+            self._last_resize_ckpt = _RESIZE_CKPT_UNSET
             drain_mod.reset_drain()
             drain_mod.set_fit_active(True)
             drain_installed = drain_mod.install_signal_handlers()
@@ -608,6 +675,9 @@ class TpuStrategy:
                     )
                 except PreemptedError as err:
                     self._capture_attempt_events()
+                    t_recover = time.monotonic()
+                    grow_drain = self._grow_pending
+                    self._grow_pending = False
                     if (not elastic or self._drain_broadcast
                             or drain_mod.drain_requested()):
                         # No elastic recovery, or the DRIVER itself is
@@ -618,6 +688,8 @@ class TpuStrategy:
                     # Flap guard: consecutive preemption recoveries that
                     # make no forward progress mean the host/quota is
                     # flapping — budget-free must not mean infinite.
+                    # Grow drains ride the same guard: a grow that never
+                    # advances the step cannot keep draining the fit.
                     step = int(getattr(err, "step", 0) or 0)
                     preempt_streak = (
                         preempt_streak + 1 if step <= last_preempt_step
@@ -628,6 +700,15 @@ class TpuStrategy:
                         preserve_scratch = err.checkpoint is not None
                         raise
                     self.preempt_restarts_used += 1
+                    # World sizing for the next attempt: a preemption
+                    # may shrink the fleet (capacity lost with the
+                    # drained host) or — on a deliberate grow drain —
+                    # re-expand toward num_workers.
+                    target, rejected = self._elastic_resize_decision()
+                    if rejected:
+                        preserve_scratch = err.checkpoint is not None
+                        self._record_resize_rejected(target)
+                        raise
                     # Elastic fits always have restart_dir set, and the
                     # drain checkpoint lands inside it — so verified
                     # discovery alone decides the resume point (the
@@ -649,7 +730,11 @@ class TpuStrategy:
                         f"(budget untouched), resuming from "
                         f"{resume or 'scratch'}."
                     )
-                    self._respawn_workers()
+                    self._respawn_resized(
+                        target, t_recover, resume,
+                        why="grow-back drain" if grow_drain
+                        else "preemption",
+                    )
                     if resume is not None:
                         config = dataclasses.replace(
                             config, resume_from_checkpoint=resume
@@ -659,8 +744,65 @@ class TpuStrategy:
                 # respawning would retrain epochs just to re-raise it.
                 except ActorDiedError as err:
                     self._capture_attempt_events()
+                    # A death supersedes any in-flight grow drain (the
+                    # restart below is itself a grow opportunity); a
+                    # stale flag would mislabel the NEXT preemption as
+                    # a grow-back drain.
+                    self._grow_pending = False
                     if not elastic:
                         raise
+                    t_recover = time.monotonic()
+                    target, rejected = self._elastic_resize_decision()
+                    if rejected:
+                        self._record_resize_rejected(target)
+                        err.enrich(note=(
+                            f"fleet capacity {target} below "
+                            f"elastic_min_workers="
+                            f"{self.elastic_min_workers} — shrink "
+                            "rejected, restart abandoned"
+                        ))
+                        raise
+                    if (target is not None
+                            and target < self.active_workers):
+                        # Capacity loss EXPLAINS the death: a preempted
+                        # host is fleet churn, not a failure — respawn
+                        # with the M survivors budget-free (like
+                        # preempt_restarts), flap-guarded by forward
+                        # progress of the resume point below.  Kill the
+                        # doomed set FIRST: the dead rank's peers may be
+                        # wedged inside the collective boundary, and
+                        # discovery asking a wedged worker 0 would wait
+                        # out its entire rendezvous timeout.
+                        self._kill_workers(why="elastic-shrink")
+                        info = self._discover_resume(config)
+                        resume = info["path"]
+                        self._resize_streak = (
+                            self._resize_streak + 1
+                            if resume == self._last_resize_ckpt else 0
+                        )
+                        self._last_resize_ckpt = resume
+                        if self._resize_streak >= 2:
+                            err.enrich(note=(
+                                "no forward progress across "
+                                "consecutive elastic resizes — flap "
+                                "guard stopped the shrink loop"
+                            ))
+                            raise
+                        warnings.warn(
+                            f"Worker loss with reduced fleet capacity "
+                            f"({err}); elastic shrink to {target} "
+                            f"survivors (budget untouched), resuming "
+                            f"from {resume or 'scratch'}."
+                        )
+                        self._respawn_resized(
+                            target, t_recover, resume,
+                            why="capacity loss",
+                        )
+                        if resume is not None:
+                            config = dataclasses.replace(
+                                config, resume_from_checkpoint=resume
+                            )
+                        continue
                     now = time.monotonic()
                     fail_times[:] = [
                         t for t in fail_times
@@ -693,9 +835,23 @@ class TpuStrategy:
                         )
                         time.sleep(delay)
                     t_recover = time.monotonic()
+                    # A restart is also a grow OPPORTUNITY: capacity
+                    # that returned while running shrunk re-expands
+                    # here without a deliberate grow drain.  The resize
+                    # event is booked AFTER discovery so its
+                    # recover_s/ckpt reflect the real detour.
+                    old_active = self.active_workers
+                    grew = target is not None and target != old_active
+                    if grew:
+                        self.active_workers = int(target)
                     self._respawn_workers()
                     info = self._discover_resume(config)
                     resume = info["path"]
+                    if grew:
+                        self._record_resize(
+                            old_active, int(target), t_recover, resume,
+                            why="restart",
+                        )
                     self._record_recovery(
                         "elastic_restart", attempt=fail_streak,
                         recover_s=round(time.monotonic() - t_recover, 3),
@@ -800,6 +956,133 @@ class TpuStrategy:
         )
         return base * (1.0 + 0.25 * random.random())
 
+    # -- elastic world sizing (shrink/grow governance) -----------------------
+    def _fleet_capacity(self) -> int:
+        """Workers the fleet can currently host.  Production installs
+        pass ``elastic_capacity_fn`` (a fleet-API probe); the default
+        reads the chaos plane's lost-worker markers
+        (``fault.inject.lost_worker_count``) so a ``lose_worker@...``
+        fault drives the shrink/grow path deterministically."""
+        if self.elastic_capacity_fn is not None:
+            return int(self.elastic_capacity_fn())
+        from ray_lightning_tpu.fault import inject
+
+        return self.num_workers - inject.lost_worker_count()
+
+    def _elastic_resize_decision(self):
+        """``(target_world, rejected)``: the size the next attempt
+        should run at.  ``target_world`` is ``None`` when elastic
+        sizing is off (``elastic_min_workers`` unset — fixed-size
+        governance, the pre-elastic behavior); ``rejected`` flags
+        capacity below the floor (the caller raises instead of
+        training a crippled fleet)."""
+        if self.elastic_min_workers is None:
+            return None, False
+        target = max(min(self._fleet_capacity(), self.num_workers), 0)
+        if target < self.elastic_min_workers:
+            return target, True
+        return target, False
+
+    def _record_resize_rejected(self, target: int) -> None:
+        self._record_recovery(
+            "resize_rejected",
+            old_world=self.active_workers, new_world=target,
+            message=(
+                f"fleet capacity {target} below elastic_min_workers="
+                f"{self.elastic_min_workers}; shrink rejected"
+            ),
+        )
+
+    def _respawn_resized(self, target: Optional[int], t_recover: float,
+                         resume: Optional[str], why: str) -> None:
+        """Respawn the worker set, applying an elastic resize when
+        ``target`` differs from the active size."""
+        old = self.active_workers
+        changed = target is not None and target != old
+        if changed:
+            self.active_workers = int(target)
+        self._respawn_workers()
+        if changed:
+            self._record_resize(old, int(target), t_recover, resume, why)
+
+    def _record_resize(self, old: int, new: int, t_recover: float,
+                       resume: Optional[str], why: str) -> None:
+        """Book one applied resize: the ``resize`` event (old/new world
+        + recover_s) flows through the schema gate into
+        ``trainer.monitor_report`` / OpenMetrics / ``rlt_top``, and any
+        gang packer holding this trial's sub-mesh is notified so the
+        freed devices can host other trials."""
+        recover_s = round(time.monotonic() - t_recover, 3)
+        self.resizes_used += 1
+        self.last_resize_recover_s = recover_s
+        self._record_recovery(
+            "resize", old_world=old, new_world=new,
+            recover_s=recover_s, ckpt=resume or "",
+            message=(
+                f"elastic resize: world {old} → {new} ({why}); "
+                f"recovered in {recover_s}s"
+            ),
+        )
+        warnings.warn(
+            f"elastic resize: world {old} → {new} ({why})"
+        )
+        self._notify_packer_resize(old, new)
+
+    def _notify_packer_resize(self, old: int, new: int) -> None:
+        """Gang-packing hook: a trial running inside ``tune_run``'s
+        fleet packer frees (or reclaims) sub-mesh devices when its
+        governor resizes — best-effort, never costs the restart."""
+        try:
+            from ray_lightning_tpu.tuning import session as trial_session
+
+            trial_session.notify_world_resize(old, new)
+        except Exception as e:  # noqa: BLE001 - observer only
+            log.debug("gang-packer resize notify failed: %r", e)
+
+    def _maybe_request_grow(self) -> None:
+        """Grow-back arming, run from the result-pump tick: when the
+        fit runs below ``num_workers`` and capacity has been back for
+        ``elastic_grow_after_s``, request a fleet drain — the resulting
+        ``PreemptedError`` respawns budget-free at the larger size from
+        the step-granular drain checkpoint."""
+        if (self.elastic_min_workers is None
+                or self.elastic_grow_after_s is None
+                or self._grow_pending
+                or self.active_workers >= self.num_workers):
+            return
+        cap = min(self._fleet_capacity(), self.num_workers)
+        now = time.monotonic()
+        if cap <= self.active_workers:
+            self._capacity_ok_since = None
+            return
+        if self._capacity_ok_since is None:
+            self._capacity_ok_since = now
+            return
+        if now - self._capacity_ok_since < self.elastic_grow_after_s:
+            return
+        self._grow_pending = True
+        self._capacity_ok_since = None
+        warnings.warn(
+            f"fleet capacity returned ({cap} > {self.active_workers} "
+            "active); draining to grow the worker set back"
+        )
+        delivered = 0
+        for rank, w in enumerate(self._workers):
+            request = getattr(w, "request_drain", None)
+            if request is None:
+                continue
+            try:
+                request(wait=False)
+                delivered += 1
+            except Exception as e:  # noqa: BLE001 - a dead worker
+                # surfaces through the pump anyway
+                log.debug("grow drain to rank %d failed: %r", rank, e)
+        if delivered == 0:
+            # Nobody heard the drain (backend without the control lane,
+            # or every worker mid-death): a pending flag with no drain
+            # coming would disarm grow-back for the rest of the fit.
+            self._grow_pending = False
+
     def _maybe_broadcast_drain(self) -> None:
         """Driver-side preemption fan-out: the signal handler only sets
         a flag (no I/O in handlers); the pump tick turns it into one
@@ -854,7 +1137,7 @@ class TpuStrategy:
             "datamodule": datamodule,
             "config": config,
             "callbacks": callbacks,
-            "world_size": self.num_workers,
+            "world_size": self.active_workers,
             "coordinator": coordinator,
             "mesh_axes": self.mesh_axes,
             "mode": self.mode,
@@ -879,9 +1162,11 @@ class TpuStrategy:
 
             def _tick() -> None:
                 # Driver-preemption fan-out rides the pump (signal
-                # handlers must not do socket I/O), then the watchdog.
+                # handlers must not do socket I/O), then the elastic
+                # grow-back arming, then the watchdog.
                 if kind == "fit":
                     self._maybe_broadcast_drain()
+                    self._maybe_request_grow()
                 if monitor is not None:
                     monitor.tick()
 
@@ -934,7 +1219,7 @@ class TpuStrategy:
             )
         monitor = RunMonitor(
             mon_cfg,
-            world_size=self.num_workers,
+            world_size=self.active_workers,
             dump_cb=self._dump_rank_stacks,
             abort_cb=self._abort_workers,
         )
@@ -1095,7 +1380,33 @@ class LocalStrategy(TpuStrategy):
 
         if config.megastep is None and self.megastep is not None:
             config = dataclasses.replace(config, megastep=self.megastep)
-        mesh = build_mesh(MeshSpec(self.mesh_axes))
+        # Gang-packing: inside a tune_run trial holding a sub-mesh
+        # allocation (tuning/pack.py), build the mesh over exactly the
+        # allocated devices — concurrent trials then run on DISJOINT
+        # slices of one fleet instead of time-sharing every chip.
+        devices = None
+        try:
+            from ray_lightning_tpu.tuning.session import (
+                current_trial_devices,
+            )
+
+            indices = current_trial_devices()
+        except Exception:  # noqa: BLE001 - tuner not in play
+            indices = None
+        if indices:
+            import jax
+
+            all_devices = jax.devices()
+            bad = [i for i in indices if not 0 <= i < len(all_devices)]
+            if bad:
+                raise ValueError(
+                    f"trial sub-mesh allocation names device indices "
+                    f"{bad} but only {len(all_devices)} devices exist — "
+                    "fleet_devices must not exceed the host's device "
+                    "count for LocalStrategy trials"
+                )
+            devices = [all_devices[i] for i in indices]
+        mesh = build_mesh(MeshSpec(self.mesh_axes), devices=devices)
         common = dict(
             module=module, datamodule=datamodule, config=config,
             global_rank=0, world_size=1, mesh=mesh,
@@ -1232,6 +1543,7 @@ class MpmdStrategy(TpuStrategy):
     """
 
     mode = "mpmd"
+    supports_elastic_resize = False  # the stage count is structural
 
     def __init__(
         self,
@@ -1273,7 +1585,17 @@ class MpmdStrategy(TpuStrategy):
             raise ValueError("num_microbatches must be >= 1")
         if ckpt_every_n_steps < 1:
             raise ValueError("ckpt_every_n_steps must be >= 1")
+        if kwargs.get("elastic_min_workers") is not None:
+            raise ValueError(
+                "MpmdStrategy cannot resize elastically: the stage "
+                "count is structural (the layer split is baked into "
+                "every stage's compiled program); run SPMD strategies "
+                "for shrink/grow recovery"
+            )
         kwargs.setdefault("use_tpu", devices_per_stage is None)
+        # supports_elastic_resize = False (class attr below): the
+        # fleet-wide RLT_ELASTIC_* env bus is ignored here for the same
+        # structural reason, rather than crashing pipeline fits.
         super().__init__(num_workers=num_stages, **kwargs)
         self.schedule = schedule
         self.num_microbatches = num_microbatches

@@ -174,7 +174,8 @@ _HEARTBEAT_OPTIONAL = {
 # Event: structured monitor/worker occurrences (stall, stack_dump,
 # heartbeat_lost, straggler, crash, abort — and, since the recovery-
 # plane round: drain, preempt_restart, backoff, elastic_restart,
-# ckpt_corrupt).  rank == -1 means fleet-wide.
+# ckpt_corrupt; since the elastic-world round: resize,
+# resize_rejected).  rank == -1 means fleet-wide.
 _EVENT_REQUIRED = {
     "type": str,          # always "event"
     "kind": str,
@@ -193,7 +194,9 @@ _EVENT_OPTIONAL = {
     "ckpt": str,          # drain / restart / ckpt_corrupt checkpoint path
     "delay_s": (int, float),    # backoff events: the observed delay
     "attempt": int,             # backoff / elastic_restart ordinal
-    "recover_s": (int, float),  # elastic_restart: respawn+discovery time
+    "recover_s": (int, float),  # elastic_restart/resize: respawn time
+    "old_world": int,           # resize/resize_rejected: world before
+    "new_world": int,           # resize/resize_rejected: world after
 }
 
 # Log: a rank-tagged forwarded logging record (warning+ severity).
@@ -635,18 +638,29 @@ def validate_bench_telemetry(block: Any,
 
 # The bench fault block: recovery cost lands in the perf trajectory
 # (crash → resumed wall time, drain checkpoint write time, the backoff
-# actually slept).  Every key is nullable — each probe is best-effort.
+# actually slept; since the elastic-world round: lost worker → resumed
+# -at-smaller-world wall delta).  Every key is nullable — each probe is
+# best-effort.
 _BENCH_FAULT_OPTIONAL = {
     "time_to_recover_s": (int, float, type(None)),
     "drain_checkpoint_s": (int, float, type(None)),
     "backoff_s": (int, float, type(None)),
+    "resize_time_to_recover_s": (int, float, type(None)),
+    "resize_old_world": (int, type(None)),
+    "resize_new_world": (int, type(None)),
 }
 
 
 def validate_bench_fault(block: Any, where: str = "fault") -> List[str]:
     """Validate the ``fault`` block of a ``BENCH_*.json`` artifact
     (absent on pre-recovery-plane rounds)."""
-    return _check_fields(block, {}, _BENCH_FAULT_OPTIONAL, where)
+    problems = _check_fields(block, {}, _BENCH_FAULT_OPTIONAL, where)
+    if not problems and isinstance(block, dict):
+        for key in ("resize_old_world", "resize_new_world"):
+            value = block.get(key)
+            if isinstance(value, int) and value < 0:
+                problems.append(f"{where}: negative {key}")
+    return problems
 
 
 # The bench host_overhead block: how much of the step the HOST costs

@@ -7,6 +7,7 @@ from .search import (
     sample_from,
     uniform,
 )
+from .pack import FleetPacker, SubMeshAllocation
 from .schedulers import ASHAScheduler, FIFOScheduler, PopulationBasedTraining
 from .session import (
     TrialStopRequested,
@@ -28,6 +29,8 @@ __all__ = [
     "uniform",
     "ASHAScheduler",
     "FIFOScheduler",
+    "FleetPacker",
+    "SubMeshAllocation",
     "PopulationBasedTraining",
     "TrialStopRequested",
     "checkpoint_dir",

@@ -1,0 +1,141 @@
+"""Gang-packing: concurrent trials on disjoint sub-meshes of one fleet.
+
+``tune_run(max_concurrent_trials=N)`` runs N trial drivers at once, but
+until this module each trial's ``LocalStrategy`` built its mesh over
+EVERY visible device — concurrent trials silently time-shared the same
+chips.  The :class:`FleetPacker` is the missing resource layer: one
+fleet of ``total_devices`` device slots, carved into disjoint
+allocations that trials acquire before running and release after.
+``build_mesh(devices=...)`` already accepts an explicit device list, so
+an allocation IS a sub-mesh.
+
+Elastic interplay (the reason this lives in the recovery PR): when a
+trial's restart governor resizes its world (``elastic_min_workers``,
+docs/FAULT_TOLERANCE.md "Elastic resume"), the strategy notifies the
+trial session (``session.notify_world_resize``) and the packer
+**re-packs** — a shrunk trial's freed devices immediately become
+capacity for queued trials, and a grown trial reclaims free slots
+(best-effort: growth never steals from a running peer).
+
+Thread-safe; blocking ``acquire`` with condition-variable wakeups on
+every release/shrink.  jax-free — allocations are device *indices*;
+the strategy resolves them against ``jax.devices()``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+__all__ = ["FleetPacker", "SubMeshAllocation"]
+
+
+class SubMeshAllocation:
+    """A trial's slice of the fleet: a sorted list of device indices.
+
+    The list identity is stable across :meth:`FleetPacker.resize` —
+    holders that keep a reference (the trial session) always see the
+    current membership.
+    """
+
+    def __init__(self, packer: "FleetPacker", devices: List[int]):
+        self._packer = packer
+        self.devices = sorted(devices)
+        self.released = False
+
+    @property
+    def n(self) -> int:
+        return len(self.devices)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"SubMeshAllocation({self.devices})"
+
+
+class FleetPacker:
+    """Disjoint device-slot allocator for one fleet.
+
+    * :meth:`acquire` blocks until at least ``min_n`` slots are free,
+      then takes ``min(n, free)`` — a trial may deliberately START
+      shrunk on a busy fleet rather than wait for its full request.
+    * :meth:`resize` re-packs a live allocation to ``new_n`` slots:
+      shrinking frees the highest-numbered slots (and wakes waiters);
+      growing takes free slots up to ``new_n``, keeping the current
+      size when the fleet has none spare (growth must never deadlock a
+      running trial).  Returns the actual post-resize size.
+    * :meth:`release` returns everything and wakes every waiter.
+    """
+
+    def __init__(self, total_devices: int):
+        if total_devices < 1:
+            raise ValueError("total_devices must be >= 1")
+        self.total_devices = int(total_devices)
+        self._free = set(range(self.total_devices))
+        self._cond = threading.Condition()
+        self._allocs: List[SubMeshAllocation] = []
+
+    def acquire(self, n: int, min_n: Optional[int] = None,
+                timeout: Optional[float] = None) -> SubMeshAllocation:
+        n = int(n)
+        min_n = n if min_n is None else int(min_n)
+        if not 1 <= min_n <= n:
+            raise ValueError(
+                f"need 1 <= min_n ({min_n}) <= n ({n})"
+            )
+        if min_n > self.total_devices:
+            raise ValueError(
+                f"min_n {min_n} exceeds the fleet "
+                f"({self.total_devices} devices)"
+            )
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: len(self._free) >= min_n, timeout=timeout
+            )
+            if not ok:
+                raise TimeoutError(
+                    f"no {min_n} free devices within {timeout}s "
+                    f"({len(self._free)}/{self.total_devices} free)"
+                )
+            take = sorted(self._free)[: min(n, len(self._free))]
+            self._free.difference_update(take)
+            alloc = SubMeshAllocation(self, take)
+            self._allocs.append(alloc)
+            return alloc
+
+    def resize(self, alloc: SubMeshAllocation, new_n: int) -> int:
+        new_n = max(int(new_n), 0)
+        with self._cond:
+            if alloc.released:
+                return 0
+            if new_n < alloc.n:
+                # Shrink: free the highest slots so the low-numbered
+                # prefix stays stable (mesh rebuilds see a prefix of
+                # the old device set, not a reshuffle).
+                drop = alloc.devices[new_n:]
+                del alloc.devices[new_n:]
+                self._free.update(drop)
+                self._cond.notify_all()
+            elif new_n > alloc.n:
+                want = new_n - alloc.n
+                grab = sorted(self._free)[:want]
+                self._free.difference_update(grab)
+                alloc.devices.extend(grab)
+                alloc.devices.sort()
+            return alloc.n
+
+    def release(self, alloc: SubMeshAllocation) -> None:
+        with self._cond:
+            if alloc.released:
+                return
+            alloc.released = True
+            self._free.update(alloc.devices)
+            if alloc in self._allocs:
+                self._allocs.remove(alloc)
+            self._cond.notify_all()
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._cond:
+            return {
+                "total": self.total_devices,
+                "free": sorted(self._free),
+                "allocations": [list(a.devices) for a in self._allocs],
+            }

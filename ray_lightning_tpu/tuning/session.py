@@ -25,6 +25,8 @@ __all__ = [
     "report",
     "checkpoint_dir",
     "get_checkpoint",
+    "current_trial_devices",
+    "notify_world_resize",
 ]
 
 
@@ -45,12 +47,23 @@ class TrialSession:
         local_dir: str,
         on_report: Optional[Callable[[Dict[str, Any]], str]] = None,
         restore_path: Optional[str] = None,
+        devices: Optional[list] = None,
     ):
         self.trial_id = trial_id
         self.local_dir = local_dir
         self._on_report = on_report
         self.reports: list = []
         self.training_iteration = 0
+        # Gang-packing (tuning/pack.py): the device INDICES this trial
+        # was allocated out of the shared fleet — LocalStrategy builds
+        # its mesh over exactly these, so concurrent trials run on
+        # disjoint sub-meshes instead of time-sharing every chip.
+        # ``on_resize(old_world, new_world)`` is the elastic hook the
+        # restart governor calls when it resizes the trial's world; the
+        # tuner wires it to the packer so freed devices re-enter the
+        # pool mid-experiment.
+        self.devices = devices
+        self.on_resize: Optional[Callable[[int, int], None]] = None
         # Checkpoint this trial should START from (PBT exploit: the donor
         # trial's weights — reference ``tune.py:136-178``'s reason to
         # exist).  Read by the trainable via :func:`get_checkpoint`.
@@ -194,3 +207,25 @@ def get_checkpoint() -> Optional[str]:
     if sess is None:
         return None
     return sess.restore_path
+
+
+def current_trial_devices() -> Optional[list]:
+    """Device indices of the active trial's sub-mesh allocation, or
+    ``None`` outside a gang-packed trial.  LocalStrategy consults this
+    at mesh-build time, so trainables need no packer plumbing."""
+    sess = _current()
+    if sess is None:
+        return None
+    return sess.devices
+
+
+def notify_world_resize(old_world: int, new_world: int) -> None:
+    """Elastic-governor → gang-packer bridge: called by the strategy
+    when it resizes a trial's world (docs/FAULT_TOLERANCE.md "Elastic
+    resume").  No-op outside a trial session or when the tuner wired no
+    packer — resizing is an observer concern, never a restart
+    dependency."""
+    sess = _current()
+    if sess is None or sess.on_resize is None:
+        return
+    sess.on_resize(int(old_world), int(new_world))

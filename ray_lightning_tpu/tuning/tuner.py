@@ -148,6 +148,9 @@ def tune_run(
     raise_on_trial_error: bool = False,
     verbose: bool = True,
     max_concurrent_trials: int = 1,
+    fleet_devices: Optional[int] = None,
+    devices_per_trial: Optional[int] = None,
+    min_devices_per_trial: Optional[int] = None,
 ) -> ExperimentAnalysis:
     """Run an experiment: sample configs, execute trials, schedule stops.
 
@@ -167,6 +170,18 @@ def tune_run(
     Schedulers are shared and lock-protected; PBT exploits from whatever
     population state exists when a trial STARTS (the same asynchronous
     semantics real concurrent PBT has).
+
+    **Gang-packing** (``fleet_devices=``): with a fleet size set, every
+    trial acquires a disjoint sub-mesh allocation from one
+    :class:`~ray_lightning_tpu.tuning.pack.FleetPacker` before it runs
+    (``devices_per_trial`` slots, defaulting to an even
+    ``fleet_devices / max_concurrent_trials`` split; a trial may start
+    with as few as ``min_devices_per_trial`` on a busy fleet).
+    ``LocalStrategy`` builds its mesh over exactly the allocated
+    devices, so concurrent trials stop time-sharing chips — and when a
+    trial's elastic restart governor shrinks its world
+    (docs/FAULT_TOLERANCE.md "Elastic resume"), the packer re-packs:
+    the freed devices immediately become capacity for queued trials.
     """
     import threading
 
@@ -175,6 +190,34 @@ def tune_run(
     os.makedirs(local_dir, exist_ok=True)
     if max_concurrent_trials < 1:
         raise ValueError("max_concurrent_trials must be >= 1")
+    packer = None
+    if fleet_devices is not None:
+        from .pack import FleetPacker
+
+        packer = FleetPacker(fleet_devices)
+        if devices_per_trial is None:
+            devices_per_trial = max(
+                fleet_devices // max_concurrent_trials, 1
+            )
+        if not 1 <= devices_per_trial <= fleet_devices:
+            raise ValueError(
+                f"devices_per_trial must be in [1, {fleet_devices}], "
+                f"got {devices_per_trial}"
+            )
+        if min_devices_per_trial is not None and not (
+            1 <= min_devices_per_trial <= devices_per_trial
+        ):
+            # Validated HERE, not at the first acquire inside a trial
+            # thread — a config typo must fail the experiment eagerly,
+            # not as a phantom trial error mid-run.
+            raise ValueError(
+                f"min_devices_per_trial must be in [1, "
+                f"{devices_per_trial}], got {min_devices_per_trial}"
+            )
+    elif devices_per_trial is not None or min_devices_per_trial is not None:
+        raise ValueError(
+            "devices_per_trial/min_devices_per_trial need fleet_devices"
+        )
     trials: List[Optional[Trial]] = [None] * len(configs)
     # Latest checkpoint each trial wrote — the donor pool for PBT's
     # exploit step (config mutation alone is only half of PBT; the
@@ -204,10 +247,34 @@ def tune_run(
                 _trial.reports.append(record)
                 return scheduler.on_result(_trial.trial_id, record)
 
+        # Gang-packing: claim this trial's sub-mesh BEFORE the session
+        # exists (a blocked acquire must not hold a half-open session),
+        # and wire the elastic-resize hook so a governor shrink frees
+        # devices back into the fleet mid-experiment.
+        alloc = None
+        if packer is not None:
+            alloc = packer.acquire(
+                devices_per_trial, min_n=min_devices_per_trial
+            )
         session = init_trial_session(
             trial.trial_id, local_dir, on_report=on_report,
             restore_path=restore_path,
+            devices=alloc.devices if alloc is not None else None,
         )
+        if alloc is not None:
+
+            def _on_resize(old_world: int, new_world: int,
+                           _alloc=alloc, _sess=session) -> None:
+                # Scale the allocation with the world change so devices
+                # per worker stay constant: computed off the CURRENT
+                # size, so chained resizes (2→1→2) round-trip.
+                if old_world <= 0:
+                    return
+                new_n = max((_alloc.n * new_world) // old_world, 1)
+                packer.resize(_alloc, new_n)
+                _sess.devices = _alloc.devices
+
+            session.on_resize = _on_resize
         trial.status = "RUNNING"
         t0 = time.perf_counter()
         try:
@@ -219,12 +286,13 @@ def tune_run(
             trial.status = "ERROR"
             trial.error = traceback.format_exc()
             if raise_on_trial_error:
-                shutdown_trial_session()
-                raise
+                raise  # the finally below releases + shuts down
         finally:
             trial.duration_s = time.perf_counter() - t0
             with lock:
                 last_ckpts[trial.trial_id] = session.last_checkpoint
+            if alloc is not None:
+                packer.release(alloc)
             shutdown_trial_session()
         with lock:
             scheduler.on_trial_complete(trial.trial_id, trial.last_result)

@@ -22,6 +22,15 @@ Here every process writes only its ADDRESSABLE shards:
   leaves by index, and returns the same payload dict the single-file
   format yields — so resume stays topology-independent (save on N hosts,
   restore on 1 or M; the caller re-places onto its own shardings).
+* **reshard-on-load** (``load_sharded(dir, shardings=...)``): given the
+  NEW mesh's shardings, each host reads only the byte ranges of the
+  shard files that overlap its own addressable shards (the ``RLTSHRD2``
+  file layout keeps every leaf entry's offset/length in a small header,
+  so a ZeRO-3 restore on M hosts never reassembles the full model on
+  any of them) and the leaves come back as device-placed ``jax.Array``s
+  on the new mesh.  The shard layout problem of arXiv:2004.13336 —
+  re-partitioning weight-update shards for a different replica count —
+  reduces to index intersection against the recorded global indices.
 
 Trust model matches ``state_stream``: leaf DATA is raw msgpack bytes;
 the treedef/metadata are pickled, so checkpoints are only as trustworthy
@@ -32,8 +41,9 @@ from __future__ import annotations
 
 import os
 import pickle
+import struct
 import zlib
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import msgpack
@@ -48,14 +58,30 @@ __all__ = [
     "save_shard",
     "save_meta",
     "load_sharded",
+    "load_meta",
     "is_sharded_ckpt",
     "verify_sharded",
     "verify_checkpoint",
     "CorruptCheckpointError",
+    "LOAD_STATS",
 ]
 
 _META = "META.ckpt"
 _CRC_SUFFIX = ".crc32"
+# v2 shard file layout: magic + u32 header length + msgpack header +
+# raw data section.  The header carries, per leaf entry, the entry's
+# global index AND its (offset, length, crc32) inside the data section,
+# so a reshard-on-load reader can seek straight to the bytes its own
+# shards need.  v1 files (a bare msgpack blob) still load.
+_SHARD_MAGIC = b"RLTSHRD2"
+
+# Accounting of the most recent load_sharded call in this process
+# (read-only diagnostics; tests pin the selective reader's I/O here):
+# bytes_read counts shard-file payload bytes actually read, selective
+# says whether the index-selective path ran.
+LOAD_STATS: Dict[str, Any] = {
+    "bytes_read": 0, "entries_read": 0, "selective": False,
+}
 
 
 def _shard_name(rank: int, world: int) -> str:
@@ -97,6 +123,104 @@ def _leaf_record(leaf: Any) -> Dict[str, Any]:
     }
 
 
+def _encode_shard_v2(rank: int, world: int,
+                     records: List[Dict[str, Any]]) -> bytes:
+    """Serialize leaf records into the seekable v2 layout: each entry's
+    raw bytes move to a trailing data section, and the header keeps the
+    entry's global index plus ``(offset, length, crc32)`` so a selective
+    reader can fetch exactly the blocks overlapping its shards."""
+    data_parts: List[bytes] = []
+    offset = 0
+    header_leaves: List[Dict[str, Any]] = []
+    for rec in records:
+        entries = []
+        for e in rec["e"]:
+            b = e["b"]
+            entries.append({
+                "i": e["i"], "o": offset, "n": len(b),
+                "c": zlib.crc32(b),
+            })
+            data_parts.append(b)
+            offset += len(b)
+        header_leaves.append({"s": rec["s"], "d": rec["d"], "e": entries})
+    header = msgpack.packb(
+        {"rank": rank, "world": world, "leaves": header_leaves},
+        use_bin_type=True,
+    )
+    return b"".join(
+        [_SHARD_MAGIC, struct.pack("<I", len(header)), header, *data_parts]
+    )
+
+
+def _read_shard_header(
+    path: str, expected_crc: Optional[int] = None,
+) -> Tuple[Dict[str, Any], int]:
+    """Parse a shard file's header WITHOUT reading its data section.
+
+    Returns ``(header, data_offset)``.  v1 files (no magic) are read in
+    full and normalized to the v2 header shape with the entry bytes
+    inlined under ``"b"`` (``data_offset == -1`` marks them) — their
+    bytes are in memory anyway, so ``expected_crc`` (the META-recorded
+    whole-file checksum) is checked here: a v1 shard has no per-entry
+    checksums for the selective reader to fall back on.
+    """
+    with open(path, "rb") as f:
+        magic = f.read(len(_SHARD_MAGIC))
+        if magic == _SHARD_MAGIC:
+            (hlen,) = struct.unpack("<I", f.read(4))
+            try:
+                header = msgpack.unpackb(f.read(hlen), raw=False)
+            except Exception as e:  # noqa: BLE001 - corrupt ≠ crash
+                raise CorruptCheckpointError(
+                    f"{path}: unparsable shard header ({e})"
+                ) from e
+            LOAD_STATS["bytes_read"] += len(_SHARD_MAGIC) + 4 + hlen
+            return header, len(_SHARD_MAGIC) + 4 + hlen
+        raw = magic + f.read()
+    LOAD_STATS["bytes_read"] += len(raw)
+    if expected_crc is not None and zlib.crc32(raw) != expected_crc:
+        raise CorruptCheckpointError(
+            f"{path}: checksum mismatch — torn write or bit corruption"
+        )
+    try:
+        payload = msgpack.unpackb(raw, raw=False)
+    except Exception as e:  # noqa: BLE001
+        raise CorruptCheckpointError(
+            f"{path}: unparsable shard file ({e})"
+        ) from e
+    return payload, -1
+
+
+def _entry_bytes(path: str, entry: Dict[str, Any], data_offset: int,
+                 fh=None) -> bytes:
+    """One entry's raw bytes: an inline v1 payload, or a seek+read of
+    the v2 data section (verified against the entry's own crc32, so a
+    selective load that skips the whole-file checksum still never
+    deserializes silently corrupted bytes).  ``fh`` is an already-open
+    handle on ``path`` — callers reading many entries of one shard file
+    pass it so the read costs one open per FILE, not one per entry."""
+    if data_offset < 0:
+        return entry["b"]
+    if fh is None:
+        with open(path, "rb") as f:
+            f.seek(data_offset + entry["o"])
+            b = f.read(entry["n"])
+    else:
+        fh.seek(data_offset + entry["o"])
+        b = fh.read(entry["n"])
+    LOAD_STATS["bytes_read"] += len(b)
+    LOAD_STATS["entries_read"] += 1
+    expected = entry.get("c")
+    if len(b) != entry["n"] or (
+        expected is not None and zlib.crc32(b) != expected
+    ):
+        raise CorruptCheckpointError(
+            f"{path}: shard entry at offset {entry['o']} failed its "
+            "crc32 — torn write or bit corruption"
+        )
+    return b
+
+
 def _dtype_of(name: str) -> np.dtype:
     if name == "bfloat16":
         import ml_dtypes
@@ -115,10 +239,8 @@ def save_shard(tree: Any, dirpath: str, rank: int, world: int) -> str:
     """
     os.makedirs(dirpath, exist_ok=True)
     leaves, _ = jax.tree_util.tree_flatten(tree)
-    blob = msgpack.packb(
-        {"rank": rank, "world": world,
-         "leaves": [_leaf_record(leaf) for leaf in leaves]},
-        use_bin_type=True,
+    blob = _encode_shard_v2(
+        rank, world, [_leaf_record(leaf) for leaf in leaves]
     )
     path = os.path.join(dirpath, _shard_name(rank, world))
     tmp = f"{path}.tmp{os.getpid()}"
@@ -222,19 +344,132 @@ def is_sharded_ckpt(path: str) -> bool:
     )
 
 
-def load_sharded(dirpath: str) -> Dict[str, Any]:
-    """Reassemble a payload dict: ``{"state": host_tree, **extra}``.
+def load_meta(dirpath: str) -> Dict[str, Any]:
+    """META alone — the cheap pre-load peek: ``{"world": <shard count>,
+    "extra": {...}}`` without touching any shard file.  The elastic
+    resume path reads the recorded ``world_size``/``accum`` here BEFORE
+    building the optimizer, so the accumulation factor can be re-derived
+    for a different world size (global-batch invariance)."""
+    meta = _load_meta(dirpath)
+    return {
+        "world": meta["world"],
+        "extra": pickle.loads(meta["extra"]) if "extra" in meta else {},
+    }
 
-    Verify-on-load: every shard file's bytes are checked against the
-    META-recorded checksum before anything is deserialized — a
-    bit-flipped or torn shard raises :class:`CorruptCheckpointError`
-    instead of silently resuming garbage into the params.
+
+def _parse_shard_blob(raw: bytes, path: str) -> Dict[str, Any]:
+    """An in-memory shard blob → normalized v1-shaped payload (entry
+    bytes inlined under ``"b"``), accepting both file layouts."""
+    if raw[: len(_SHARD_MAGIC)] == _SHARD_MAGIC:
+        (hlen,) = struct.unpack(
+            "<I", raw[len(_SHARD_MAGIC): len(_SHARD_MAGIC) + 4]
+        )
+        base = len(_SHARD_MAGIC) + 4
+        try:
+            header = msgpack.unpackb(raw[base: base + hlen], raw=False)
+        except Exception as e:  # noqa: BLE001
+            raise CorruptCheckpointError(
+                f"{path}: unparsable shard header ({e})"
+            ) from e
+        data_off = base + hlen
+        for rec in header["leaves"]:
+            for e in rec["e"]:
+                e["b"] = raw[data_off + e["o"]: data_off + e["o"] + e["n"]]
+        return header
+    try:
+        return msgpack.unpackb(raw, raw=False)
+    except Exception as e:  # noqa: BLE001 - corrupt ≠ crash-on-load
+        raise CorruptCheckpointError(
+            f"{path}: unparsable shard file ({e})"
+        ) from e
+
+
+def _check_shard_identity(payload: Dict[str, Any], dirpath: str,
+                          path: str, rank: int, world: int) -> None:
+    # Guard against rank mixups / stale copies: the file must agree
+    # with its own name about who wrote it for which world size.
+    if payload.get("rank") != rank or payload.get("world") != world:
+        raise ValueError(
+            f"sharded checkpoint {dirpath}: {os.path.basename(path)} "
+            f"claims rank={payload.get('rank')} world="
+            f"{payload.get('world')} — rank mixup or stale copy"
+        )
+
+
+def _needed_regions(sharding: Any, shape: tuple) -> Optional[List[tuple]]:
+    """The unique global index regions THIS PROCESS's devices hold under
+    ``sharding``, as ``((start, stop), ...)`` per-dim tuples — or
+    ``None`` when the target is not a device sharding (caller falls
+    back to a full host read of that leaf)."""
+    index_map_fn = getattr(
+        sharding, "addressable_devices_indices_map", None
+    )
+    if index_map_fn is None:
+        return None
+    try:
+        index_map = index_map_fn(tuple(shape))
+    except Exception:  # noqa: BLE001 - shape/sharding mismatch: the
+        # caller's coverage check will say so on the full-read path.
+        return None
+    regions = set()
+    for idx in index_map.values():
+        regions.add(tuple(
+            (0 if s.start is None else int(s.start),
+             dim if s.stop is None else int(s.stop))
+            for s, dim in zip(idx, shape)
+        ))
+    return sorted(regions)
+
+
+def _regions_overlap(a: tuple, b: tuple) -> bool:
+    return all(
+        max(a0, b0) < min(a1, b1) for (a0, a1), (b0, b1) in zip(a, b)
+    )
+
+
+def _flatten_shardings(shardings: Any, treedef) -> Optional[List[Any]]:
+    """Sharding leaves congruent with the CHECKPOINT's treedef, or
+    ``None`` when the structures differ (a checkpoint carrying an EF
+    residual restored into a run without one, and vice versa) — the
+    caller then falls back to the topology-independent full read."""
+    if shardings is None:
+        return None
+    try:
+        flat, sh_def = jax.tree_util.tree_flatten(shardings)
+    except Exception:  # noqa: BLE001
+        return None
+    if sh_def != treedef:
+        return None
+    return flat
+
+
+def load_sharded(dirpath: str, shardings: Any = None) -> Dict[str, Any]:
+    """Reassemble a payload dict: ``{"state": tree, **extra}``.
+
+    Without ``shardings`` every leaf comes back as a full host numpy
+    array (save on N hosts, restore anywhere).  With ``shardings`` — a
+    pytree of ``jax.sharding.Sharding`` congruent with the saved state —
+    the **index-selective** path runs: this process reads only the shard
+    -file byte ranges overlapping its own addressable shards and the
+    leaves come back as ``jax.Array``s already placed on the new mesh
+    (reshard-on-load; no full-model reassembly on any host).  A
+    structure mismatch between ``shardings`` and the checkpoint falls
+    back to the full host read.
+
+    Verify-on-load: the full path checks every shard file's bytes
+    against the META-recorded checksum; the selective path checks each
+    entry it reads against the entry's own crc32 (whole-file checksums
+    would force reading the bytes selectivity exists to skip) — either
+    way a bit-flipped or torn block raises
+    :class:`CorruptCheckpointError` instead of silently resuming garbage
+    into the params.
     """
     meta = _load_meta(dirpath)
     world = meta["world"]
     treedef = pickle.loads(meta["treedef"])
     extra = pickle.loads(meta["extra"])
     shard_crcs = meta.get("shard_crcs") or {}
+    LOAD_STATS.update(bytes_read=0, entries_read=0, selective=False)
 
     shard_files = [
         os.path.join(dirpath, _shard_name(r, world)) for r in range(world)
@@ -246,11 +481,22 @@ def load_sharded(dirpath: str) -> Dict[str, Any]:
             f"{len(missing)}/{world} shard files (e.g. {missing[0]})"
         )
 
+    sharding_leaves = _flatten_shardings(shardings, treedef)
+    if sharding_leaves is not None:
+        out = _load_selective(
+            dirpath, shard_files, world, sharding_leaves, shard_crcs
+        )
+        if out is not None:
+            LOAD_STATS["selective"] = True
+            return {"state": jax.tree_util.tree_unflatten(treedef, out),
+                    **extra}
+
     leaves: List[Optional[np.ndarray]] = []
     covered: List[Optional[np.ndarray]] = []
     for rank, path in enumerate(shard_files):
         with open(path, "rb") as f:
             raw = f.read()
+        LOAD_STATS["bytes_read"] += len(raw)
         expected = shard_crcs.get(str(rank))
         if expected is not None and zlib.crc32(raw) != expected:
             raise CorruptCheckpointError(
@@ -258,21 +504,8 @@ def load_sharded(dirpath: str) -> Dict[str, Any]:
                 f"{os.path.basename(path)} checksum mismatch — torn "
                 "write or bit corruption"
             )
-        try:
-            payload = msgpack.unpackb(raw, raw=False)
-        except Exception as e:  # noqa: BLE001 - corrupt ≠ crash-on-load
-            raise CorruptCheckpointError(
-                f"sharded checkpoint {dirpath}: "
-                f"{os.path.basename(path)} is unparsable ({e})"
-            ) from e
-        # Guard against rank mixups / stale copies: the file must agree
-        # with its own name about who wrote it for which world size.
-        if payload.get("rank") != rank or payload.get("world") != world:
-            raise ValueError(
-                f"sharded checkpoint {dirpath}: {os.path.basename(path)} "
-                f"claims rank={payload.get('rank')} world="
-                f"{payload.get('world')} — rank mixup or stale copy"
-            )
+        payload = _parse_shard_blob(raw, f"sharded checkpoint {dirpath}")
+        _check_shard_identity(payload, dirpath, path, rank, world)
         records = payload["leaves"]
         if not leaves:
             leaves = [None] * len(records)
@@ -317,6 +550,185 @@ def load_sharded(dirpath: str) -> Dict[str, Any]:
     return {"state": tree, **extra}
 
 
+def _load_selective(
+    dirpath: str,
+    shard_files: List[str],
+    world: int,
+    sharding_leaves: List[Any],
+    shard_crcs: Dict[str, int],
+) -> Optional[List[Any]]:
+    """The index-selective reader: per leaf, read only the shard-file
+    entries overlapping this process's addressable regions, assemble a
+    host buffer spanning just their bounding box, and place the leaf as
+    a ``jax.Array`` via ``make_array_from_callback``.  Returns the leaf
+    list, or ``None`` when any target leaf is not a device sharding
+    (the caller then runs the topology-independent full read)."""
+    # Per-leaf plan, fixed by the FIRST shard file's header (every shard
+    # file records the same leaf shapes — only entry coverage differs).
+    # v1 files (fully in memory anyway) verify their META whole-file
+    # checksum here; v2 files verify per ENTRY at read time.
+    first_header, first_off = _read_shard_header(
+        shard_files[0], shard_crcs.get("0")
+    )
+    _check_shard_identity(
+        first_header, dirpath, shard_files[0], 0, world
+    )
+    n_leaves = len(first_header["leaves"])
+    if n_leaves != len(sharding_leaves):
+        return None
+
+    needs: List[Optional[List[tuple]]] = []
+    for rec, sharding in zip(first_header["leaves"], sharding_leaves):
+        if rec["s"] is None:
+            needs.append(None)
+            continue
+        regions = _needed_regions(sharding, tuple(rec["s"]))
+        if regions is None:
+            # Host-side target (e.g. a residual the caller rebuilds):
+            # selective placement is impossible for this tree — let the
+            # full path produce host leaves uniformly.
+            return None
+        needs.append(regions)
+
+    # Bounding box + host buffer per leaf (None shape leaves stay None).
+    box_lo: List[Optional[tuple]] = []
+    bufs: List[Optional[np.ndarray]] = []
+    masks: List[Optional[np.ndarray]] = []
+    dtypes: List[Any] = []
+    for rec, regions in zip(first_header["leaves"], needs):
+        if rec["s"] is None or regions is None:
+            box_lo.append(None)
+            bufs.append(None)
+            masks.append(None)
+            dtypes.append(None)
+            continue
+        shape = tuple(rec["s"])
+        dtype = _dtype_of(rec["d"])
+        dtypes.append(dtype)
+        if not shape:
+            box_lo.append(())
+            bufs.append(np.empty((), dtype))
+            masks.append(np.zeros((), bool))
+            continue
+        lo = tuple(
+            min(r[d][0] for r in regions) for d in range(len(shape))
+        )
+        hi = tuple(
+            max(r[d][1] for r in regions) for d in range(len(shape))
+        )
+        box_lo.append(lo)
+        box_shape = tuple(h - l for l, h in zip(lo, hi))
+        bufs.append(np.empty(box_shape, dtype))
+        masks.append(np.zeros(box_shape, bool))
+
+    seen_entries: List[set] = [set() for _ in range(n_leaves)]
+    headers = [(first_header, first_off)]
+    for rank in range(1, world):
+        header, off = _read_shard_header(
+            shard_files[rank], shard_crcs.get(str(rank))
+        )
+        _check_shard_identity(
+            header, dirpath, shard_files[rank], rank, world
+        )
+        headers.append((header, off))
+
+    for rank, (header, data_off) in enumerate(headers):
+        path = shard_files[rank]
+        # One open per shard FILE, not one per entry: thousands of
+        # pytree leaves would otherwise pay an open/close round-trip
+        # each (a metadata RPC apiece on network filesystems).
+        fh = open(path, "rb") if data_off >= 0 else None
+        try:
+            for i, rec in enumerate(header["leaves"]):
+                regions = needs[i]
+                if regions is None or rec["s"] is None:
+                    continue
+                shape = tuple(rec["s"])
+                lo = box_lo[i]
+                for entry in rec["e"]:
+                    eidx = tuple((a, b) for a, b in entry["i"])
+                    if eidx in seen_entries[i]:
+                        continue  # local replica already read elsewhere
+                    if not shape:  # 0-d leaf: any entry IS the value
+                        seen_entries[i].add(eidx)
+                        b = _entry_bytes(path, entry, data_off, fh)
+                        bufs[i] = np.frombuffer(
+                            b, dtype=dtypes[i]
+                        ).reshape(()).copy()
+                        masks[i] = np.ones((), bool)
+                        continue
+                    if not any(
+                        _regions_overlap(eidx, r) for r in regions
+                    ):
+                        continue
+                    seen_entries[i].add(eidx)
+                    b = _entry_bytes(path, entry, data_off, fh)
+                    block = np.frombuffer(b, dtype=dtypes[i]).reshape(
+                        tuple(b1 - a1 for a1, b1 in eidx)
+                    )
+                    # Clip the entry to the bounding box and copy in.
+                    box_shape = bufs[i].shape
+                    dst = tuple(
+                        slice(max(a1 - l, 0), min(b1 - l, sz))
+                        for (a1, b1), l, sz in zip(eidx, lo, box_shape)
+                    )
+                    src = tuple(
+                        slice(d.start + l - a1, d.stop + l - a1)
+                        for d, l, (a1, _) in zip(dst, lo, eidx)
+                    )
+                    if any(d.start >= d.stop for d in dst):
+                        continue
+                    bufs[i][dst] = block[src]
+                    masks[i][dst] = True
+        finally:
+            if fh is not None:
+                fh.close()
+
+    # Coverage: every NEEDED region must be fully present.
+    for i, regions in enumerate(needs):
+        if regions is None or masks[i] is None:
+            continue
+        lo = box_lo[i]
+        for r in regions:
+            if not r:
+                sub = masks[i]
+            else:
+                sub = masks[i][tuple(
+                    slice(a - l, b - l) for (a, b), l in zip(r, lo)
+                )]
+            if not bool(np.all(sub)):
+                raise ValueError(
+                    f"sharded checkpoint {dirpath}: leaf {i} region "
+                    f"{r} is not fully covered by any shard — entries "
+                    "are incomplete or corrupt"
+                )
+
+    out: List[Any] = []
+    for i, rec in enumerate(first_header["leaves"]):
+        if rec["s"] is None:
+            out.append(None)
+            continue
+        shape = tuple(rec["s"])
+        sharding = sharding_leaves[i]
+        buf, lo = bufs[i], box_lo[i]
+
+        def cb(idx, buf=buf, lo=lo, shape=shape):
+            if not shape:
+                return buf
+            return buf[tuple(
+                slice(
+                    (0 if s.start is None else s.start) - l,
+                    (dim if s.stop is None else s.stop) - l,
+                )
+                for s, l, dim in zip(idx, lo, shape)
+            )]
+
+        out.append(
+            jax.make_array_from_callback(shape, sharding, cb)
+        )
+    return out
+
+
 def verify_sharded(dirpath: str) -> List[str]:
     """Integrity problems of a sharded checkpoint (empty = valid):
     META parse + self-checksum, every shard present, every shard's
@@ -330,6 +742,32 @@ def verify_sharded(dirpath: str) -> List[str]:
         return [str(e)]
     world = meta["world"]
     shard_crcs = meta.get("shard_crcs") or {}
+    # Shard-count agreement (elastic discovery's pre-flight): any shard
+    # file whose NAME disagrees with META's recorded world size marks a
+    # stale copy or a half-migrated directory — resuming would either
+    # miss shards (FileNotFoundError mid-restart) or mix topologies.
+    # Flagging it here lets restart discovery skip the candidate with a
+    # ``ckpt_corrupt`` event and walk back to the previous verified set
+    # instead of failing inside ``load_sharded``.
+    try:
+        names = [
+            n for n in os.listdir(dirpath)
+            if n.startswith("shard-") and n.endswith(".ckpt")
+        ]
+    except OSError as e:
+        return [f"{dirpath}: unreadable ({e})"]
+    for name in sorted(names):
+        try:
+            claimed_world = int(name.split("-of-")[1].split(".")[0])
+        except (IndexError, ValueError):
+            problems.append(f"{dirpath}/{name}: unparsable shard name")
+            continue
+        if claimed_world != world:
+            problems.append(
+                f"{dirpath}/{name}: shard written for world size "
+                f"{claimed_world} but META records {world} — stale "
+                "copy or mixed-topology write"
+            )
     for r in range(world):
         path = os.path.join(dirpath, _shard_name(r, world))
         try:

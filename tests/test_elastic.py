@@ -1,0 +1,882 @@
+"""Elastic world-size recovery: reshard-on-load, shrink/grow restart
+governance, gang-packed trials (docs/FAULT_TOLERANCE.md "Elastic
+resume").
+
+Fast tier-1 units cover the index-selective shard reader (hand-built
+shard files), accum re-derivation, the governor's resize decisions (no
+processes), the FleetPacker, and the resize event schema.  Every real
+fit — the N→M drain/resume parity matrix and the ``lose_worker`` chaos
+acceptance — is ``slow``-marked per the tier-1 budget.
+"""
+
+import os
+import threading
+import time
+import warnings
+
+import jax
+import numpy as np
+import pytest
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_lightning_tpu.core.loop import (
+    FitConfig,
+    _elastic_resume_info,
+    _rederive_accum,
+    run_fit,
+)
+from ray_lightning_tpu.fault import inject
+from ray_lightning_tpu.models.boring import BoringDataModule, BoringModel
+from ray_lightning_tpu.parallel.mesh import MeshSpec, build_mesh
+from ray_lightning_tpu.utils import sharded_ckpt as sc
+
+
+def mesh_of(n):
+    return build_mesh(MeshSpec({"data": n}), devices=jax.devices()[:n])
+
+
+# ---------------------------------------------------------------------------
+# Index-selective reader (fast units against hand-built shard files)
+# ---------------------------------------------------------------------------
+
+def _write_fake_world(dirpath, world=2):
+    """Hand-build a ``world``-host checkpoint of one (16, 8) leaf: host
+    r writes rows [r*8, (r+1)*8) — the multi-host layout a single test
+    process cannot produce through save_shard."""
+    import zlib
+
+    full = np.arange(16 * 8, dtype=np.float32).reshape(16, 8)
+    os.makedirs(dirpath, exist_ok=True)
+    rows_per = 16 // world
+    shard_crcs = {}
+    for r in range(world):
+        lo, hi = r * rows_per, (r + 1) * rows_per
+        records = [{
+            "s": [16, 8], "d": "float32",
+            "e": [{"i": [[lo, hi], [0, 8]], "b": full[lo:hi].tobytes()}],
+        }]
+        blob = sc._encode_shard_v2(r, world, records)
+        path = os.path.join(dirpath, f"shard-{r:05d}-of-{world:05d}.ckpt")
+        with open(path, "wb") as f:
+            f.write(blob)
+        with open(path + ".crc32", "w") as f:
+            f.write(str(zlib.crc32(blob)))
+        shard_crcs[str(r)] = zlib.crc32(blob)
+    import msgpack
+    import pickle
+
+    treedef = jax.tree_util.tree_structure({"w": 0})
+    body = msgpack.packb(
+        {"world": world, "treedef": pickle.dumps(treedef),
+         "extra": pickle.dumps({"epoch": 0}),
+         "shard_crcs": shard_crcs},
+        use_bin_type=True,
+    )
+    blob = msgpack.packb(
+        {"v": 2, "crc": zlib.crc32(body), "body": body}, use_bin_type=True
+    )
+    with open(os.path.join(dirpath, "META.ckpt"), "wb") as f:
+        f.write(blob)
+    return full
+
+
+def test_selective_reader_reads_only_overlapping_bytes(tmp_path):
+    """A 1-device target whose sharding needs only the first half of
+    the leaf must NOT read the second shard file's data bytes."""
+    tag = str(tmp_path / "ck.ckpt")
+    full = _write_fake_world(tag, world=2)
+    full_size = sum(
+        os.path.getsize(os.path.join(tag, n))
+        for n in os.listdir(tag) if n.endswith(".ckpt") and "shard" in n
+    )
+    # Target: rows sharded over 2 devices — each device holds 8 rows,
+    # both addressable in one process, so the WHOLE leaf is needed.
+    mesh2 = Mesh(np.array(jax.devices()[:2]), ("data",))
+    sh = {"w": NamedSharding(mesh2, P("data", None))}
+    payload = sc.load_sharded(tag, shardings=sh)
+    assert sc.LOAD_STATS["selective"]
+    np.testing.assert_array_equal(np.asarray(payload["state"]["w"]), full)
+
+    # Now a target sharding placing rows 0-7 on THIS process only:
+    # simulate via a sharding whose addressable map covers half.  A
+    # 1-device mesh over device 0 with rows replicated would need all
+    # rows; instead restrict with a custom object exposing the index
+    # map protocol.
+    class HalfSharding:
+        def addressable_devices_indices_map(self, shape):
+            return {jax.devices()[0]: (slice(0, 8), slice(0, 8))}
+
+        # make_array_from_callback needs a real Sharding — assemble via
+        # the internal reader instead and check its I/O accounting.
+
+    needs = sc._needed_regions(HalfSharding(), (16, 8))
+    assert needs == [((0, 8), (0, 8))]
+    header0, off0 = sc._read_shard_header(
+        os.path.join(tag, "shard-00000-of-00002.ckpt")
+    )
+    sc.LOAD_STATS.update(bytes_read=0, entries_read=0)
+    entry = header0["leaves"][0]["e"][0]
+    assert sc._regions_overlap(
+        tuple((a, b) for a, b in entry["i"]), needs[0]
+    )
+    header1, off1 = sc._read_shard_header(
+        os.path.join(tag, "shard-00001-of-00002.ckpt")
+    )
+    entry1 = header1["leaves"][0]["e"][0]
+    # The second shard's rows [8, 16) do not overlap the needed half.
+    assert not sc._regions_overlap(
+        tuple((a, b) for a, b in entry1["i"]), needs[0]
+    )
+    # Reading just the overlapping entry costs half the data bytes.
+    b = sc._entry_bytes(
+        os.path.join(tag, "shard-00000-of-00002.ckpt"), entry, off0
+    )
+    assert len(b) == 8 * 8 * 4
+    # The non-overlapping shard's data section (8×8 f32) stayed unread.
+    assert sc.LOAD_STATS["entries_read"] == 1
+    assert sc.LOAD_STATS["bytes_read"] <= full_size - 8 * 8 * 4
+
+
+def test_selective_reader_places_resharded_leaves(tmp_path):
+    """2-host checkpoint → 4-device mesh placement: values identical,
+    leaves arrive as jax.Arrays with the requested shardings."""
+    tag = str(tmp_path / "ck.ckpt")
+    full = _write_fake_world(tag, world=2)
+    mesh4 = Mesh(np.array(jax.devices()[:4]), ("data",))
+    sh = {"w": NamedSharding(mesh4, P("data", None))}
+    payload = sc.load_sharded(tag, shardings=sh)
+    got = payload["state"]["w"]
+    assert isinstance(got, jax.Array) and got.sharding == sh["w"]
+    np.testing.assert_array_equal(np.asarray(got), full)
+    # Structure mismatch falls back to the full host read.
+    bad = {"w": NamedSharding(mesh4, P()), "extra_leaf": None}
+    payload = sc.load_sharded(tag, shardings=bad)
+    assert not sc.LOAD_STATS["selective"]
+    np.testing.assert_array_equal(payload["state"]["w"], full)
+
+
+def test_selective_entry_crc_catches_corruption(tmp_path):
+    tag = str(tmp_path / "ck.ckpt")
+    _write_fake_world(tag, world=2)
+    path = os.path.join(tag, "shard-00000-of-00002.ckpt")
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:  # flip a byte in the DATA section
+        f.seek(size - 4)
+        byte = f.read(1)
+        f.seek(size - 4)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    mesh2 = Mesh(np.array(jax.devices()[:2]), ("data",))
+    sh = {"w": NamedSharding(mesh2, P("data", None))}
+    with pytest.raises(sc.CorruptCheckpointError, match="crc32"):
+        sc.load_sharded(tag, shardings=sh)
+
+
+def _rewrite_meta_crcs(dirpath, world):
+    """Refresh META's recorded shard checksums from the sidecars (what
+    a real rank-0 save_meta does) after a test rewrote a shard file."""
+    import msgpack
+    import pickle
+    import zlib
+
+    crcs = {}
+    for r in range(world):
+        with open(os.path.join(
+            dirpath, f"shard-{r:05d}-of-{world:05d}.ckpt.crc32"
+        )) as f:
+            crcs[str(r)] = int(f.read().strip())
+    treedef = jax.tree_util.tree_structure({"w": 0})
+    body = msgpack.packb(
+        {"world": world, "treedef": pickle.dumps(treedef),
+         "extra": pickle.dumps({"epoch": 0}), "shard_crcs": crcs},
+        use_bin_type=True,
+    )
+    blob = msgpack.packb(
+        {"v": 2, "crc": zlib.crc32(body), "body": body}, use_bin_type=True
+    )
+    with open(os.path.join(dirpath, "META.ckpt"), "wb") as f:
+        f.write(blob)
+
+
+def _rewrite_shard1_as_v1(tag, full):
+    """Replace shard 1 with a pre-elastic (bare msgpack) file."""
+    import msgpack
+    import zlib
+
+    path = os.path.join(tag, "shard-00001-of-00002.ckpt")
+    blob = msgpack.packb(
+        {"rank": 1, "world": 2, "leaves": [{
+            "s": [16, 8], "d": "float32",
+            "e": [{"i": [[8, 16], [0, 8]], "b": full[8:].tobytes()}],
+        }]},
+        use_bin_type=True,
+    )
+    with open(path, "wb") as f:
+        f.write(blob)
+    with open(path + ".crc32", "w") as f:
+        f.write(str(zlib.crc32(blob)))
+    _rewrite_meta_crcs(tag, 2)
+    return path
+
+
+def test_v1_shard_files_still_load(tmp_path):
+    """Pre-elastic shard files (bare msgpack, entry bytes inline) load
+    through both the full and the selective path."""
+    tag = str(tmp_path / "ck.ckpt")
+    full = _write_fake_world(tag, world=2)
+    _rewrite_shard1_as_v1(tag, full)
+    payload = sc.load_sharded(tag)
+    np.testing.assert_array_equal(payload["state"]["w"], full)
+    mesh2 = Mesh(np.array(jax.devices()[:2]), ("data",))
+    payload = sc.load_sharded(
+        tag, shardings={"w": NamedSharding(mesh2, P("data", None))}
+    )
+    np.testing.assert_array_equal(np.asarray(payload["state"]["w"]), full)
+
+
+def test_v1_selective_load_still_verifies_checksums(tmp_path):
+    """Review regression: the selective path must NOT bypass integrity
+    for v1 shards (no per-entry crcs there) — the META whole-file
+    checksum is checked at header-read time instead."""
+    tag = str(tmp_path / "ck.ckpt")
+    full = _write_fake_world(tag, world=2)
+    path = _rewrite_shard1_as_v1(tag, full)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:  # flip a byte mid-file
+        f.seek(size // 2)
+        byte = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    mesh2 = Mesh(np.array(jax.devices()[:2]), ("data",))
+    sh = {"w": NamedSharding(mesh2, P("data", None))}
+    with pytest.raises(sc.CorruptCheckpointError, match="checksum"):
+        sc.load_sharded(tag, shardings=sh)
+    with pytest.raises(sc.CorruptCheckpointError, match="checksum"):
+        sc.load_sharded(tag)
+
+
+def test_verify_flags_world_mismatch_and_discovery_walks_back(tmp_path):
+    """Satellite: a candidate dir whose shard files disagree with
+    META's world size is skipped with a ckpt_corrupt-style record, and
+    discovery walks back to the previous verified checkpoint."""
+    from ray_lightning_tpu.parallel.strategies import (
+        _remote_latest_restart_checkpoint,
+    )
+
+    mesh = Mesh(mesh_utils.create_device_mesh((8,)), ("data",))
+    tree = {"w": jax.device_put(
+        np.arange(64, dtype=np.float32).reshape(8, 8),
+        NamedSharding(mesh, P("data", None)),
+    )}
+    rdir = tmp_path / "restarts"
+    good = str(rdir / "restart-epoch-000000.ckpt")
+    sc.save_shard(tree, good, rank=0, world=1)
+    sc.save_meta(tree, good, world=1)
+    time.sleep(0.05)
+    stale = str(rdir / "restart-epoch-000001.ckpt")
+    sc.save_shard(tree, stale, rank=0, world=1)
+    sc.save_meta(tree, stale, world=1)
+    # A leftover shard from an older, larger world in the newest dir.
+    with open(os.path.join(stale, "shard-00000-of-00004.ckpt"), "wb") as f:
+        f.write(b"leftover")
+    problems = sc.verify_sharded(stale)
+    assert any("world size 4" in p for p in problems)
+    info = _remote_latest_restart_checkpoint(str(rdir))
+    assert info["path"] == good
+    assert [c["path"] for c in info["corrupt"]] == [stale]
+
+
+# ---------------------------------------------------------------------------
+# Accum re-derivation (global-batch invariance)
+# ---------------------------------------------------------------------------
+
+def test_rederive_accum():
+    assert _rederive_accum(4, 2, 2) == 4      # shrink 4→2 doubles accum
+    assert _rederive_accum(2, 2, 4) == 1      # grow 2→4 halves it
+    assert _rederive_accum(2, 3, 2) == 3      # same world: unchanged
+    assert _rederive_accum(2, 1, 4) is None   # 2 rows !% 4 → not exact
+    assert _rederive_accum(3, 2, 2) == 3      # 6 / 2
+    assert _rederive_accum(1, 1, 0) is None
+
+
+def test_elastic_resume_info_reads_meta(tmp_path):
+    mesh = Mesh(mesh_utils.create_device_mesh((8,)), ("data",))
+    tree = {"w": jax.device_put(
+        np.arange(8, dtype=np.float32), NamedSharding(mesh, P())
+    )}
+    tag = str(tmp_path / "drain-step-00000006.ckpt")
+    sc.save_shard(tree, tag, rank=0, world=1)
+    sc.save_meta(tree, tag, world=1,
+                 extra={"world_size": 2, "accum": 2, "epoch": 0})
+    info = _elastic_resume_info(tag, world_size=1, cfg_accum=2)
+    assert info is not None and info["accum"] == 4 and info["exact"]
+    assert (info["old_world"], info["new_world"]) == (2, 1)
+    # Same world + same accum: no resize.
+    assert _elastic_resume_info(tag, world_size=2, cfg_accum=2) is None
+    # Same world but the checkpoint's recorded accum differs (a
+    # previous elastic resize re-derived it): the recorded value wins
+    # — reverting to the config's would change the global batch
+    # mid-trajectory and hand the resume a mismatched opt_state.
+    cont = _elastic_resume_info(tag, world_size=2, cfg_accum=1)
+    assert cont is not None and cont["accum"] == 2
+    assert cont["old_world"] == cont["new_world"] == 2
+    # Pre-elastic checkpoint (no recorded world): no resize.
+    tag2 = str(tmp_path / "drain-step-00000007.ckpt")
+    sc.save_shard(tree, tag2, rank=0, world=1)
+    sc.save_meta(tree, tag2, world=1, extra={"epoch": 0})
+    assert _elastic_resume_info(tag2, world_size=1, cfg_accum=2) is None
+
+
+@pytest.mark.slow
+def test_accum_rederived_in_fit(tmp_path):
+    """A checkpoint claiming world_size=2, accum=2 resumed at world 1
+    must train with accum 4: 8 micro-batches advance exactly 2
+    optimizer steps."""
+    dm = BoringDataModule(length=128, batch_size=16)
+    cfg = FitConfig(max_epochs=1, seed=0, default_root_dir=str(tmp_path),
+                    restart_dir=str(tmp_path / "rs"))
+    res = run_fit(BoringModel(), dm, cfg, callbacks=[])
+    tag = str(tmp_path / "rs" / "restart-epoch-000000.ckpt")
+    assert sc.is_sharded_ckpt(tag)
+    # Rewrite META claiming the state came from a 2-host, accum-2 run.
+    payload = sc.load_meta(tag)
+    extra = dict(payload["extra"])
+    extra.update(world_size=2, accum=2)
+    state = sc.load_sharded(tag)["state"]
+    sc.save_meta(state, tag, world=1, extra=extra)
+    cfg2 = FitConfig(max_epochs=2, seed=0, accumulate_grad_batches=2,
+                     default_root_dir=str(tmp_path),
+                     resume_from_checkpoint=tag)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        res2 = run_fit(BoringModel(), BoringDataModule(
+            length=128, batch_size=16), cfg2, callbacks=[])
+    assert any("elastic resume" in str(x.message) for x in w)
+    # Epoch 2: 8 micro-batches at accum 4 → 2 optimizer steps on top of
+    # the resumed counter.
+    assert res2["micro_step"] - res["micro_step"] == 8
+    assert res2["global_step"] - res["global_step"] == 2
+
+
+@pytest.mark.slow
+def test_same_world_resume_honors_recorded_accum(tmp_path):
+    """Review regression (shrink-then-crash): a checkpoint whose META
+    records an elastically re-derived accum must keep that accum on a
+    SAME-world resume, even when the config says otherwise — reverting
+    would change the global batch mid-trajectory and crash on the
+    mismatched opt_state structure."""
+    dm = BoringDataModule(length=128, batch_size=16)
+    cfg = FitConfig(max_epochs=1, seed=0, default_root_dir=str(tmp_path),
+                    restart_dir=str(tmp_path / "rs"))
+    res = run_fit(BoringModel(), dm, cfg, callbacks=[])
+    tag = str(tmp_path / "rs" / "restart-epoch-000000.ckpt")
+    # Simulate the post-shrink record: world 1, accum 2 (the first fit
+    # ran accum 1, so the opt_state is BARE — the resume must wrap it).
+    payload = sc.load_meta(tag)
+    extra = dict(payload["extra"])
+    extra.update(world_size=1, accum=2)
+    state = sc.load_sharded(tag)["state"]
+    sc.save_meta(state, tag, world=1, extra=extra)
+    cfg2 = FitConfig(max_epochs=2, seed=0, accumulate_grad_batches=1,
+                     default_root_dir=str(tmp_path),
+                     resume_from_checkpoint=tag)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        res2 = run_fit(BoringModel(), BoringDataModule(
+            length=128, batch_size=16), cfg2, callbacks=[])
+    assert any("recorded accum" in str(x.message) for x in w)
+    # Epoch 2: 8 micro-batches at the RECORDED accum 2 → 4 optimizer
+    # steps (the config's accum 1 would have made 8).
+    assert res2["micro_step"] - res["micro_step"] == 8
+    assert res2["global_step"] - res["global_step"] == 4
+
+
+# ---------------------------------------------------------------------------
+# N→M drain/resume parity (slow fits; the tentpole acceptance)
+# ---------------------------------------------------------------------------
+
+def _drain_ckpt(tmp_path, accum, megastep, drain_at=4):
+    from ray_lightning_tpu.core.callbacks import Callback
+    from ray_lightning_tpu.fault import drain as drain_mod
+    from ray_lightning_tpu.fault.drain import PreemptedError
+
+    class DrainAt(Callback):
+        def on_train_batch_end(self, trainer, module, logs, batch_idx):
+            # >= not ==: under megastep, hooks fire once per stride
+            # with micro_step advancing K at a time.
+            if trainer.micro_step >= drain_at:
+                drain_mod.request_drain("test")
+
+    cfg = FitConfig(
+        max_epochs=2, seed=0, default_root_dir=str(tmp_path),
+        restart_dir=str(tmp_path / "rs"),
+        accumulate_grad_batches=accum, megastep=megastep,
+    )
+    with pytest.raises(PreemptedError) as err:
+        run_fit(BoringModel(), BoringDataModule(length=96, batch_size=16),
+                cfg, callbacks=[DrainAt()], mesh=mesh_of(4))
+    assert err.value.checkpoint
+    return err.value.checkpoint
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("accum", [1, 4])
+@pytest.mark.parametrize("megastep", ["off", 2])
+def test_n_to_m_resume_parity(tmp_path, accum, megastep):
+    """Drain on a 4-way mesh, resume on 2 and on 1: losses and step
+    counters match an uninterrupted fit — across accum and megastep."""
+    base_cfg = FitConfig(
+        max_epochs=2, seed=0, default_root_dir=str(tmp_path),
+        accumulate_grad_batches=accum, megastep=megastep,
+    )
+    base = run_fit(
+        BoringModel(), BoringDataModule(length=96, batch_size=16),
+        base_cfg, callbacks=[], mesh=mesh_of(4),
+    )
+    ckpt = _drain_ckpt(tmp_path, accum, megastep)
+    for m in (2, 1):
+        cfg = FitConfig(
+            max_epochs=2, seed=0, default_root_dir=str(tmp_path),
+            resume_from_checkpoint=ckpt,
+            accumulate_grad_batches=accum, megastep=megastep,
+        )
+        res = run_fit(
+            BoringModel(), BoringDataModule(length=96, batch_size=16),
+            cfg, callbacks=[], mesh=mesh_of(m),
+        )
+        assert res["global_step"] == base["global_step"]
+        assert res["micro_step"] == base["micro_step"]
+        assert res["callback_metrics"]["train_loss"] == pytest.approx(
+            base["callback_metrics"]["train_loss"], abs=1e-5
+        )
+
+
+# ---------------------------------------------------------------------------
+# Capacity oracle + governor decisions (fast, no processes)
+# ---------------------------------------------------------------------------
+
+def test_lost_worker_count_expiry(tmp_path):
+    d = str(tmp_path / "chaos")
+    inject.record_worker_loss(1, regain_s=None, state_dir=d)
+    inject.record_worker_loss(2, regain_s=30.0, state_dir=d)
+    assert inject.lost_worker_count(state_dir=d) == 2
+    assert inject.lost_worker_count(
+        now=time.time() + 60, state_dir=d) == 1
+    assert inject.lost_worker_count(state_dir=str(tmp_path / "nope")) == 0
+
+
+def test_lose_worker_grammar():
+    spec = inject.parse_faults("lose_worker@point:spawn,rank:1,secs:5")[0]
+    assert spec.kind == "lose_worker" and spec.rank == 1
+    assert spec.secs == 5.0 and spec.point == "spawn"
+
+
+def test_governor_resize_decisions():
+    from ray_lightning_tpu.parallel.strategies import RayStrategy
+
+    cap = [4]
+    s = RayStrategy(num_workers=4, max_restarts=1,
+                    elastic_min_workers=2,
+                    elastic_capacity_fn=lambda: cap[0])
+    assert s.world_size == 4
+    assert s._elastic_resize_decision() == (4, False)
+    cap[0] = 3
+    assert s._elastic_resize_decision() == (3, False)
+    cap[0] = 9  # capacity above the request never grows past it
+    assert s._elastic_resize_decision() == (4, False)
+    cap[0] = 1
+    assert s._elastic_resize_decision() == (1, True)
+    # Fixed-size strategy: never resizes regardless of markers.
+    fixed = RayStrategy(num_workers=4, max_restarts=1)
+    assert fixed._elastic_resize_decision() == (None, False)
+
+
+def test_governor_knob_validation():
+    from ray_lightning_tpu.parallel.strategies import (
+        MpmdStrategy,
+        RayStrategy,
+    )
+
+    with pytest.raises(ValueError, match="elastic_min_workers"):
+        RayStrategy(num_workers=2, elastic_min_workers=3)
+    with pytest.raises(ValueError, match="elastic_min_workers"):
+        RayStrategy(num_workers=2, elastic_min_workers=0)
+    with pytest.raises(ValueError, match="elastic_grow_after_s"):
+        RayStrategy(num_workers=2, elastic_grow_after_s=-1.0)
+    with pytest.raises(ValueError, match="cannot resize"):
+        MpmdStrategy(num_stages=2, elastic_min_workers=1)
+
+
+def test_governor_env_bus(monkeypatch):
+    from ray_lightning_tpu.parallel.strategies import (
+        MpmdStrategy,
+        RayStrategy,
+    )
+
+    monkeypatch.setenv("RLT_ELASTIC_MIN_WORKERS", "1")
+    monkeypatch.setenv("RLT_ELASTIC_GROW_AFTER_S", "2.5")
+    s = RayStrategy(num_workers=2, max_restarts=1)
+    assert s.elastic_min_workers == 1
+    assert s.elastic_grow_after_s == 2.5
+    # A fleet-wide floor larger than this strategy clamps, not crashes.
+    monkeypatch.setenv("RLT_ELASTIC_MIN_WORKERS", "8")
+    s2 = RayStrategy(num_workers=2, max_restarts=1)
+    assert s2.elastic_min_workers == 2
+    # MpmdStrategy ignores the env bus entirely: stages are structural.
+    m = MpmdStrategy(num_stages=2)
+    assert m.elastic_min_workers is None
+    assert m.elastic_grow_after_s is None
+
+
+def test_governor_shrink_grow_simulation(tmp_path):
+    """The whole shrink→grow trace without processes: attempt 1 dies
+    with capacity 1 → shrink to 1 (budget-free); attempt 2 drains on
+    the grow request → respawn at 2; attempt 3 completes."""
+    from ray_lightning_tpu.cluster.actor import ActorDiedError
+    from ray_lightning_tpu.fault.drain import PreemptedError
+    from ray_lightning_tpu.parallel.strategies import RayStrategy
+
+    cap = [1]
+    s = RayStrategy(
+        num_workers=2, max_restarts=1, restart_backoff_s=0.0,
+        elastic_min_workers=1, elastic_grow_after_s=0.0,
+        elastic_capacity_fn=lambda: cap[0],
+    )
+    s._backend = object()
+    s._respawn_workers = lambda: None
+    s._kill_workers = lambda *a, **k: None
+    s._latest_restart_checkpoint = (
+        lambda rd: {"path": None, "corrupt": []}
+    )
+    worlds, attempt = [], [0]
+
+    def fake_run_once(*a, **k):
+        attempt[0] += 1
+        worlds.append(s.active_workers)
+        if attempt[0] == 1:
+            raise ActorDiedError("worker 1 preempted")
+        if attempt[0] == 2:
+            cap[0] = 2
+            s._grow_pending = True
+            raise PreemptedError("grow drain", step=5, reason="grow")
+        return [{"rank": 0}]
+
+    s._run_once = fake_run_once
+    s.run("fit", None, None,
+          FitConfig(max_epochs=1, default_root_dir=str(tmp_path)), [])
+    assert worlds == [2, 1, 2]
+    assert s.restarts_used == 0
+    assert s.preempt_restarts_used == 1
+    assert s.resizes_used == 2
+    kinds = [e["kind"] for e in s.recovery_events]
+    assert kinds.count("resize") == 2
+    resizes = [e for e in s.recovery_events if e["kind"] == "resize"]
+    assert (resizes[0]["old_world"], resizes[0]["new_world"]) == (2, 1)
+    assert (resizes[1]["old_world"], resizes[1]["new_world"]) == (1, 2)
+
+
+def test_governor_resize_flap_guard(tmp_path):
+    """Consecutive shrinks resuming from the same point must raise (a
+    flapping fleet cannot loop budget-free forever)."""
+    from ray_lightning_tpu.cluster.actor import ActorDiedError
+    from ray_lightning_tpu.parallel.strategies import RayStrategy
+
+    cap = [3]
+    s = RayStrategy(
+        num_workers=4, max_restarts=1, elastic_min_workers=1,
+        elastic_capacity_fn=lambda: cap[0],
+    )
+    s._backend = object()
+    s._respawn_workers = lambda: None
+    s._kill_workers = lambda *a, **k: None
+    s._latest_restart_checkpoint = (
+        lambda rd: {"path": "/same/ckpt", "corrupt": []}
+    )
+    attempt = [0]
+
+    def fake_run_once(*a, **k):
+        attempt[0] += 1
+        cap[0] = max(cap[0] - (attempt[0] > 1), 1)
+        raise ActorDiedError(f"death {attempt[0]}")
+
+    s._run_once = fake_run_once
+    with pytest.raises(ActorDiedError, match="flap guard"):
+        s.run("fit", None, None,
+              FitConfig(max_epochs=1, default_root_dir=str(tmp_path)), [])
+    assert attempt[0] == 3  # shrink, shrink-same-ckpt, shrink-flagged
+
+
+def test_governor_flap_guard_not_preseeded_by_scratch(tmp_path):
+    """Review regression: a fit with NO checkpoint yet (resume None)
+    must get the same two-strike allowance as one with checkpoints —
+    the initial sentinel must not make the first scratch shrink count
+    as a repeat."""
+    from ray_lightning_tpu.cluster.actor import ActorDiedError
+    from ray_lightning_tpu.parallel.strategies import RayStrategy
+
+    cap = [3]
+    s = RayStrategy(
+        num_workers=4, max_restarts=1, elastic_min_workers=1,
+        elastic_capacity_fn=lambda: cap[0],
+    )
+    s._backend = object()
+    s._respawn_workers = lambda: None
+    s._kill_workers = lambda *a, **k: None
+    s._latest_restart_checkpoint = (
+        lambda rd: {"path": None, "corrupt": []}  # always scratch
+    )
+    attempt = [0]
+
+    def fake_run_once(*a, **k):
+        attempt[0] += 1
+        if attempt[0] == 2:
+            return [{"rank": 0}]  # second attempt (first shrink) runs
+        cap[0] -= 1
+        raise ActorDiedError(f"death {attempt[0]}")
+
+    s._run_once = fake_run_once
+    s.run("fit", None, None,
+          FitConfig(max_epochs=1, default_root_dir=str(tmp_path)), [])
+    assert attempt[0] == 2  # the single scratch shrink was allowed
+    assert s.resizes_used == 1
+
+
+def test_resize_events_validate():
+    from ray_lightning_tpu.telemetry.monitor import make_event
+    from ray_lightning_tpu.telemetry.schema import (
+        validate_bench_fault,
+        validate_event,
+    )
+
+    ev = make_event("resize", -1, old_world=4, new_world=2,
+                    recover_s=1.5, ckpt="/tmp/x.ckpt", message="m")
+    assert validate_event(ev) == []
+    rej = make_event("resize_rejected", -1, old_world=4, new_world=0,
+                     message="below min")
+    assert validate_event(rej) == []
+    assert validate_bench_fault(
+        {"resize_time_to_recover_s": 2.0, "resize_old_world": 2,
+         "resize_new_world": 1}
+    ) == []
+    assert validate_bench_fault({"resize_old_world": -1})
+
+
+# ---------------------------------------------------------------------------
+# EF residual under a changed device count (satellite regression)
+# ---------------------------------------------------------------------------
+
+def test_grad_residual_dropped_loudly_on_world_change():
+    from ray_lightning_tpu.core.module import TrainState
+    from ray_lightning_tpu.parallel import grad_sync as gsync
+    from ray_lightning_tpu.telemetry import Telemetry
+
+    mesh = Mesh(mesh_utils.create_device_mesh((8,)), ("data",))
+    module = BoringModel(in_dim=64, out_dim=8)
+    gs = gsync.maybe_build_grad_sync(
+        module, mesh, {"mode": "int8_ef", "dcn_only": False}
+    )
+    assert gs is not None
+    tel = Telemetry.build({"tier": "cheap"}, 0, 1, n_chips=8)
+    gs.register_telemetry(tel)
+    params = module.init_params(jax.random.PRNGKey(0))
+    # A residual from a 4-device world: wrong leading dim here (8).
+    wrong = np.zeros((4, gs.plan.total_padded), np.float32)
+    state = TrainState(params, None, 0, wrong)
+    with pytest.warns(UserWarning, match="elastic world-size change"):
+        out = gs.reconcile_resumed_state(state)
+    assert out.grad_residual.shape == (8, gs.plan.total_padded)
+    assert not out.grad_residual.any()
+    assert tel.snapshot()["counters"]["grad_residual_dropped"] == 1
+    # A matching residual passes through untouched, silently.
+    good = np.ones((8, gs.plan.total_padded), np.float32)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        kept = gs.reconcile_resumed_state(
+            TrainState(params, None, 0, good)
+        )
+    assert kept.grad_residual is good
+
+
+# ---------------------------------------------------------------------------
+# Gang-packing (FleetPacker + session wiring; fast)
+# ---------------------------------------------------------------------------
+
+def test_fleet_packer_disjoint_and_blocking():
+    from ray_lightning_tpu.tuning.pack import FleetPacker
+
+    p = FleetPacker(8)
+    a = p.acquire(4)
+    b = p.acquire(4)
+    assert set(a.devices).isdisjoint(b.devices)
+    assert len(a.devices) == len(b.devices) == 4
+    with pytest.raises(TimeoutError):
+        p.acquire(1, timeout=0.05)
+    got = []
+    t = threading.Thread(target=lambda: got.append(p.acquire(2)))
+    t.start()
+    time.sleep(0.05)
+    assert not got  # still blocked
+    p.release(a)
+    t.join(timeout=2)
+    assert got and len(got[0].devices) == 2
+    # min_n: a busy fleet hands out what it has.
+    c = p.acquire(4, min_n=2)
+    assert len(c.devices) == 2
+    snap = p.snapshot()
+    assert snap["total"] == 8 and snap["free"] == []
+
+
+def test_fleet_packer_resize_repacks():
+    from ray_lightning_tpu.tuning.pack import FleetPacker
+
+    p = FleetPacker(8)
+    a = p.acquire(6)
+    assert p.resize(a, 3) == 3
+    assert len(p.snapshot()["free"]) == 5
+    b = p.acquire(4)
+    assert set(a.devices).isdisjoint(b.devices)
+    # Growing takes only what is free (never steals from b).
+    assert p.resize(a, 8) == 4
+    p.release(b)
+    assert p.resize(a, 8) == 8
+    p.release(a)
+    assert len(p.snapshot()["free"]) == 8
+
+
+def test_session_resize_notifies_packer(tmp_path):
+    from ray_lightning_tpu.tuning.pack import FleetPacker
+    from ray_lightning_tpu.tuning.session import (
+        current_trial_devices,
+        init_trial_session,
+        notify_world_resize,
+        shutdown_trial_session,
+    )
+
+    p = FleetPacker(8)
+    alloc = p.acquire(4)
+    sess = init_trial_session(
+        "t0", str(tmp_path), devices=alloc.devices
+    )
+    try:
+        assert current_trial_devices() == alloc.devices
+
+        def on_resize(old, new, _a=alloc, _s=sess):
+            p.resize(_a, max((_a.n * new) // old, 1))
+            _s.devices = _a.devices
+
+        sess.on_resize = on_resize
+        notify_world_resize(2, 1)  # the governor's shrink hook
+        assert len(current_trial_devices()) == 2
+        assert len(p.snapshot()["free"]) == 6
+        notify_world_resize(1, 2)  # grow back
+        assert len(current_trial_devices()) == 4
+    finally:
+        shutdown_trial_session()
+
+
+@pytest.mark.slow
+def test_gang_packed_trials_get_disjoint_meshes(tmp_path):
+    """Two concurrent LocalStrategy trials on one 8-device fleet train
+    on DISJOINT 4-device sub-meshes."""
+    from ray_lightning_tpu.core.trainer import Trainer
+    from ray_lightning_tpu.parallel.strategies import LocalStrategy
+    from ray_lightning_tpu.tuning import tune_run
+    from ray_lightning_tpu.tuning.session import (
+        current_trial_devices,
+        get_trial_session,
+        report,
+    )
+
+    seen = {}
+    lock = threading.Lock()
+
+    def trainable(cfg):
+        devs = current_trial_devices()
+        tr = Trainer(
+            strategy=LocalStrategy(), max_epochs=1,
+            limit_train_batches=2, limit_val_batches=0,
+            enable_checkpointing=False,
+            default_root_dir=str(tmp_path),
+        )
+        tr.fit(BoringModel(), BoringDataModule(batch_size=16))
+        with lock:
+            seen[get_trial_session().trial_id] = tuple(devs)
+        report(loss=float(tr.callback_metrics["train_loss"]))
+
+    ana = tune_run(
+        trainable, {"lr": 0.1}, num_samples=2,
+        max_concurrent_trials=2, fleet_devices=8,
+        local_dir=str(tmp_path / "tune"), raise_on_trial_error=True,
+    )
+    assert [t.status for t in ana.trials] == ["TERMINATED"] * 2
+    a, b = seen.values()
+    assert len(a) == len(b) == 4 and set(a).isdisjoint(b)
+
+
+# ---------------------------------------------------------------------------
+# Chaos acceptance: lose_worker → shrink (slow; real worker actors)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.remote
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_lose_worker_shrinks_and_completes(tmp_path, monkeypatch):
+    """The acceptance pin: a fit killed by a ``lose_worker`` fault
+    resumes at the smaller world size with step-exact counters, the
+    shrink is budget-free, and the resize event records
+    old/new world + recover_s (the scorecard's
+    ``resize_time_to_recover_s``)."""
+    from ray_lightning_tpu.core.trainer import Trainer
+    from ray_lightning_tpu.parallel.strategies import RayStrategy
+
+    monkeypatch.setenv("RLT_FAULT", "lose_worker@point:spawn,rank:1")
+    monkeypatch.setenv("RLT_FAULT_STATE", str(tmp_path / "chaos"))
+    strategy = RayStrategy(
+        num_workers=2, max_restarts=1, restart_backoff_s=0.05,
+        elastic_min_workers=1,
+    )
+    trainer = Trainer(
+        strategy=strategy, max_epochs=3, default_root_dir=str(tmp_path),
+        limit_train_batches=2, limit_val_batches=1,
+        enable_checkpointing=False,
+    )
+    trainer.fit(BoringModel(), BoringDataModule(batch_size=16))
+    assert trainer.global_step == 6
+    assert strategy.active_workers == 1
+    assert strategy.resizes_used == 1
+    assert strategy.restarts_used == 0  # budget-free shrink
+    kinds = [e["kind"] for e in trainer.monitor_report["events"]]
+    assert "resize" in kinds
+    resize = next(
+        e for e in trainer.monitor_report["events"]
+        if e["kind"] == "resize"
+    )
+    assert (resize["old_world"], resize["new_world"]) == (2, 1)
+    assert resize["recover_s"] > 0
+    assert strategy.last_resize_recover_s == resize["recover_s"]
+
+
+@pytest.mark.remote
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_shrink_below_min_rejects(tmp_path, monkeypatch):
+    from ray_lightning_tpu.cluster.actor import ActorDiedError
+    from ray_lightning_tpu.core.trainer import Trainer
+    from ray_lightning_tpu.parallel.strategies import RayStrategy
+
+    monkeypatch.setenv("RLT_FAULT", "lose_worker@point:spawn,rank:1")
+    monkeypatch.setenv("RLT_FAULT_STATE", str(tmp_path / "chaos"))
+    strategy = RayStrategy(
+        num_workers=2, max_restarts=1, restart_backoff_s=0.05,
+        elastic_min_workers=2,
+    )
+    trainer = Trainer(
+        strategy=strategy, max_epochs=3, default_root_dir=str(tmp_path),
+        limit_train_batches=2, limit_val_batches=1,
+        enable_checkpointing=False,
+    )
+    with pytest.raises(ActorDiedError, match="shrink rejected"):
+        trainer.fit(BoringModel(), BoringDataModule(batch_size=16))
+    assert strategy.active_workers == 2  # never resized
+    kinds = [e["kind"] for e in strategy.recovery_events]
+    assert "resize_rejected" in kinds

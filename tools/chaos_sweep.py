@@ -118,6 +118,40 @@ def _selftest() -> list:
         )
         inject._corrupt_torn(path)
         check(bool(verify_stream_file(path)), "verify: torn missed")
+
+    # Elastic world sizing: the lose_worker capacity oracle and the
+    # governor's shrink/grow/reject decision logic (pure — no fits).
+    with tempfile.TemporaryDirectory(prefix="rlt_chaos_cap_") as tmp:
+        specs = inject.parse_faults("lose_worker@point:spawn,rank:1,secs:5")
+        check(specs[0].kind == "lose_worker" and specs[0].secs == 5.0,
+              "grammar: lose_worker parse")
+        inject.record_worker_loss(1, regain_s=None, state_dir=tmp)
+        check(inject.lost_worker_count(state_dir=tmp) == 1,
+              "capacity: permanent loss not counted")
+        inject.record_worker_loss(2, regain_s=10.0, state_dir=tmp)
+        check(inject.lost_worker_count(state_dir=tmp) == 2,
+              "capacity: timed loss not counted")
+        check(inject.lost_worker_count(
+            now=time.time() + 60, state_dir=tmp) == 1,
+            "capacity: regained worker still counted")
+
+    from ray_lightning_tpu.parallel.strategies import RayStrategy
+
+    cap = [4]
+    gov = RayStrategy(num_workers=4, max_restarts=1,
+                      elastic_min_workers=2,
+                      elastic_capacity_fn=lambda: cap[0])
+    check(gov._elastic_resize_decision() == (4, False),
+          "governor: full capacity must not resize")
+    cap[0] = 3
+    check(gov._elastic_resize_decision() == (3, False),
+          "governor: shrink target wrong")
+    cap[0] = 1
+    check(gov._elastic_resize_decision() == (1, True),
+          "governor: below elastic_min_workers not rejected")
+    fixed = RayStrategy(num_workers=4, max_restarts=1)
+    check(fixed._elastic_resize_decision() == (None, False),
+          "governor: fixed-size strategy must never resize")
     return problems
 
 
@@ -191,15 +225,223 @@ def _run_scenario(name: str, fault: str, overrides: dict,
     return out
 
 
+# ---------------------------------------------------------------------------
+# Elastic world-size matrix (shrink, shrink→grow, shrink-below-min)
+# ---------------------------------------------------------------------------
+
+def _run_elastic_shrink(workers_unused: int) -> dict:
+    """A real 2-worker fit loses worker 1 at spawn (``lose_worker``):
+    the governor must respawn with the 1 survivor (budget-free), finish
+    with the exact step count, and record a ``resize`` event whose
+    ``recover_s`` is the scorecard's ``resize_time_to_recover_s``."""
+    from ray_lightning_tpu.core.trainer import Trainer
+    from ray_lightning_tpu.models.boring import (
+        BoringDataModule,
+        BoringModel,
+    )
+    from ray_lightning_tpu.parallel.strategies import RayStrategy
+
+    out = {"name": "elastic-shrink", "ok": False, "error": "",
+           "events": [], "restarts": 0, "preempts": 0, "resizes": 0,
+           "resize_time_to_recover_s": None, "wall_s": 0.0}
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory(prefix="rlt_chaos_shrink_") as tmp:
+        os.environ["RLT_FAULT"] = "lose_worker@point:spawn,rank:1"
+        os.environ["RLT_FAULT_STATE"] = os.path.join(tmp, "chaos")
+        try:
+            strategy = RayStrategy(
+                num_workers=2, max_restarts=1, restart_backoff_s=0.05,
+                elastic_min_workers=1,
+            )
+            trainer = Trainer(
+                strategy=strategy, max_epochs=3, default_root_dir=tmp,
+                limit_train_batches=2, limit_val_batches=1,
+                enable_checkpointing=False,
+            )
+            trainer.fit(BoringModel(), BoringDataModule(batch_size=16))
+            out["events"] = sorted({
+                e["kind"] for e in trainer.monitor_report.get("events", [])
+            })
+            out["restarts"] = strategy.restarts_used
+            out["preempts"] = strategy.preempt_restarts_used
+            out["resizes"] = strategy.resizes_used
+            out["resize_time_to_recover_s"] = (
+                strategy.last_resize_recover_s
+            )
+            if trainer.global_step != 6:
+                out["error"] = f"global_step {trainer.global_step} != 6"
+            elif strategy.active_workers != 1:
+                out["error"] = (
+                    f"active_workers {strategy.active_workers} != 1"
+                )
+            elif strategy.restarts_used:
+                out["error"] = "shrink consumed the restart budget"
+            elif "resize" not in out["events"]:
+                out["error"] = "no resize event recorded"
+            else:
+                out["ok"] = True
+        except Exception as e:  # noqa: BLE001
+            out["error"] = f"{type(e).__name__}: {e}"
+        finally:
+            os.environ.pop("RLT_FAULT", None)
+            os.environ.pop("RLT_FAULT_STATE", None)
+    out["wall_s"] = round(time.monotonic() - t0, 1)
+    return out
+
+
+def _run_elastic_shrink_grow(workers_unused: int) -> dict:
+    """Governor-level shrink→grow simulation: deterministic fake
+    attempts drive run()'s recovery loop (a real grown attempt needs a
+    multi-process mesh this container's CPU backend cannot train).
+    World trace must read 2 → 1 → 2 with two resize events and no
+    budget consumed."""
+    from ray_lightning_tpu.cluster.actor import ActorDiedError
+    from ray_lightning_tpu.core.loop import FitConfig
+    from ray_lightning_tpu.fault.drain import PreemptedError
+    from ray_lightning_tpu.parallel.strategies import RayStrategy
+
+    out = {"name": "elastic-shrink-grow", "ok": False, "error": "",
+           "events": [], "restarts": 0, "preempts": 0, "resizes": 0,
+           "resize_time_to_recover_s": None, "wall_s": 0.0}
+    t0 = time.monotonic()
+    try:
+        with tempfile.TemporaryDirectory(prefix="rlt_chaos_sg_") as tmp:
+            cap = [1]  # worker 1 already lost when the fit starts
+            strategy = RayStrategy(
+                num_workers=2, max_restarts=1, restart_backoff_s=0.0,
+                elastic_min_workers=1, elastic_grow_after_s=0.0,
+                elastic_capacity_fn=lambda: cap[0],
+            )
+            strategy._backend = object()  # fakes below never touch it
+            strategy._respawn_workers = lambda: None
+            strategy._kill_workers = lambda *a, **k: None
+            strategy._latest_restart_checkpoint = (
+                lambda rd: {"path": None, "corrupt": []}
+            )
+            worlds = [strategy.active_workers]
+            attempt = [0]
+
+            def fake_run_once(*a, **k):
+                attempt[0] += 1
+                worlds.append(strategy.active_workers)
+                if attempt[0] == 1:
+                    raise ActorDiedError("worker 1 preempted")
+                if attempt[0] == 2:
+                    # capacity returned mid-attempt; the pump's grow
+                    # arming drained the fleet
+                    cap[0] = 2
+                    strategy._grow_pending = True
+                    raise PreemptedError(
+                        "grow drain", step=5, reason="grow"
+                    )
+                return [{"rank": 0}]
+
+            strategy._run_once = fake_run_once
+            strategy.run(
+                "fit", None, None,
+                FitConfig(max_epochs=1, default_root_dir=tmp), [],
+            )
+            out["events"] = sorted({
+                e["kind"] for e in strategy.recovery_events
+            })
+            out["restarts"] = strategy.restarts_used
+            out["preempts"] = strategy.preempt_restarts_used
+            out["resizes"] = strategy.resizes_used
+            out["resize_time_to_recover_s"] = (
+                strategy.last_resize_recover_s
+            )
+            trace = worlds[1:]  # world size seen by each attempt
+            if trace != [2, 1, 2]:
+                out["error"] = f"world trace {trace} != [2, 1, 2]"
+            elif strategy.restarts_used:
+                out["error"] = "shrink/grow consumed the restart budget"
+            elif strategy.resizes_used != 2:
+                out["error"] = f"resizes {strategy.resizes_used} != 2"
+            else:
+                out["ok"] = True
+    except Exception as e:  # noqa: BLE001
+        out["error"] = f"{type(e).__name__}: {e}"
+    out["wall_s"] = round(time.monotonic() - t0, 1)
+    return out
+
+
+def _run_elastic_below_min(workers_unused: int) -> dict:
+    """Capacity below ``elastic_min_workers`` must REJECT the shrink:
+    the fit fails with the capacity arithmetic in the error, rather
+    than training a crippled fleet."""
+    from ray_lightning_tpu.cluster.actor import ActorDiedError
+    from ray_lightning_tpu.core.trainer import Trainer
+    from ray_lightning_tpu.models.boring import (
+        BoringDataModule,
+        BoringModel,
+    )
+    from ray_lightning_tpu.parallel.strategies import RayStrategy
+
+    out = {"name": "elastic-below-min", "ok": False, "error": "",
+           "events": [], "restarts": 0, "preempts": 0, "resizes": 0,
+           "resize_time_to_recover_s": None, "wall_s": 0.0}
+    t0 = time.monotonic()
+    with tempfile.TemporaryDirectory(prefix="rlt_chaos_bm_") as tmp:
+        os.environ["RLT_FAULT"] = "lose_worker@point:spawn,rank:1"
+        os.environ["RLT_FAULT_STATE"] = os.path.join(tmp, "chaos")
+        try:
+            strategy = RayStrategy(
+                num_workers=2, max_restarts=1, restart_backoff_s=0.05,
+                elastic_min_workers=2,
+            )
+            trainer = Trainer(
+                strategy=strategy, max_epochs=3, default_root_dir=tmp,
+                limit_train_batches=2, limit_val_batches=1,
+                enable_checkpointing=False,
+            )
+            try:
+                trainer.fit(
+                    BoringModel(), BoringDataModule(batch_size=16)
+                )
+                out["error"] = "fit completed despite capacity < min"
+            except ActorDiedError as e:
+                out["events"] = sorted({
+                    ev["kind"] for ev in strategy.recovery_events
+                })
+                if "shrink rejected" not in str(e):
+                    out["error"] = (
+                        f"rejection not named in error: {e}"
+                    )
+                elif "resize_rejected" not in out["events"]:
+                    out["error"] = "no resize_rejected event"
+                elif strategy.active_workers != 2:
+                    out["error"] = "world changed despite rejection"
+                else:
+                    out["ok"] = True
+        except Exception as e:  # noqa: BLE001
+            out["error"] = f"{type(e).__name__}: {e}"
+        finally:
+            os.environ.pop("RLT_FAULT", None)
+            os.environ.pop("RLT_FAULT_STATE", None)
+    out["wall_s"] = round(time.monotonic() - t0, 1)
+    return out
+
+
+_ELASTIC_MATRIX = [
+    ("elastic-shrink", _run_elastic_shrink),
+    ("elastic-shrink-grow", _run_elastic_shrink_grow),
+    ("elastic-below-min", _run_elastic_below_min),
+]
+
+
 def _print_scorecard(rows: list) -> None:
     width = max(len(r["name"]) for r in rows) + 2
     print(f"\n{'scenario':<{width}}{'result':<10}{'wall':<8}"
-          f"{'restarts':<10}{'preempts':<10}events")
+          f"{'restarts':<10}{'preempts':<10}{'resizes':<9}events")
     for r in rows:
         verdict = "RECOVERED" if r["ok"] else "FAILED"
         extra = ",".join(r["events"]) or "-"
         print(f"{r['name']:<{width}}{verdict:<10}{r['wall_s']:<8}"
-              f"{r['restarts']:<10}{r['preempts']:<10}{extra}")
+              f"{r['restarts']:<10}{r['preempts']:<10}"
+              f"{r.get('resizes', 0):<9}{extra}")
+        if r.get("resize_time_to_recover_s") is not None:
+            print(f"{'':<{width}}  resize_time_to_recover_s="
+                  f"{r['resize_time_to_recover_s']}")
         if r["error"]:
             print(f"{'':<{width}}  {r['error']}")
     good = sum(r["ok"] for r in rows)
@@ -240,6 +482,11 @@ def main(argv=None) -> int:
             continue
         print(f"chaos_sweep: running {name} ({fault}) ...", flush=True)
         rows.append(_run_scenario(name, fault, overrides, args.workers))
+    for name, runner in _ELASTIC_MATRIX:
+        if args.only and name != args.only:
+            continue
+        print(f"chaos_sweep: running {name} ...", flush=True)
+        rows.append(runner(args.workers))
     _print_scorecard(rows)
     return 0 if all(r["ok"] for r in rows) else 1
 

@@ -128,6 +128,29 @@ def _self_test_live_plane(tmp: str) -> list:
                    message="self-test corrupt"),
         "self-test ckpt_corrupt event",
     )
+    # Elastic world-size events (shrink/grow governance + the loop's
+    # reshard-on-load announcement) and the bench fault block's resize
+    # keys — the exact shapes strategies._record_recovery and
+    # loop._announce_resize produce.
+    problems += validate_stream_item(
+        make_event("resize", -1, old_world=4, new_world=2,
+                   recover_s=3.2, ckpt="/tmp/drain-step-00000007.ckpt",
+                   message="self-test elastic resize"),
+        "self-test resize event",
+    )
+    problems += validate_stream_item(
+        make_event("resize_rejected", -1, old_world=4, new_world=0,
+                   message="self-test below elastic_min_workers"),
+        "self-test resize_rejected event",
+    )
+    from ray_lightning_tpu.telemetry.schema import validate_bench_fault
+
+    problems += validate_bench_fault(
+        {"time_to_recover_s": 1.5, "drain_checkpoint_s": 0.2,
+         "backoff_s": None, "resize_time_to_recover_s": 2.5,
+         "resize_old_world": 2, "resize_new_world": 1},
+        "self-test bench fault block",
+    )
     problems += validate_stream_item(
         make_log_item(0, "WARNING", "self.test", "hello"),
         "self-test log",
