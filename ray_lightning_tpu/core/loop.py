@@ -77,6 +77,16 @@ class FitConfig:
     # epoch/limit/max_steps boundaries fall back to the per-step path,
     # so step-count contracts hold exactly.
     megastep: Optional[Any] = None
+    # Cross-replica sharded weight update (arXiv:2004.13336): on a
+    # pure-DP mesh with a replicated optimizer (zero_stage=0), annotate
+    # the optimizer state — and therefore the update computation —
+    # sharded over the batch axes, so each replica updates 1/P of the
+    # moments (reduce-scatter → sharded update → all-gather params,
+    # inserted by GSPMD from the in/out shardings).  Values: None (read
+    # the RLT_UPDATE_SHARDING env bus, default "auto"), "auto" (on for
+    # TPU batch-only gspmd meshes, off on CPU), "on", "off"/bools.
+    # Gated off wherever ZeRO already shards the state.
+    update_sharding: Optional[Any] = None
     seed: int = 0
     precision: str = "f32"
     default_root_dir: str = "."
@@ -139,6 +149,7 @@ class FitConfig:
         # (_resolve_megastep) — the driver may be CPU-only while the
         # workers run TPUs.
         _normalize_megastep(self.megastep)
+        _normalize_update_sharding(self.update_sharding)
         if self.fast_dev_run:
             self.max_epochs = 1
             self.limit_train_batches = 1
@@ -170,6 +181,83 @@ def _normalize_megastep(value: Any) -> Optional[Any]:
     if value < 1:
         raise ValueError(f"megastep must be >= 1, got {value}")
     return value
+
+
+def _normalize_update_sharding(value: Any) -> Optional[str]:
+    """Validate an ``update_sharding`` knob value: None, "auto", "on"
+    or "off" (bools accepted as on/off).  Resolution against the real
+    mesh/mode happens at fit time (:func:`_resolve_update_sharding`)."""
+    if value is None:
+        return None
+    if isinstance(value, bool):
+        return "on" if value else "off"
+    if isinstance(value, str):
+        s = value.strip().lower()
+        if s == "":
+            return "off"
+        if s in ("auto", "on", "off"):
+            return s
+    raise ValueError(
+        f"update_sharding={value!r}: expected 'auto', 'on', 'off' or a "
+        "bool"
+    )
+
+
+def _resolve_update_sharding(
+    config: FitConfig, mesh, mode: str, zero_stage: int
+) -> bool:
+    """Whether THIS fit shards the weight update over the batch axes
+    (arXiv:2004.13336 via sharding annotations — see
+    :func:`init_train_state`).
+
+    Strongest first: the Trainer/strategy knob → the
+    ``RLT_UPDATE_SHARDING`` env bus → ``"auto"``.  The technique only
+    exists for replicated-optimizer data-parallel meshes, so it
+    requires: a multi-device mesh whose axes are all batch-parallel
+    (``data``/``fsdp``), gspmd step mode, and ``zero_stage == 0`` —
+    ZeRO already shards the update, shard_map replicates the state by
+    contract, and model-parallel axes change what "replica" means.  An
+    explicit "on" outside that envelope warns and stays off (the same
+    loud-downgrade discipline as grad_comm); "auto" additionally keeps
+    CPU meshes off — like megastep, the XLA:CPU collective rendezvous
+    costs more than the update traffic it saves, so auto engages on
+    TPU backends only.
+    """
+    value = _normalize_update_sharding(config.update_sharding)
+    if value is None:
+        value = _normalize_update_sharding(
+            os.environ.get("RLT_UPDATE_SHARDING", "auto")
+        )
+    if value == "off":
+        return False
+    eligible = (
+        mesh is not None
+        and getattr(mesh, "size", 1) > 1
+        and mode == "gspmd"
+        and zero_stage == 0
+        and set(mesh.axis_names) <= {"data", "fsdp"}
+    )
+    if value == "on":
+        if not eligible:
+            import warnings
+
+            warnings.warn(
+                "update_sharding='on' needs a multi-device batch-only "
+                "(data/fsdp) gspmd mesh with zero_stage=0 (ZeRO already "
+                f"shards the update); got mesh="
+                f"{None if mesh is None else tuple(mesh.axis_names)}, "
+                f"mode={mode!r}, zero_stage={zero_stage} — running with "
+                "a replicated update instead"
+            )
+            return False
+        return True
+    # auto
+    if not eligible:
+        return False
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:
+        return False
 
 
 def _resolve_megastep(config: FitConfig) -> int:
@@ -697,6 +785,87 @@ def _reconcile_multisteps(host_state: Any, template: Any) -> Any:
     )
 
 
+def _reconcile_opt_state_format(host_state: Any, template: Any) -> Any:
+    """Reconcile a checkpoint's optimizer-state STORAGE FORMAT with
+    this run's template across an ``opt_state_dtype`` policy change
+    (models/optim.py): quantized ↔ float moment leaves differ in tree
+    STRUCTURE (a :class:`~ray_lightning_tpu.ops.optim_quant.BlockQuantized`
+    node vs a bare array), which the dtype-cast reconciliation below
+    cannot express.  Float → quantized requantizes (lossy by exactly
+    the codec's rounding — the same rounding a fresh step would apply);
+    quantized → float dequantizes.  Same-policy resumes pass through
+    untouched, so int8 state round-trips drain → resume bit-exactly.
+    """
+    from ray_lightning_tpu.core.module import TrainState
+    from ray_lightning_tpu.ops.optim_quant import (
+        dequantize_moment,
+        is_block_quantized,
+        quantize_moment,
+    )
+
+    if not isinstance(host_state, TrainState) or not isinstance(
+        template, TrainState
+    ):
+        return host_state
+    tdef = jax.tree_util.tree_structure(template.opt_state)
+    hdef = jax.tree_util.tree_structure(host_state.opt_state)
+    if tdef == hdef:
+        return host_state
+    converted = [0]
+
+    def coerce(tmpl_leaf, ckpt_piece):
+        t_q = is_block_quantized(tmpl_leaf)
+        c_q = is_block_quantized(ckpt_piece)
+        if t_q and c_q:
+            if (tuple(tmpl_leaf.shape) != tuple(ckpt_piece.shape)
+                    or tmpl_leaf.block_size != ckpt_piece.block_size
+                    or tmpl_leaf.sqrt_domain != ckpt_piece.sqrt_domain):
+                converted[0] += 1
+                return quantize_moment(
+                    dequantize_moment(ckpt_piece),
+                    block_size=tmpl_leaf.block_size,
+                    sqrt_domain=tmpl_leaf.sqrt_domain,
+                )
+            return ckpt_piece
+        if t_q:
+            converted[0] += 1
+            return quantize_moment(
+                jnp.asarray(ckpt_piece, jnp.float32),
+                block_size=tmpl_leaf.block_size,
+                sqrt_domain=tmpl_leaf.sqrt_domain,
+            )
+        if c_q:
+            converted[0] += 1
+            return dequantize_moment(ckpt_piece).astype(
+                getattr(tmpl_leaf, "dtype", jnp.float32)
+            )
+        return ckpt_piece
+
+    try:
+        new_opt = jax.tree_util.tree_map(
+            coerce, template.opt_state, host_state.opt_state,
+            is_leaf=is_block_quantized,
+        )
+    except ValueError:
+        # Structures differ beyond moment storage (a genuinely foreign
+        # checkpoint) — let the downstream congruence checks raise
+        # their own, more specific error.
+        return host_state
+    if converted[0]:
+        import warnings
+
+        warnings.warn(
+            f"resume across an opt_state_dtype change: "
+            f"{converted[0]} optimizer moment leaves converted to this "
+            "run's storage format (float ↔ block-scaled int8; "
+            "requantization applies the codec's rounding once)"
+        )
+    return TrainState(
+        host_state.params, new_opt, host_state.step,
+        host_state.grad_residual,
+    )
+
+
 def _announce_resize(info: Dict[str, Any], tel: Telemetry, queue,
                      global_rank: int) -> None:
     """Make an elastic N→M resume LOUD: a warning on every rank, an
@@ -892,8 +1061,20 @@ def init_train_state(
     zero_stage: int,
     seed: int,
     use_preset: bool = True,
+    shard_update: bool = False,
 ) -> Tuple[TrainState, Any]:
     """Build the (possibly ZeRO-sharded) initial train state.
+
+    ``shard_update`` (the cross-replica sharded weight update,
+    arXiv:2004.13336 — docs/PERFORMANCE.md "Optimizer-state precision &
+    update sharding") annotates the OPTIMIZER state sharded over the
+    batch axes while params stay replicated: on a pure-DP mesh the
+    in/out shardings on the jitted step then act as sharding
+    constraints on the update computation — GSPMD lowers the gradient
+    all-reduce to reduce-scatter, each replica updates only its shard
+    of the moments, and the new params all-gather back — so a
+    replicated-optimizer mesh stops paying P× the update's HBM+wire
+    traffic.  A no-op where ZeRO already shards (``zero_stage >= 1``).
 
     Params are initialized **on-device under jit** with the target
     shardings as ``out_shardings`` — a ZeRO-3 model never materializes
@@ -940,8 +1121,14 @@ def init_train_state(
             return make_from(jax.device_put(preset)), None
         return make(rng), None
     abstract = jax.eval_shape(make, rng)
+    # The sharded-update path reuses the ZeRO-1 sharding computation —
+    # stage 1 is exactly "optimizer state sharded, params replicated" —
+    # but the run's SEMANTIC zero_stage stays 0 (grad-comm gating,
+    # checkpoint metadata and module compute-path selection all key off
+    # the semantic stage).
+    sharding_stage = max(zero_stage, 1) if shard_update else zero_stage
     shardings = shardlib.state_shardings_for_module(
-        module, abstract, mesh, zero_stage
+        module, abstract, mesh, sharding_stage
     )
     if preset is not None:
         placed = jax.device_put(preset, shardings.params)
@@ -1356,9 +1543,17 @@ def _run_fit_inner(
         tel.set_meta("grad_sync_mode", "full")
         ctx.comm_stats = {"grad_sync_mode": "full"}
 
+    # Cross-replica sharded weight update: resolved against the real
+    # mesh/mode/stage (docs/PERFORMANCE.md "Optimizer-state precision &
+    # update sharding"); recorded in telemetry so bench artifacts can
+    # attribute the arm.
+    shard_update = _resolve_update_sharding(config, mesh, mode, zero_stage)
+    tel.set_meta("update_sharding", "on" if shard_update else "off")
+    ctx.update_sharding_active = shard_update
     state, state_shardings = init_train_state(
         module, tx, mesh, zero_stage, config.seed,
         use_preset=not config.resume_from_checkpoint,
+        shard_update=shard_update,
     )
     if grad_sync is not None:
         # Error-feedback residual (int8_ef): attached to BOTH the state
@@ -1409,6 +1604,11 @@ def _run_fit_inner(
             # vanishes) — re-wrap before the congruence-dependent
             # reconciliations below.
             host_state = _reconcile_multisteps(host_state, state)
+        # Storage-format reconcile: an ``opt_state_dtype`` policy change
+        # between runs (f32/bf16 moments ↔ block-scaled int8) changes
+        # the opt-state TREE STRUCTURE, not just leaf dtypes — convert
+        # before the per-leaf cast below (which requires congruence).
+        host_state = _reconcile_opt_state_format(host_state, state)
         # Reconcile checkpoint dtypes with THIS run's state template: a
         # dtype-policy change between runs (e.g. AdamW mu f32 → bf16,
         # models/gpt.py ``mu_dtype``) must not leak the old dtype into

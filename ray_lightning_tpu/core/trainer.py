@@ -86,6 +86,7 @@ class Trainer:
         log_every_n_steps: int = 50,
         accumulate_grad_batches: int = 1,
         megastep=None,
+        update_sharding=None,
         enable_checkpointing: bool = True,
         fast_dev_run: bool = False,
         resume_from_checkpoint: Optional[str] = None,
@@ -126,6 +127,12 @@ class Trainer:
             # megastep").  None defers to the strategy's knob / the
             # RLT_MEGASTEP env bus / "auto".
             megastep=megastep,
+            # Cross-replica sharded weight update (optimizer state +
+            # update computation sharded over the batch axes on pure-DP
+            # meshes — docs/PERFORMANCE.md).  None defers to the
+            # strategy's knob / the RLT_UPDATE_SHARDING env bus /
+            # "auto".
+            update_sharding=update_sharding,
             seed=seed,
             precision=precision,
             default_root_dir=default_root_dir,

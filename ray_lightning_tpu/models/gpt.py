@@ -38,7 +38,7 @@ from ray_lightning_tpu.ops import causal_attention
 
 __all__ = ["GPTConfig", "GPT", "SyntheticLMDataModule", "make_block_stage",
            "gpt_adamw", "merge_lora", "add_lora_adapters",
-           "has_lora_adapters"]
+           "has_lora_adapters", "residual_save_bytes"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -67,6 +67,19 @@ class GPTConfig:
     # optimizer-state leaves to this run's template dtypes on load
     # (core/loop.py resume path), so f32-era checkpoints restore cleanly.
     mu_dtype: str = "bfloat16"
+    # Optimizer-state precision policy (generalizes ``mu_dtype`` — that
+    # knob is the legacy special case "bf16 first moment only"):
+    #  * None       — legacy behavior, ``mu_dtype`` applies as before;
+    #  * "float32"  — both moments f32 (bit-conservative);
+    #  * "bfloat16" — BOTH moments bf16 (2x less optimizer-state HBM);
+    #  * "int8"     — both moments block-scaled int8 with per-block f32
+    #    absmax scales (ops/optim_quant.py; ~3.9x less state HBM, and
+    #    ZeRO / RLTSHRD2 elastic shards shrink by the same factor).
+    # The update math is f32 in every mode — dequant → update → requant
+    # happens inside the donated train step, so the f32 moments never
+    # persist in HBM.  Loss-parity vs the f32 arm is gated by
+    # tests/test_opt_state.py at the int8_ef grad-comm tolerance.
+    opt_state_dtype: Optional[str] = None
     # LoRA fine-tuning (0 = off).  rank>0 adds low-rank adapters on the
     # attention projections (qkv column + output proj — the standard
     # target set); the optimizer then trains ONLY the adapters (the base
@@ -186,16 +199,36 @@ class GPT(TpuModule):
         #  * "dots"           — matmul outputs only; the backward re-runs
         #    the flash forward kernel (measured dead end, kept as the
         #    control arm).
-        if remat_policy not in ("dots+flash", "dots+flash-out", "dots"):
+        #  * "bf16-resid"     — the dots+flash-out save set, PLUS the
+        #    layer-scan carry (the residual stream between blocks) is
+        #    stored in bf16 and upcast to the compute dtype on read.
+        #    The scan's per-layer carry save is the profiler's largest
+        #    remaining dynamic-update-slice line; on an f32-precision
+        #    run this halves it (on bf16 runs the carry is already
+        #    bf16, so the arm costs nothing and saves only the f32
+        #    embed-boundary save).  Numerics: equivalent to casting the
+        #    residual stream to bf16 at block boundaries — exactly what
+        #    precision="bf16" already does — so the f32-run loss delta
+        #    is the bf16 rounding of one tensor per layer
+        #    (tolerance-pinned by tests/test_gpt.py).
+        if remat_policy not in (
+            "dots+flash", "dots+flash-out", "dots", "bf16-resid"
+        ):
             raise ValueError(
                 f"remat_policy {remat_policy!r} not in "
-                f"('dots+flash', 'dots+flash-out', 'dots')"
+                f"('dots+flash', 'dots+flash-out', 'dots', 'bf16-resid')"
             )
         if self.config.lora_rank > 0 and self.config.n_experts > 0:
             raise ValueError(
                 "LoRA adapters target the dense attention projections; "
                 "lora_rank > 0 with n_experts > 0 is not supported"
             )
+        # Eager knob validation (same discipline as remat_policy): a
+        # typo'd state-precision policy fails at construction, not when
+        # the optimizer first builds on a worker.
+        from ray_lightning_tpu.models.optim import resolve_opt_state_dtype
+
+        resolve_opt_state_dtype(self.config.opt_state_dtype)
         self.remat = remat
         self.remat_policy = remat_policy
         self.save_hyperparameters(
@@ -427,6 +460,16 @@ class GPT(TpuModule):
         x = self._constrain_residual(
             (params["wte"][tokens] + params["wpe"][:T]).astype(c)
         )
+        # Scan-residual compression: under the "bf16-resid" arm the
+        # CARRY crossing scan iterations — which is exactly what the
+        # scan saves per layer for the remat backward — is held in
+        # bf16; the block upcasts to the compute dtype on entry (the
+        # "f32 recompute on read" half of the trade).  Gated on remat:
+        # without remat nothing is saved per layer, so rounding the
+        # carry would change numerics for no storage win.
+        bf16r = self.remat and self.remat_policy == "bf16-resid"
+        if bf16r:
+            x = x.astype(jnp.bfloat16)
 
         lora_s = (
             cfg.lora_alpha / cfg.lora_rank if cfg.lora_rank > 0 else 0.0
@@ -434,6 +477,8 @@ class GPT(TpuModule):
 
         def block(carry, p):
             x, aux = carry
+            if bf16r:
+                x = x.astype(c)
             h = _layer_norm(x, p["ln1_g"], p["ln1_b"], lnp)
             qkv = h @ p["qkv_w"].astype(c) + p["qkv_b"].astype(c)
             if cfg.lora_rank > 0:
@@ -462,7 +507,10 @@ class GPT(TpuModule):
                 aux = aux + layer_aux
             else:
                 x = _mlp_residual(x, p, c, lnp)
-            return (self._constrain_residual(x), aux), None
+            x = self._constrain_residual(x)
+            if bf16r:
+                x = x.astype(jnp.bfloat16)
+            return (x, aux), None
 
         if self.remat:
             # Save matmul outputs AND (per remat_policy) the named
@@ -472,6 +520,9 @@ class GPT(TpuModule):
             if self.remat_policy == "dots":
                 policy = cp.dots_with_no_batch_dims_saveable
             else:
+                # "bf16-resid" keeps the dots+flash-out (no-double-save)
+                # set — its storage win comes from the bf16 carry, not
+                # from a different save set.
                 names = ("flash_out", "flash_lse")
                 if self.remat_policy == "dots+flash":
                     names += ("flash_q", "flash_k", "flash_v")
@@ -483,6 +534,8 @@ class GPT(TpuModule):
         (x, aux), _ = jax.lax.scan(
             block, (x, jnp.zeros((), jnp.float32)), params["blocks"]
         )
+        if bf16r:
+            x = x.astype(c)
         # Per-layer mean: the aux weight is depth-independent (balanced
         # routing ⇒ aux ≈ 1 at any n_layer).
         aux = aux / max(cfg.n_layer, 1)
@@ -610,15 +663,71 @@ def gpt_adamw(cfg: GPTConfig):
     schedule = optax.warmup_cosine_decay_schedule(
         0.0, cfg.lr, cfg.warmup_steps, max(10 * cfg.warmup_steps, 1000)
     )
-    from ray_lightning_tpu.models.optim import decay_mask
+    from ray_lightning_tpu.models.optim import (
+        apply_opt_state_dtype,
+        decay_mask,
+        resolve_opt_state_dtype,
+    )
+
+    # Optimizer-state precision: an explicit ``opt_state_dtype`` policy
+    # overrides the legacy ``mu_dtype`` knob (the inner adamw then keeps
+    # f32 moments — the wrapper owns the storage dtype; stacking bf16
+    # mu_dtype under an int8 wrapper would quantize already-rounded
+    # values for no win).
+    osd = resolve_opt_state_dtype(cfg.opt_state_dtype)
+    mu_dtype = jnp.dtype(cfg.mu_dtype) if osd is None else jnp.float32
 
     # Decay matrices only (nanoGPT-style naming rule): LN params and
     # biases are exempt; decay_mask is aware of the stacked-blocks
     # leading layer dim, so per-block biases/LN stay exempt too.
-    return optax.adamw(schedule, b1=0.9, b2=0.95,
-                       weight_decay=cfg.weight_decay,
-                       mask=decay_mask,
-                       mu_dtype=jnp.dtype(cfg.mu_dtype))
+    adamw = optax.adamw(schedule, b1=0.9, b2=0.95,
+                        weight_decay=cfg.weight_decay,
+                        mask=decay_mask,
+                        mu_dtype=mu_dtype)
+    return apply_opt_state_dtype(adamw, osd)
+
+
+def residual_save_bytes(
+    cfg: GPTConfig,
+    batch_size: int,
+    policy: str,
+    precision: str = "bf16",
+) -> int:
+    """Analytic bytes the remat backward SAVES per step under a policy —
+    the accounting behind the bench's ``residual_policy`` block (chip
+    truth comes from the profiler's dynamic-update-slice lines via
+    ``tools/hw_session.sh``; this is the model that says which arm to
+    expect to win and by how much).
+
+    Per layer, the saved set is: the scan CARRY (the block's residual-
+    stream input, stacked across layers by the scan — the top profiler
+    line), the dot outputs the ``dots`` policy keeps (qkv 3d, proj d,
+    mlp-in 4d, mlp-out d), and the named flash residuals per arm
+    (out ``d``; lse at its 8-lane stat width in f32; q/k/v transposes
+    ``3d`` only under ``dots+flash`` — the double-save
+    ``dots+flash-out`` exists to drop).  ``bf16-resid`` stores the
+    carry in 2 bytes regardless of compute precision.
+    """
+    if policy not in ("dots+flash", "dots+flash-out", "dots",
+                      "bf16-resid"):
+        # Same eager discipline as GPT.__init__: a typo'd arm must not
+        # return plausible-but-mislabeled accounting.
+        raise ValueError(
+            f"remat_policy {policy!r} not in "
+            f"('dots+flash', 'dots+flash-out', 'dots', 'bf16-resid')"
+        )
+    c = 2 if precision in ("bf16", "bfloat16") else 4
+    carry = 2 if policy == "bf16-resid" else c
+    B, T, d, L, H = (batch_size, cfg.seq_len, cfg.d_model, cfg.n_layer,
+                     cfg.n_head)
+    per_layer = B * T * d * carry  # scan carry
+    per_layer += B * T * 9 * d * c  # dot outputs (3d + d + 4d + d)
+    if policy != "dots":
+        per_layer += B * T * d * c          # flash_out
+        per_layer += B * H * T * 8 * 4      # flash_lse (8-lane f32 stat)
+    if policy == "dots+flash":
+        per_layer += B * T * 3 * d * c      # per-head q/k/v double-save
+    return L * per_layer
 
 
 def has_lora_adapters(params: Dict[str, Any]) -> bool:

@@ -55,6 +55,11 @@ class ViTConfig:
     lr: float = 1e-3
     weight_decay: float = 0.05
     warmup_steps: int = 100
+    # Optimizer-state precision policy — same contract as
+    # ``GPTConfig.opt_state_dtype`` (models/gpt.py): None/"float32" =
+    # plain f32 moments, "bfloat16" = both moments bf16, "int8" = both
+    # moments block-scaled int8 (ops/optim_quant.py).
+    opt_state_dtype: Optional[str] = None
 
     @classmethod
     def tiny(cls) -> "ViTConfig":
@@ -89,6 +94,9 @@ class ViT(TpuModule):
             )
         if cfg.d_model % cfg.n_head != 0:
             raise ValueError("n_head must divide d_model")
+        from ray_lightning_tpu.models.optim import resolve_opt_state_dtype
+
+        resolve_opt_state_dtype(cfg.opt_state_dtype)
         self.remat = remat
         self.save_hyperparameters(
             **dataclasses.asdict(cfg), remat=remat,
@@ -240,12 +248,15 @@ class ViT(TpuModule):
         return jnp.argmax(self.forward(params, batch["x"]), axis=-1)
 
     def configure_optimizers(self):
+        from ray_lightning_tpu.models.optim import apply_opt_state_dtype
+
         cfg = self.config
         schedule = optax.warmup_cosine_decay_schedule(
             0.0, cfg.lr, cfg.warmup_steps, max(10 * cfg.warmup_steps, 1000)
         )
-        return optax.chain(
-            optax.clip_by_global_norm(1.0),
+        adamw = apply_opt_state_dtype(
             optax.adamw(schedule, weight_decay=cfg.weight_decay,
                         mask=decay_mask),
+            cfg.opt_state_dtype,
         )
+        return optax.chain(optax.clip_by_global_norm(1.0), adamw)

@@ -46,6 +46,7 @@ from ray_lightning_tpu.cluster.actor import ActorDiedError, RemoteError
 from ray_lightning_tpu.core.loop import (
     FitConfig,
     _normalize_megastep,
+    _normalize_update_sharding,
     run_eval,
     run_fit,
     run_predict,
@@ -270,6 +271,7 @@ class TpuStrategy:
         telemetry=None,
         monitor=None,
         megastep=None,
+        update_sharding=None,
         elastic_min_workers: Optional[int] = None,
         elastic_grow_after_s: Optional[float] = None,
         elastic_capacity_fn: Optional[Callable[[], int]] = None,
@@ -325,6 +327,12 @@ class TpuStrategy:
         # eagerly like every other strategy knob.
         _normalize_megastep(megastep)
         self.megastep = megastep
+        # Cross-replica sharded weight update (core/loop.py
+        # update_sharding mode).  None defers to the Trainer's knob /
+        # the RLT_UPDATE_SHARDING env bus / "auto"; validated eagerly
+        # like every other strategy knob.
+        _normalize_update_sharding(update_sharding)
+        self.update_sharding = update_sharding
         self.env_per_worker = dict(env_per_worker or {})
         # Persistent XLA compilation cache (RLT_COMPILE_CACHE=dir): the
         # first GPT-2-scale compile costs 20-40s on this platform; a
@@ -369,8 +377,10 @@ class TpuStrategy:
                     "RLT_DRAIN_SYNC_EVERY",
                     # Megastep execution mode (core/loop.py): a driver-
                     # side RLT_MEGASTEP must reach remote workers or the
-                    # knob would only ever affect inline fits.
-                    "RLT_MEGASTEP"):
+                    # knob would only ever affect inline fits.  The
+                    # sharded-weight-update knob rides the same bridge —
+                    # it resolves worker-side against the real mesh.
+                    "RLT_MEGASTEP", "RLT_UPDATE_SHARDING"):
             val = os.environ.get(var)
             if val is not None:
                 self.env_per_worker.setdefault(var, val)
@@ -626,6 +636,11 @@ class TpuStrategy:
             # The strategy's megastep knob fills the unset Trainer
             # default (an explicit Trainer(megastep=...) always wins).
             config = dataclasses.replace(config, megastep=self.megastep)
+        if (config.update_sharding is None
+                and self.update_sharding is not None):
+            config = dataclasses.replace(
+                config, update_sharding=self.update_sharding
+            )
         elastic = self.max_restarts > 0 and kind == "fit"
         if elastic and config.restart_every_n_epochs is None:
             # The strategy's cadence fills the unset default wherever the
@@ -1341,10 +1356,11 @@ class LocalStrategy(TpuStrategy):
     def __init__(self, mesh_axes: Optional[Dict[str, int]] = None,
                  mode: str = "gspmd", zero_stage: int = 0,
                  grad_comm=None, telemetry=None, monitor=None,
-                 megastep=None):
+                 megastep=None, update_sharding=None):
         super().__init__(
             num_workers=1, mesh_axes=mesh_axes, grad_comm=grad_comm,
             telemetry=telemetry, monitor=monitor, megastep=megastep,
+            update_sharding=update_sharding,
         )
         if monitor is not None:
             warnings.warn(
@@ -1380,6 +1396,11 @@ class LocalStrategy(TpuStrategy):
 
         if config.megastep is None and self.megastep is not None:
             config = dataclasses.replace(config, megastep=self.megastep)
+        if (config.update_sharding is None
+                and self.update_sharding is not None):
+            config = dataclasses.replace(
+                config, update_sharding=self.update_sharding
+            )
         # Gang-packing: inside a tune_run trial holding a sub-mesh
         # allocation (tuning/pack.py), build the mesh over exactly the
         # allocated devices — concurrent trials then run on DISJOINT

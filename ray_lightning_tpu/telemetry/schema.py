@@ -26,6 +26,8 @@ __all__ = [
     "validate_bench_telemetry",
     "validate_bench_fault",
     "validate_bench_host_overhead",
+    "validate_bench_opt_state",
+    "validate_bench_residual_policy",
     "validate_heartbeat",
     "validate_event",
     "validate_log_item",
@@ -688,3 +690,73 @@ def validate_bench_host_overhead(block: Any,
     if not problems and isinstance(k, int) and k < 1:
         problems.append(f"{where}: megastep_k must be >= 1, got {k}")
     return problems
+
+
+# The bench opt_state block: the HBM-traffic diet's acceptance surface.
+# ``bytes_*`` are ANALYTIC persistent AdamW moment bytes
+# (models/optim.py:opt_state_bytes — the chip truth is the optimizer
+# line in the per-op profile, tools/hw_session.sh); ``hbm_ratio`` =
+# bytes_f32 / bytes_int8 (the >= 3.5x acceptance bar);
+# ``loss_rel_diff_vs_f32`` is the measured A/B fit parity at the int8_ef
+# grad-comm tolerance; ``update_sharding`` records the resolved
+# cross-replica sharded-update arm.  Measured keys nullable per probe.
+_BENCH_OPT_STATE_REQUIRED = {
+    "dtype": str,
+    "block_size": int,
+    "bytes_f32": (int, float),
+    "bytes_int8": (int, float),
+    "bytes_active": (int, float),
+    "hbm_ratio": (int, float),
+}
+_BENCH_OPT_STATE_OPTIONAL = {
+    "loss_rel_diff_vs_f32": (int, float, type(None)),
+    "tokens_per_sec": (int, float, type(None)),
+    "vs_baseline": (int, float, type(None)),
+    "update_sharding": (str, type(None)),
+}
+
+
+def validate_bench_opt_state(block: Any,
+                             where: str = "opt_state") -> List[str]:
+    """Validate the ``opt_state`` block of a ``BENCH_*.json`` artifact
+    (absent on pre-round-15 artifacts)."""
+    problems = _check_fields(
+        block, _BENCH_OPT_STATE_REQUIRED, _BENCH_OPT_STATE_OPTIONAL, where
+    )
+    if not problems:
+        if block["hbm_ratio"] <= 0:
+            problems.append(f"{where}: hbm_ratio must be > 0")
+        if block["block_size"] < 1:
+            problems.append(f"{where}: block_size must be >= 1")
+    return problems
+
+
+# The bench residual_policy block: scan-residual compression A/B.
+# ``*_bytes_per_step`` are ANALYTIC remat-saved residual bytes
+# (models/gpt.py:residual_save_bytes; the chip truth is the profiler's
+# dynamic-update-slice lines); ``vs_baseline`` is the measured
+# tokens/sec ratio of the active arm against the baseline policy when
+# the probe ran (remat fits measure nothing on the CPU container —
+# nullable, chip numbers via tools/hw_session.sh).
+_BENCH_RESIDUAL_REQUIRED = {
+    "policy": str,
+    "baseline_policy": str,
+    "residual_bytes_per_step": (int, float),
+    "baseline_residual_bytes_per_step": (int, float),
+    "bytes_saved_pct": (int, float),
+}
+_BENCH_RESIDUAL_OPTIONAL = {
+    "tokens_per_sec": (int, float, type(None)),
+    "vs_baseline": (int, float, type(None)),
+    "loss_rel_diff_vs_baseline": (int, float, type(None)),
+}
+
+
+def validate_bench_residual_policy(
+    block: Any, where: str = "residual_policy"
+) -> List[str]:
+    """Validate the ``residual_policy`` block of a ``BENCH_*.json``
+    artifact (absent on pre-round-15 artifacts)."""
+    return _check_fields(
+        block, _BENCH_RESIDUAL_REQUIRED, _BENCH_RESIDUAL_OPTIONAL, where
+    )

@@ -63,8 +63,22 @@ __all__ = [
     "verify_sharded",
     "verify_checkpoint",
     "CorruptCheckpointError",
+    "UnsupportedLeafDtypeError",
+    "LEAF_DTYPE_CODECS",
     "LOAD_STATS",
 ]
+
+
+class UnsupportedLeafDtypeError(TypeError):
+    """A checkpoint leaf's recorded dtype has no registered codec.
+
+    Raised at the checkpoint BOUNDARY (header decode) instead of
+    letting ``np.dtype(name)`` crash mid-reassembly: a future
+    state-dtype addition (fp8 moments, packed int4, …) that forgets to
+    register here fails with the registry in the message — and
+    ``verify_sharded`` flags it, so restart discovery walks back to a
+    loadable candidate rather than dying inside ``load_sharded``.
+    """
 
 _META = "META.ckpt"
 _CRC_SUFFIX = ".crc32"
@@ -111,16 +125,30 @@ def _leaf_record(leaf: Any) -> Dict[str, Any]:
             seen.add(idx)
             data = _np_of(sh.data)
             entries.append({"i": [list(p) for p in idx], "b": data.tobytes()})
-        return {"s": list(shape), "d": str(leaf.dtype), "e": entries}
+        return {"s": list(shape), "d": _codec_name(leaf.dtype),
+                "e": entries}
     arr = _np_of(leaf) if leaf is not None else None
     if arr is None:
         return {"s": None, "d": None, "e": []}
     idx = [[0, dim] for dim in arr.shape]
     return {
         "s": list(arr.shape),
-        "d": str(arr.dtype),
+        "d": _codec_name(arr.dtype),
         "e": [{"i": idx, "b": arr.tobytes()}],
     }
+
+
+def _codec_name(dtype) -> str:
+    """Write-side codec gate: refusing an unregistered dtype at SAVE
+    time beats writing a checkpoint no reader can open."""
+    name = str(dtype)
+    if name not in LEAF_DTYPE_CODECS:
+        raise UnsupportedLeafDtypeError(
+            f"cannot checkpoint a leaf of dtype {name!r}: no registered "
+            f"codec (registered: {sorted(LEAF_DTYPE_CODECS)}) — add one "
+            "to ray_lightning_tpu.utils.sharded_ckpt.LEAF_DTYPE_CODECS"
+        )
+    return name
 
 
 def _encode_shard_v2(rank: int, world: int,
@@ -221,12 +249,41 @@ def _entry_bytes(path: str, entry: Dict[str, Any], data_offset: int,
     return b
 
 
-def _dtype_of(name: str) -> np.dtype:
-    if name == "bfloat16":
-        import ml_dtypes
+def _bf16_dtype() -> np.dtype:
+    import ml_dtypes
 
-        return np.dtype(ml_dtypes.bfloat16)
-    return np.dtype(name)
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+# The closed set of leaf dtypes this checkpoint format can (de)serialize
+# — name → np.dtype factory.  Every dtype a TrainState can legitimately
+# carry is here: float params/moments, bf16 moments/activations, int8
+# block-quantized optimizer payloads (ops/optim_quant.py), integer
+# step/count leaves, bool masks.  Writers of NEW leaf dtypes must
+# register a codec (and its round-trip test) or every save becomes a
+# checkpoint no reader can open.
+LEAF_DTYPE_CODECS = {
+    name: (lambda n=name: np.dtype(n))
+    for name in (
+        "float16", "float32", "float64",
+        "int8", "int16", "int32", "int64",
+        "uint8", "uint16", "uint32", "uint64",
+        "bool",
+    )
+}
+LEAF_DTYPE_CODECS["bfloat16"] = _bf16_dtype
+
+
+def _dtype_of(name: str) -> np.dtype:
+    codec = LEAF_DTYPE_CODECS.get(name)
+    if codec is None:
+        raise UnsupportedLeafDtypeError(
+            f"checkpoint leaf dtype {name!r} has no registered codec "
+            f"(registered: {sorted(LEAF_DTYPE_CODECS)}); a new state "
+            "dtype must be added to "
+            "ray_lightning_tpu.utils.sharded_ckpt.LEAF_DTYPE_CODECS"
+        )
+    return codec()
 
 
 def save_shard(tree: Any, dirpath: str, rank: int, world: int) -> str:
@@ -357,31 +414,50 @@ def load_meta(dirpath: str) -> Dict[str, Any]:
     }
 
 
-def _parse_shard_blob(raw: bytes, path: str) -> Dict[str, Any]:
-    """An in-memory shard blob → normalized v1-shaped payload (entry
-    bytes inlined under ``"b"``), accepting both file layouts."""
+def _parse_header_from_blob(
+    raw: bytes, path: str
+) -> Tuple[Dict[str, Any], int]:
+    """Parse an in-memory shard blob's header WITHOUT materializing
+    entry bytes — the one place the file framing is decoded from bytes
+    (``_parse_shard_blob`` layers byte inlining on top;
+    ``verify_sharded``'s codec pre-flight uses the header alone).
+
+    Returns ``(header, data_offset)``; ``data_offset == -1`` marks a
+    v1 blob, whose "header" is the full payload with bytes already
+    inline.  Any framing damage — truncation included — raises
+    :class:`CorruptCheckpointError`, never a bare decode error.
+    """
     if raw[: len(_SHARD_MAGIC)] == _SHARD_MAGIC:
-        (hlen,) = struct.unpack(
-            "<I", raw[len(_SHARD_MAGIC): len(_SHARD_MAGIC) + 4]
-        )
         base = len(_SHARD_MAGIC) + 4
+        if len(raw) < base:
+            raise CorruptCheckpointError(
+                f"{path}: truncated shard header — torn write"
+            )
+        (hlen,) = struct.unpack("<I", raw[len(_SHARD_MAGIC): base])
         try:
             header = msgpack.unpackb(raw[base: base + hlen], raw=False)
         except Exception as e:  # noqa: BLE001
             raise CorruptCheckpointError(
                 f"{path}: unparsable shard header ({e})"
             ) from e
-        data_off = base + hlen
-        for rec in header["leaves"]:
-            for e in rec["e"]:
-                e["b"] = raw[data_off + e["o"]: data_off + e["o"] + e["n"]]
-        return header
+        return header, base + hlen
     try:
-        return msgpack.unpackb(raw, raw=False)
+        return msgpack.unpackb(raw, raw=False), -1
     except Exception as e:  # noqa: BLE001 - corrupt ≠ crash-on-load
         raise CorruptCheckpointError(
             f"{path}: unparsable shard file ({e})"
         ) from e
+
+
+def _parse_shard_blob(raw: bytes, path: str) -> Dict[str, Any]:
+    """An in-memory shard blob → normalized v1-shaped payload (entry
+    bytes inlined under ``"b"``), accepting both file layouts."""
+    header, data_off = _parse_header_from_blob(raw, path)
+    if data_off >= 0:
+        for rec in header["leaves"]:
+            for e in rec["e"]:
+                e["b"] = raw[data_off + e["o"]: data_off + e["o"] + e["n"]]
+    return header
 
 
 def _check_shard_identity(payload: Dict[str, Any], dirpath: str,
@@ -777,12 +853,42 @@ def verify_sharded(dirpath: str) -> List[str]:
             problems.append(f"{path}: unreadable ({e})")
             continue
         expected = shard_crcs.get(str(r))
-        if expected is None:
-            continue  # v1 writer: no checksum recorded for this rank
-        if zlib.crc32(raw) != expected:
+        if expected is not None and zlib.crc32(raw) != expected:
             problems.append(
                 f"{path}: checksum mismatch — torn write or bit "
                 "corruption"
+            )
+            continue
+        # Codec pre-flight: every recorded leaf dtype must have a
+        # registered codec, so a checkpoint written by a NEWER state-
+        # dtype scheme is flagged here (discovery skips it with a
+        # ``ckpt_corrupt`` event and walks back) instead of throwing
+        # ``UnsupportedLeafDtypeError`` inside ``load_sharded``
+        # mid-restart.  v2 blobs only: their header parses without
+        # touching the data section, whereas a v1 "header" IS the full
+        # payload — deserializing it here would double the walk's
+        # memory per candidate, and every v1 writer predates every
+        # unregistered dtype anyway.
+        if raw[: len(_SHARD_MAGIC)] != _SHARD_MAGIC:
+            continue
+        try:
+            header, _ = _parse_header_from_blob(raw, path)
+        except CorruptCheckpointError as e:
+            problems.append(str(e))
+            continue
+        records = (
+            header.get("leaves", []) if isinstance(header, dict) else []
+        )
+        unknown = sorted({
+            rec["d"] for rec in records
+            if isinstance(rec, dict) and rec.get("d") is not None
+            and rec["d"] not in LEAF_DTYPE_CODECS
+        })
+        if unknown:
+            problems.append(
+                f"{path}: leaf dtypes {unknown} have no registered "
+                "codec (newer writer?) — this checkpoint cannot be "
+                "loaded by this build"
             )
     return problems
 

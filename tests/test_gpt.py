@@ -283,6 +283,96 @@ def test_remat_policy_rejects_unknown():
         GPT(GPTConfig.tiny(), remat_policy="everything")
 
 
+@pytest.mark.slow  # same budget class as the other remat-variant fits
+def test_remat_bf16_resid_close_numerics():
+    """The "bf16-resid" arm stores the layer-scan carry in bf16 — by
+    design a ROUNDING of the residual stream at block boundaries (the
+    same rounding precision='bf16' applies everywhere), so loss/grads
+    track the exact arms within bf16 tolerance rather than matching
+    bitwise.  Flash attention explicitly, like the exact-parity test:
+    the named flash residuals must exist for the save-set to differ."""
+    import jax
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    cfg = GPTConfig(vocab_size=512, n_layer=2, n_head=4, d_model=256,
+                    seq_len=128, warmup_steps=2)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (2, cfg.seq_len + 1)),
+        jnp.int32)
+
+    def loss_fn(model):
+        params = model.init_params(jax.random.PRNGKey(0))
+
+        def loss(p):
+            l, _ = model.training_step(
+                p, {"tokens": tokens}, jax.random.PRNGKey(1))
+            return l
+
+        val, grads = jax.jit(jax.value_and_grad(loss))(params)
+        return float(val), grads
+
+    base_val, base_grads = loss_fn(
+        GPT(cfg, attn_impl="flash", remat=True,
+            remat_policy="dots+flash-out"))
+    val, grads = loss_fn(
+        GPT(cfg, attn_impl="flash", remat=True,
+            remat_policy="bf16-resid"))
+    assert val == pytest.approx(base_val, rel=1e-3)
+    assert np.isfinite(val)
+    for a, b in zip(jax.tree_util.tree_leaves(base_grads),
+                    jax.tree_util.tree_leaves(grads)):
+        a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        assert np.isfinite(b).all()
+        # bf16 rounding of the residual stream: absolute tolerance at
+        # the bf16 ulp scale of the gradient magnitudes involved.
+        np.testing.assert_allclose(a, b, rtol=0.05, atol=2e-3)
+
+
+def test_remat_bf16_resid_without_remat_is_exact():
+    """Without remat nothing is saved per layer, so the bf16-resid
+    carry rounding must NOT engage — the forward equals the default
+    policy's bitwise."""
+    import jax
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    cfg = GPTConfig.tiny()
+    tokens = jnp.asarray(
+        np.random.default_rng(1).integers(0, cfg.vocab_size, (2, 16)),
+        jnp.int32)
+    params = GPT(cfg).init_params(jax.random.PRNGKey(0))
+    ref = GPT(cfg, remat=False).forward(params, tokens)
+    got = GPT(cfg, remat=False, remat_policy="bf16-resid").forward(
+        params, tokens)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_residual_save_bytes_accounting():
+    """The analytic model behind the bench ``residual_policy`` block:
+    arm ordering must match the design — dots+flash (double-save) >
+    dots+flash-out > bf16-resid(f32 run) > dots — and the bf16 carry
+    must save exactly half the carry bytes of an f32 run."""
+    from ray_lightning_tpu.models.gpt import residual_save_bytes
+
+    cfg = GPTConfig.tiny()
+    B = 16
+    flash = residual_save_bytes(cfg, B, "dots+flash", "f32")
+    flash_out = residual_save_bytes(cfg, B, "dots+flash-out", "f32")
+    bf16r = residual_save_bytes(cfg, B, "bf16-resid", "f32")
+    dots = residual_save_bytes(cfg, B, "dots", "f32")
+    assert flash > flash_out > bf16r > dots
+    carry_f32 = cfg.n_layer * B * cfg.seq_len * cfg.d_model * 4
+    assert flash_out - bf16r == carry_f32 // 2
+    # On a bf16-precision run the carry is already 2 bytes — the arm
+    # changes nothing.
+    assert (residual_save_bytes(cfg, B, "bf16-resid", "bf16")
+            == residual_save_bytes(cfg, B, "dots+flash-out", "bf16"))
+
+
 def test_decay_mask_exempts_norms_biases_everywhere():
     """The weight-decay mask must exempt LN params and biases at every
     nesting level — stacked blocks and MoE tensors carry extra leading

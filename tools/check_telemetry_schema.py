@@ -32,6 +32,8 @@ from ray_lightning_tpu.telemetry.schema import (  # noqa: E402
     validate_bench_fault,
     validate_bench_host_overhead,
     validate_bench_mpmd,
+    validate_bench_opt_state,
+    validate_bench_residual_policy,
     validate_bench_serve,
     validate_bench_telemetry,
     validate_chrome_trace,
@@ -168,8 +170,72 @@ def _self_test_live_plane(tmp: str) -> list:
                 json.load(f), "self-test bundle"
             )
     problems += _self_test_host_overhead()
+    problems += _self_test_opt_state()
     problems += _self_test_serve()
     problems += _self_test_mpmd()
+    return problems
+
+
+def _self_test_opt_state() -> list:
+    """The HBM-diet bench blocks (opt_state + residual_policy): the
+    shapes bench.py emits must pass, drifted producers must NOT.  The
+    analytic byte counts here are hand-computed miniatures of the
+    models/optim.py / models/gpt.py accounting, so a validator change
+    that loosens the contract shows up as an accepted negative."""
+    from ray_lightning_tpu.telemetry.schema import (
+        validate_bench_opt_state,
+        validate_bench_residual_policy,
+    )
+
+    problems = validate_bench_opt_state(
+        {
+            "dtype": "int8", "block_size": 128,
+            "bytes_f32": 3829760, "bytes_int8": 1008640,
+            "bytes_active": 1008640, "hbm_ratio": 3.797,
+            "loss_rel_diff_vs_f32": 1.3e-6,
+            "tokens_per_sec": 1234.5, "vs_baseline": 1.01,
+            "update_sharding": "off",
+        },
+        "self-test opt_state",
+    )
+    # Nullable measured arms (probe best-effort) are a legal block.
+    problems += validate_bench_opt_state(
+        {
+            "dtype": "float32", "block_size": 128,
+            "bytes_f32": 100.0, "bytes_int8": 26.0,
+            "bytes_active": 100.0, "hbm_ratio": 3.85,
+            "loss_rel_diff_vs_f32": None, "tokens_per_sec": None,
+        },
+        "self-test opt_state nulls",
+    )
+    if not validate_bench_opt_state({"dtype": "int8"}):
+        problems.append(
+            "self-test opt_state: validator accepted a block missing "
+            "the byte accounting"
+        )
+    if not validate_bench_opt_state(
+        {"dtype": "int8", "block_size": 0, "bytes_f32": 1,
+         "bytes_int8": 1, "bytes_active": 1, "hbm_ratio": 1.0}
+    ):
+        problems.append(
+            "self-test opt_state: validator accepted block_size=0"
+        )
+    problems += validate_bench_residual_policy(
+        {
+            "policy": "bf16-resid", "baseline_policy": "dots+flash",
+            "residual_bytes_per_step": 44564480,
+            "baseline_residual_bytes_per_step": 59244544,
+            "bytes_saved_pct": 24.8,
+            "tokens_per_sec": None, "vs_baseline": None,
+            "loss_rel_diff_vs_baseline": 1.6e-5,
+        },
+        "self-test residual_policy",
+    )
+    if not validate_bench_residual_policy({"policy": "dots"}):
+        problems.append(
+            "self-test residual_policy: validator accepted a block "
+            "missing the byte accounting"
+        )
     return problems
 
 
@@ -379,6 +445,16 @@ def scan_bench_files() -> list:
         mpmd = doc.get("mpmd")
         if mpmd is not None:  # pre-MPMD rounds lack it
             problems += validate_bench_mpmd(mpmd, f"{name}:mpmd")
+        opt_state = doc.get("opt_state")
+        if opt_state is not None:  # pre-HBM-diet rounds lack it
+            problems += validate_bench_opt_state(
+                opt_state, f"{name}:opt_state"
+            )
+        residual = doc.get("residual_policy")
+        if residual is not None:  # pre-HBM-diet rounds lack it
+            problems += validate_bench_residual_policy(
+                residual, f"{name}:residual_policy"
+            )
     return problems
 
 

@@ -44,4 +44,25 @@ log "remat A/B: drop flash_q/k/v saves (double-save hypothesis)"
 RLT_REMAT_POLICY=dots+flash-out timeout 1800 python bench.py \
   2>&1 | tee "tools/hw_logs/${stamp}_bench_remat_flashout.log"
 
+log "remat A/B: bf16 scan-residual carry (residual-save diet)"
+RLT_REMAT_POLICY=bf16-resid timeout 1800 python bench.py \
+  2>&1 | tee "tools/hw_logs/${stamp}_bench_remat_bf16resid.log"
+
+log "opt-state A/B: block-scaled int8 AdamW moments"
+RLT_OPT_STATE_DTYPE=int8 timeout 1800 python bench.py \
+  2>&1 | tee "tools/hw_logs/${stamp}_bench_opt_int8.log"
+
+log "opt-state A/B: bf16 AdamW moments"
+RLT_OPT_STATE_DTYPE=bfloat16 timeout 1800 python bench.py \
+  2>&1 | tee "tools/hw_logs/${stamp}_bench_opt_bf16.log"
+
+log "update-sharding A/B: cross-replica sharded weight update"
+RLT_UPDATE_SHARDING=on timeout 1800 python bench.py \
+  2>&1 | tee "tools/hw_logs/${stamp}_bench_update_shard.log"
+
+log "combined diet: int8 state + sharded update + bf16 residuals"
+RLT_OPT_STATE_DTYPE=int8 RLT_UPDATE_SHARDING=on \
+RLT_REMAT_POLICY=bf16-resid timeout 1800 python bench.py \
+  2>&1 | tee "tools/hw_logs/${stamp}_bench_hbm_diet.log"
+
 log "done — logs in tools/hw_logs/${stamp}_*.log"
