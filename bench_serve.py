@@ -28,11 +28,24 @@ deterministic (seeded exponential inter-arrivals); latency families
 are nearest-rank percentiles over the phase's full token stream; the
 sequential baseline uses the same prompt shapes so neither arm pays a
 compile or padding tax the other doesn't.
+
+A fourth phase benches **speculative decoding** (the ``spec_decode``
+block, ``validate_bench_spec_decode``): a shallow draft proposes
+``RLT_SPEC_K`` (default 4) tokens per tick and the deeper target
+verifies them in one fixed-width dispatch, A/B'd against the same
+target on a plain (non-spec) engine.  The draft/target pair is
+CONSTRUCTED, not trained: the target is the draft plus identity tail
+blocks (``serve/draft.py::pad_identity_layers``) — full-depth compute,
+draft-equal logits — so the headline arm measures the program
+machinery at a known ~1.0 acceptance rate, and the acceptance sweep
+perturbs the tail to scan realistic acceptance regimes without
+training anything.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -42,16 +55,25 @@ import numpy as np
 
 from ray_lightning_tpu.models.generate import generate
 from ray_lightning_tpu.models.gpt import GPT, GPTConfig
+from ray_lightning_tpu.serve.draft import pad_identity_layers
 from ray_lightning_tpu.serve.engine import ServeConfig, ServeEngine
 from ray_lightning_tpu.serve.metrics import ServeStats
 from ray_lightning_tpu.telemetry import compile_event_count
-from ray_lightning_tpu.telemetry.schema import validate_bench_serve
+from ray_lightning_tpu.telemetry.schema import (
+    validate_bench_serve, validate_bench_spec_decode,
+)
 
 PROMPT_LEN = 16
 MAX_NEW = 16
 HEADLINE_REQUESTS = 48
 SWEEP_REQUESTS = 24
 SWEEP_FRACTIONS = (0.5, 0.9, 1.5)   # of measured closed-loop capacity
+SPEC_REQUESTS = 16
+# Longer generations than the headline arm: speculation pays per decode
+# tick, so the arm amortizes its (two-model) prefill cost the way a
+# real serving mix does.
+SPEC_MAX_NEW = 32
+SPEC_NOISE_SWEEP = (0.002, 0.01)    # identity-tail perturbation scales
 
 
 def _detect_backend() -> str:
@@ -154,6 +176,110 @@ def _poisson_arm(engine: ServeEngine, prompts: list, rate_rps: float,
     }
 
 
+def _spec_arm(target, target_params, serve_cfg: ServeConfig,
+              prompts: list, draft=None, draft_params=None) -> dict:
+    """One closed-loop pass on a fresh engine: warmup (compiles), then
+    the timed saturating load with the recompile counter pinned."""
+    eng = ServeEngine(
+        target, target_params, serve_cfg,
+        draft_module=draft, draft_params=draft_params,
+    )
+    for p in prompts[:2]:
+        eng.generate(p, SPEC_MAX_NEW)
+    eng.stats = ServeStats()
+    before = compile_event_count()
+    handles = [eng.submit(p, SPEC_MAX_NEW) for p in prompts]
+    t0 = time.perf_counter()
+    eng.run_until_idle()
+    wall = time.perf_counter() - t0
+    assert all(h.done() for h in handles)
+    snap = eng.snapshot()
+    counters = snap["counters"]
+    drafted = counters.get("spec_drafted", 0)
+    return {
+        "tokens": [h.result(0) for h in handles],
+        "tokens_per_sec": counters["tokens_out"] / wall,
+        "recompiles": int(compile_event_count() - before),
+        "acceptance_rate": (
+            counters.get("spec_accepted", 0) / drafted if drafted else None
+        ),
+        "drafted": drafted,
+        "accepted": counters.get("spec_accepted", 0),
+        "emitted": counters.get("spec_emitted", 0),
+    }
+
+
+def _spec_block(on_tpu: bool) -> dict:
+    """The speculative-decoding A/B: draft + identity-tail target pair,
+    spec vs non-spec closed loop, then the acceptance-rate sweep."""
+    spec_k = int(os.environ.get("RLT_SPEC_K", "4") or 4)
+    if on_tpu:
+        draft_cfg = GPTConfig(vocab_size=50304, n_layer=2, n_head=12,
+                              d_model=768, seq_len=1024, warmup_steps=10)
+        n_extra, serve_cfg = 10, ServeConfig(num_slots=16, block_size=32,
+                                             spec_k=spec_k)
+    else:
+        # Same weight-streaming-regime sizing rationale as the headline
+        # arm: the 2-layer draft is ~1/6 the per-token weight traffic
+        # of the 12-layer target, which is where drafting pays — a
+        # tiny-draft/large-target pair, not two near-equals.
+        draft_cfg = GPTConfig(vocab_size=512, n_layer=2, n_head=8,
+                              d_model=512, seq_len=128, warmup_steps=2)
+        n_extra, serve_cfg = 10, ServeConfig(num_slots=8, block_size=16,
+                                             spec_k=spec_k)
+    draft = GPT(draft_cfg, attn_impl="auto")
+    if on_tpu:
+        draft.precision = "bf16"
+    draft_params = draft.init_params(jax.random.PRNGKey(0))
+    target, target_params = pad_identity_layers(
+        draft, draft_params, n_extra
+    )
+    prompts = _prompts(SPEC_REQUESTS, draft_cfg.vocab_size, seed=42)
+    base_cfg = ServeConfig(num_slots=serve_cfg.num_slots,
+                           block_size=serve_cfg.block_size)
+    baseline = _spec_arm(target, target_params, base_cfg, prompts)
+    spec = _spec_arm(target, target_params, serve_cfg, prompts,
+                     draft=draft, draft_params=draft_params)
+    sweep = []
+    for noise in SPEC_NOISE_SWEEP:
+        noisy, noisy_params = pad_identity_layers(
+            draft, draft_params, n_extra, noise=noise
+        )
+        arm = _spec_arm(noisy, noisy_params, serve_cfg, prompts,
+                        draft=draft, draft_params=draft_params)
+        # The perturbed target costs exactly the clean target's compute
+        # (same shapes, different values), so the clean baseline arm is
+        # the denominator for every sweep point.
+        sweep.append({
+            "noise": noise,
+            "acceptance_rate": round(arm["acceptance_rate"], 4),
+            "tokens_per_sec": round(arm["tokens_per_sec"], 1),
+            "vs_baseline": round(
+                arm["tokens_per_sec"] / baseline["tokens_per_sec"], 3
+            ),
+        })
+    return {
+        "spec_k": spec_k,
+        "draft_layers": draft_cfg.n_layer,
+        "target_layers": draft_cfg.n_layer + n_extra,
+        "tokens_per_sec": round(spec["tokens_per_sec"], 1),
+        "baseline_tokens_per_sec": round(baseline["tokens_per_sec"], 1),
+        "vs_baseline": round(
+            spec["tokens_per_sec"] / baseline["tokens_per_sec"], 3
+        ),
+        "acceptance_rate": round(spec["acceptance_rate"], 4),
+        "recompiles_steady_state": spec["recompiles"],
+        "baseline_recompiles_steady_state": baseline["recompiles"],
+        "drafted": spec["drafted"],
+        "accepted": spec["accepted"],
+        "emitted": spec["emitted"],
+        "greedy_parity": spec["tokens"] == baseline["tokens"],
+        "requests": SPEC_REQUESTS,
+        "max_new_tokens": SPEC_MAX_NEW,
+        "acceptance_sweep": sweep,
+    }
+
+
 def main() -> None:
     on_tpu = _detect_backend() == "tpu"
     if on_tpu:
@@ -228,7 +354,11 @@ def main() -> None:
         "expired": snap["counters"]["expired"],
         "rate_sweep": sweep,
     }
+    # Phase 4: speculative-decoding A/B + acceptance sweep.
+    spec_block = _spec_block(on_tpu)
+
     problems = validate_bench_serve(serve_block)
+    problems += validate_bench_spec_decode(spec_block)
     if problems:  # the gate that keeps this producer honest
         for p in problems:
             sys.stderr.write(f"bench_serve schema: {p}\n")
@@ -243,6 +373,7 @@ def main() -> None:
         "max_new_tokens": MAX_NEW,
         "requests": HEADLINE_REQUESTS,
         "serve": serve_block,
+        "spec_decode": spec_block,
     }))
 
 

@@ -8,6 +8,9 @@ and print the SLO snapshot the telemetry plane exports.
 
 Run (CPU):
     JAX_PLATFORMS=cpu python examples/tpu_serve_example.py --smoke-test
+    # speculative decoding through an early-exit draft:
+    JAX_PLATFORMS=cpu python examples/tpu_serve_example.py \
+        --smoke-test --spec 4
 """
 
 from __future__ import annotations
@@ -27,6 +30,10 @@ def main() -> None:
     parser.add_argument("--requests", type=int, default=12)
     parser.add_argument("--max-new-tokens", type=int, default=16)
     parser.add_argument("--num-slots", type=int, default=4)
+    parser.add_argument("--spec", type=int, default=0, metavar="K",
+                        help="speculative decoding: draft K tokens per "
+                        "tick through a 1-layer early-exit draft of the "
+                        "trained model (0 = off)")
     parser.add_argument("--smoke-test", action="store_true")
     args = parser.parse_args()
     if args.smoke_test:
@@ -47,11 +54,22 @@ def main() -> None:
     print(f"train_loss = {trainer.callback_metrics['train_loss']:.4f}")
 
     # One engine, compiled static-shape programs, requests of DIFFERENT
-    # lengths continuously batched over the paged KV cache.
+    # lengths continuously batched over the paged KV cache.  With
+    # --spec K, a 1-layer early-exit draft of the trained model
+    # proposes K tokens per tick and the full model verifies them in
+    # one dispatch — same tokens, fewer target dispatches.
+    draft_kw = {}
+    if args.spec > 0:
+        from ray_lightning_tpu.serve import early_exit_draft
+
+        draft, draft_params = early_exit_draft(module, trainer.params, 1)
+        draft_kw = dict(draft_module=draft, draft_params=draft_params)
     engine = ServeEngine(
         module, trainer.params,
-        ServeConfig(num_slots=args.num_slots, block_size=16),
+        ServeConfig(num_slots=args.num_slots, block_size=16,
+                    spec_k=args.spec),
         telemetry_dir="rlt_logs/serve_example/telemetry",
+        **draft_kw,
     ).start()
     client = ServeClient(engine.queue_handle())
     try:
@@ -75,6 +93,11 @@ def main() -> None:
         print(f"completed={snap['counters']['completed']} "
               f"ttft_p50={lat['ttft']['p50_ms']:.1f}ms "
               f"token_p50={lat['token']['p50_ms']:.1f}ms")
+        if args.spec > 0:
+            print(f"spec: acceptance="
+                  f"{snap['gauges']['spec_acceptance_rate']:.2f} "
+                  f"drafted={snap['counters']['spec_drafted']} "
+                  f"emitted={snap['counters']['spec_emitted']}")
         assert snap["counters"]["completed"] == args.requests
         print("OK — watch live with: "
               "python tools/rlt_top.py rlt_logs/serve_example/telemetry")

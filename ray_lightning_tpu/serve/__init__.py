@@ -18,6 +18,10 @@ compiled ONCE and re-dispatched forever:
   latency, queue depth, occupancy) and OpenMetrics export;
 * :mod:`.client` — request submission/streaming over the DriverQueue
   plane, with backpressure surfaced as typed rejections;
+* :mod:`.draft` — draft-model construction for **speculative
+  decoding**: a small draft proposes K tokens per tick, the target
+  verifies them in ONE fixed-width dispatch (``spec_k``/``spec=``
+  knobs; lossless for greedy, position-keyed sampling elsewhere);
 * :mod:`.metrics` — the jax-free SLO stats engine the bench and the
   exporters share.
 
@@ -26,12 +30,18 @@ methodology (``bench_serve.py``).
 """
 
 from ray_lightning_tpu.serve.client import ServeClient, ServeRejected
+from ray_lightning_tpu.serve.draft import (
+    early_exit_draft,
+    pad_identity_layers,
+)
 from ray_lightning_tpu.serve.engine import ServeConfig, ServeEngine
 from ray_lightning_tpu.serve.kv_cache import (
     BlockAllocator,
     PagedKVCache,
     paged_decode_step,
     paged_prefill,
+    paged_verify_step,
+    sample_tokens,
 )
 from ray_lightning_tpu.serve.metrics import ServeStats
 from ray_lightning_tpu.serve.scheduler import (
@@ -50,6 +60,10 @@ __all__ = [
     "BlockAllocator",
     "paged_prefill",
     "paged_decode_step",
+    "paged_verify_step",
+    "sample_tokens",
+    "early_exit_draft",
+    "pad_identity_layers",
     "Request",
     "RequestState",
     "Scheduler",

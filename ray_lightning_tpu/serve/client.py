@@ -74,9 +74,14 @@ class ServeClient:
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                temperature: float = 0.0,
                eos_token_id: Optional[int] = None,
+               top_k: Optional[int] = None,
+               spec: Optional[int] = None,
                deadline_s: Optional[float] = None) -> str:
         """Ship one request; returns its id immediately (streaming and
-        completion arrive asynchronously)."""
+        completion arrive asynchronously).  ``spec`` caps the engine's
+        speculative draft count for this request (0 = plain decode);
+        tokens stream back in variable-width bursts either way, deduped
+        by index like any re-emission."""
         rid = uuid.uuid4().hex[:12]
         with self._lock:
             self._pending[rid] = _Pending(rid)
@@ -87,6 +92,8 @@ class ServeClient:
             "max_new_tokens": int(max_new_tokens),
             "temperature": float(temperature),
             "eos_token_id": eos_token_id,
+            "top_k": None if top_k is None else int(top_k),
+            "spec": None if spec is None else int(spec),
             "deadline_s": deadline_s,
             "reply": list(self._reply_addr),
         })

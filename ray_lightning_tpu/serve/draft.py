@@ -1,0 +1,110 @@
+"""Draft-model construction for speculative decoding.
+
+The engine takes ANY (draft_module, draft_params) pair sharing the
+target's vocabulary — a separately trained tiny model is the production
+shape.  These helpers build useful pairs from a single model:
+
+* :func:`early_exit_draft` — the draft is the target's own first
+  ``n_layers`` blocks plus its embeddings/head (the "early exit" /
+  layer-skip draft family): zero extra training, zero extra weights to
+  ship, acceptance tracks how much of the target's prediction its
+  shallow prefix already carries;
+* :func:`pad_identity_layers` — the TARGET is the draft plus extra
+  blocks whose residual branches are zeroed (an identity tail), so
+  target logits equal draft logits exactly while the target genuinely
+  pays a deeper forward.  The bench/test pair: acceptance is ~1.0 by
+  construction, and perturbing the tail (``noise``) scans the
+  acceptance axis without training anything.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Any, Dict, Tuple
+
+__all__ = ["early_exit_draft", "pad_identity_layers"]
+
+# Block leaves whose leading axis is the layer axis (dense GPT family;
+# MoE adds its own but the serving draft path is dense-only for now).
+_RESIDUAL_OUT_KEYS = ("proj_w", "proj_b", "mlp_out_w", "mlp_out_b")
+
+
+def early_exit_draft(module, params: Dict[str, Any],
+                     n_layers: int) -> Tuple[Any, Dict[str, Any]]:
+    """A draft = the target's first ``n_layers`` blocks + shared
+    embeddings, final LN and (tied) head.
+
+    The returned params ALIAS the target's arrays (sliced views of the
+    stacked block leaves) — no copy of the embedding table, which is
+    most of a small model's bytes.
+    """
+    from ray_lightning_tpu.models.gpt import GPT
+
+    cfg = module.config
+    if not 1 <= n_layers < cfg.n_layer:
+        raise ValueError(
+            f"early-exit draft needs 1 <= n_layers < {cfg.n_layer}, "
+            f"got {n_layers}"
+        )
+    if cfg.n_experts > 0:
+        raise ValueError("early_exit_draft supports dense GPTs only")
+    draft_cfg = replace(cfg, n_layer=n_layers)
+    draft = GPT(draft_cfg, attn_impl=module.attn_impl)
+    draft.precision = module.precision
+    draft_params = {
+        **{k: v for k, v in params.items() if k != "blocks"},
+        "blocks": {k: v[:n_layers] for k, v in params["blocks"].items()},
+    }
+    return draft, draft_params
+
+
+def pad_identity_layers(module, params: Dict[str, Any], n_extra: int,
+                        noise: float = 0.0,
+                        seed: int = 0) -> Tuple[Any, Dict[str, Any]]:
+    """A deeper target whose tail blocks are identity functions.
+
+    Each appended block gets fresh attention/MLP weights but ZEROED
+    residual-out projections (``proj_w``/``proj_b``/``mlp_out_w``/
+    ``mlp_out_b``), so ``x + att(...) @ 0 + 0 == x`` — the tail
+    computes full-cost attention+MLP and contributes nothing, making
+    target logits bitwise-independent of the tail.  With ``noise > 0``
+    the zeroed projections get ``N(0, noise)`` entries instead: the
+    target drifts away from its shallow prefix and the draft acceptance
+    rate falls — the knob behind the bench's acceptance-rate sweep.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ray_lightning_tpu.models.gpt import GPT
+
+    cfg = module.config
+    if n_extra < 1:
+        raise ValueError(f"n_extra must be >= 1, got {n_extra}")
+    if cfg.n_experts > 0:
+        raise ValueError("pad_identity_layers supports dense GPTs only")
+    target_cfg = replace(cfg, n_layer=cfg.n_layer + n_extra)
+    target = GPT(target_cfg, attn_impl=module.attn_impl)
+    target.precision = module.precision
+    tail = GPT(target_cfg, attn_impl=module.attn_impl).init_params(
+        jax.random.PRNGKey(seed)
+    )["blocks"]
+    rng = jax.random.PRNGKey(seed + 1)
+    blocks = {}
+    for key, head_leaf in params["blocks"].items():
+        tail_leaf = tail[key][:n_extra]
+        if key in _RESIDUAL_OUT_KEYS:
+            if noise > 0.0:
+                rng, sub = jax.random.split(rng)
+                tail_leaf = (
+                    jax.random.normal(sub, tail_leaf.shape) * noise
+                ).astype(tail_leaf.dtype)
+            else:
+                tail_leaf = jnp.zeros_like(tail_leaf)
+        blocks[key] = jnp.concatenate(
+            [jnp.asarray(head_leaf), tail_leaf], axis=0
+        )
+    target_params = {
+        **{k: v for k, v in params.items() if k != "blocks"},
+        "blocks": blocks,
+    }
+    return target, target_params

@@ -63,6 +63,11 @@ class ServeConfig:
     # Admission-queue bound: submissions beyond it are REJECTED
     # synchronously (backpressure, never silent queue bloat).
     max_queue: int = 64
+    # Speculative decoding: default drafted tokens per tick when a
+    # draft model is loaded (the verify program's width is spec_k + 1).
+    # Requires draft_module/draft_params at engine build; per-request
+    # ``spec=`` overrides downward (0 = plain target decode).
+    spec_k: int = 0
     # Sampling seed for temperature>0 requests.
     seed: int = 0
     # Background-thread idle sleep between polls when no work exists.
@@ -117,7 +122,8 @@ class ServeEngine:
     def __init__(self, module, params, config: Optional[ServeConfig] = None,
                  telemetry_dir: Optional[str] = None,
                  prom_file: Optional[str] = None,
-                 prom_port: Optional[int] = None):
+                 prom_port: Optional[int] = None,
+                 draft_module=None, draft_params=None):
         import jax
         import jax.numpy as jnp
 
@@ -131,18 +137,51 @@ class ServeEngine:
             Scheduler, default_buckets,
         )
 
+        def _prep(tree):
+            tree = jax.tree.map(jnp.asarray, tree)
+            # Same backend gate as generate(): off-TPU, per-token
+            # dequant inside the decode program costs more than the
+            # weight-bandwidth it saves — hoist it once at engine build.
+            if is_quantized(tree) and jax.default_backend() != "tpu":
+                tree = dequantize_decode_params(tree)
+            return tree
+
         self.module = module
         self.cfg = module.config
         self.config = cfg = config or ServeConfig()
         _reject_unmerged_lora(params)
-        params = jax.tree.map(jnp.asarray, params)
-        # Same backend gate as generate(): off-TPU, per-token dequant
-        # inside the decode program costs more than the weight-bandwidth
-        # it saves — hoist it once at engine build.
-        if is_quantized(params) and jax.default_backend() != "tpu":
-            params = dequantize_decode_params(params)
-        self.params = params
+        self.params = _prep(params)
         self._c = module._compute_dtype()
+        if (draft_module is None) != (draft_params is None):
+            raise ValueError(
+                "draft_module and draft_params come as a pair"
+            )
+        if cfg.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {cfg.spec_k}")
+        if cfg.spec_k > 0 and draft_module is None:
+            raise ValueError(
+                "spec_k > 0 needs a draft model: pass draft_module/"
+                "draft_params (serve/draft.py builds one from the "
+                "target)"
+            )
+        if draft_module is not None and cfg.spec_k < 1:
+            raise ValueError(
+                "a draft model without spec_k >= 1 would never be "
+                "consulted — set ServeConfig(spec_k=K)"
+            )
+        self.draft_module = draft_module
+        self.draft_params = None
+        if draft_module is not None:
+            if draft_module.config.vocab_size != self.cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab ({draft_module.config.vocab_size}) != "
+                    f"target vocab ({self.cfg.vocab_size}) — drafted "
+                    f"tokens would not be target tokens"
+                )
+            _reject_unmerged_lora(draft_params)
+            self.draft_params = _prep(draft_params)
+            self._draft_c = draft_module._compute_dtype()
+        self.spec_k = cfg.spec_k if draft_module is not None else 0
 
         self.max_model_len = cfg.max_model_len or self.cfg.seq_len
         if self.max_model_len > self.cfg.seq_len:
@@ -188,8 +227,24 @@ class ServeEngine:
         )
         self.stats = ServeStats()
         self._pool = self.cache.init_pool()
+        self._draft_pool = None
+        if draft_module is not None:
+            dcfg = draft_module.config
+            if dcfg.seq_len < self.max_model_len:
+                raise ValueError(
+                    f"draft positional table ({dcfg.seq_len}) shorter "
+                    f"than max_model_len ({self.max_model_len})"
+                )
+            # The draft pool mirrors the target pool's block geometry
+            # (same num_blocks, same block_size) and SHARES the slot
+            # block tables — one allocator, one coverage/rollback
+            # arithmetic, two pools.
+            self._draft_cache = PagedKVCache(
+                dcfg, num_blocks, cfg.block_size, dtype=self._draft_c
+            )
+            self._draft_pool = self._draft_cache.init_pool()
         self._cur_tokens = np.zeros((cfg.num_slots,), np.int32)
-        self._rng = jax.random.PRNGKey(cfg.seed)
+        self._started_t = time.monotonic()
         self._build_programs()
 
         self._handles: Dict[str, ServeHandle] = {}
@@ -217,31 +272,40 @@ class ServeEngine:
     # -- compiled programs ---------------------------------------------------
     def _build_programs(self) -> None:
         import jax
+        import jax.numpy as jnp
 
         from ray_lightning_tpu.serve.kv_cache import (
-            paged_decode_step, paged_prefill, sample_tokens,
+            make_slot_keys, paged_decode_step, paged_prefill,
+            paged_verify_step, sample_tokens,
         )
 
         cfg, c = self.cfg, self._c
+        base_key = jax.random.PRNGKey(self.config.seed)
         # Donation keeps the pool update in place on TPU; XLA:CPU cannot
         # donate and would warn on every dispatch.
         donate = (1,) if jax.default_backend() == "tpu" else ()
 
         def _decode(params, pool, block_tables, seq_lens, tokens, temps,
-                    rng):
+                    seeds, top_ks):
             logits, pool = paged_decode_step(
                 cfg, params, pool, block_tables, seq_lens, tokens,
                 compute_dtype=c,
             )
-            return sample_tokens(logits, rng, temps), pool
+            keys = make_slot_keys(base_key, seeds, seq_lens)
+            return sample_tokens(logits, keys, temps, top_ks), pool
 
         def _prefill(params, pool, tokens, prompt_len, block_ids, temp,
-                     rng):
+                     seed, top_k):
             logits, pool = paged_prefill(
                 cfg, params, pool, tokens, prompt_len, block_ids,
                 compute_dtype=c,
             )
-            first = sample_tokens(logits[None], rng, temp[None])[0]
+            keys = make_slot_keys(
+                base_key, seed[None], (prompt_len - 1)[None]
+            )
+            first = sample_tokens(
+                logits[None], keys, temp[None], top_k[None]
+            )[0]
             return first, pool
 
         self._decode_fn = jax.jit(_decode, donate_argnums=donate)
@@ -249,15 +313,72 @@ class ServeEngine:
         # length (tokens/block_ids shapes) — the bucketed prefill set.
         self._prefill_fn = jax.jit(_prefill, donate_argnums=donate)
 
+        if self.draft_module is None:
+            return
+        dcfg, dc = self.draft_module.config, self._draft_c
+        K = self.spec_k
+
+        def _draft_prefill(dparams, dpool, tokens, prompt_len, block_ids):
+            _, dpool = paged_prefill(
+                dcfg, dparams, dpool, tokens, prompt_len, block_ids,
+                compute_dtype=dc,
+            )
+            return dpool
+
+        def _draft_step(dparams, dpool, block_tables, positions, prev,
+                        override, use_override, limits):
+            # The chain's token source is resolved ON DEVICE so the
+            # K+1 dispatches never round-trip to the host: dispatch 0
+            # feeds the host-provided start token, dispatch 1 feeds the
+            # current token on slots that spent dispatch 0 syncing the
+            # bonus-token position, everything later feeds the previous
+            # dispatch's own greedy proposal.
+            tokens = jnp.where(use_override, override, prev)
+            logits, dpool = paged_decode_step(
+                dcfg, dparams, dpool, block_tables, positions, tokens,
+                compute_dtype=dc, write_limit=limits,
+            )
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), dpool
+
+        def _verify(params, pool, block_tables, seq_lens, tokens, limits,
+                    temps, seeds, top_ks):
+            logits, pool = paged_verify_step(
+                cfg, params, pool, block_tables, seq_lens, tokens,
+                limits, compute_dtype=c,
+            )
+            W, T = tokens.shape
+            pos = (seq_lens[:, None] + jnp.arange(T)).reshape(-1)
+            keys = make_slot_keys(
+                base_key, jnp.repeat(seeds, T), pos
+            )
+            sampled = sample_tokens(
+                logits.reshape(W * T, -1), keys,
+                jnp.repeat(temps, T),
+                None if top_ks is None else jnp.repeat(top_ks, T),
+            )
+            return sampled.reshape(W, T), pool
+
+        self._draft_prefill_fn = jax.jit(_draft_prefill, donate_argnums=donate)
+        self._draft_step_fn = jax.jit(_draft_step, donate_argnums=donate)
+        self._verify_fn = jax.jit(_verify, donate_argnums=donate)
+        self._spec_width = K + 1
+
     # -- submission ----------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                temperature: float = 0.0,
                eos_token_id: Optional[int] = None,
+               top_k: Optional[int] = None,
+               spec: Optional[int] = None,
                deadline_s: Optional[float] = None,
                on_token=None, rid: Optional[str] = None) -> ServeHandle:
         """Enqueue one request (thread-safe).  Returns a handle; a
         backpressure rejection is visible immediately as
-        ``handle.status == "rejected"`` (and ``result()`` raises)."""
+        ``handle.status == "rejected"`` (and ``result()`` raises).
+
+        ``spec`` caps this request's speculative draft count: None =
+        the engine's ``spec_k`` default, 0 = plain target decode, K =
+        at most K drafted tokens verified per tick (clamped to the
+        engine width)."""
         from ray_lightning_tpu.serve.scheduler import Request
 
         prompt = [int(t) for t in prompt]
@@ -267,6 +388,24 @@ class ServeEngine:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}"
             )
+        if top_k is not None:
+            top_k = int(top_k)
+            if top_k < 1:
+                raise ValueError(f"top_k must be >= 1, got {top_k}")
+            if temperature <= 0.0:
+                raise ValueError(
+                    "top_k requires temperature > 0 (temperature=0 is "
+                    "greedy decoding, which would silently ignore it)"
+                )
+        if spec is not None:
+            spec = int(spec)
+            if spec < 0:
+                raise ValueError(f"spec must be >= 0, got {spec}")
+            if spec > 0 and self.draft_module is None:
+                raise ValueError(
+                    "spec > 0 on an engine without a draft model — "
+                    "build the ServeEngine with draft_module/draft_params"
+                )
         if len(prompt) + max_new_tokens > self.max_model_len:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens "
@@ -290,6 +429,7 @@ class ServeEngine:
         req = Request(
             rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
             temperature=float(temperature), eos_token_id=eos_token_id,
+            top_k=top_k, spec=spec,
             deadline_s=deadline_s, on_token=on_token,
         )
         handle = ServeHandle(rid, req)
@@ -316,9 +456,8 @@ class ServeEngine:
     # -- the loop ------------------------------------------------------------
     def step(self) -> bool:
         """One serve iteration: drain the queue plane, expire/admit,
-        grow/preempt, one decode step.  Returns True when any work was
-        done (False = idle)."""
-        import jax
+        grow/preempt, one decode (or draft→verify) tick.  Returns True
+        when any work was done (False = idle)."""
         import jax.numpy as jnp
 
         self._drain_inbox()
@@ -339,12 +478,22 @@ class ServeEngine:
                                              // self.config.block_size],
                 np.int32,
             )
-            self._rng, sub = jax.random.split(self._rng)
+            padded = jnp.asarray(padded)
+            ids = jnp.asarray(ids)
             first, self._pool = self._prefill_fn(
-                self.params, self._pool, jnp.asarray(padded),
-                np.int32(req.prompt_len), jnp.asarray(ids),
-                np.float32(req.temperature), sub,
+                self.params, self._pool, padded,
+                np.int32(req.prompt_len), ids,
+                np.float32(req.temperature), np.int32(req.sample_seed),
+                np.int32(req.top_k or 0),
             )
+            if self.draft_module is not None:
+                # The draft cache tracks every admission (one bucketed
+                # draft-prefill program per bucket) so any later tick
+                # can speculate for this slot.
+                self._draft_pool = self._draft_prefill_fn(
+                    self.draft_params, self._draft_pool, padded,
+                    np.int32(req.prompt_len), ids,
+                )
             first = int(first)
             t_first = time.monotonic()
             self.stats.note_first_token(t_first - req.arrival_t)
@@ -354,8 +503,22 @@ class ServeEngine:
             if done:
                 self._complete(slot)
 
+        # Per-slot speculative widths for THIS tick: the engine K,
+        # capped per request (spec= knob) and by the tokens it has left
+        # (a tick never drafts past max_new_tokens).  Zero everywhere
+        # when no draft model is loaded.
+        widths = self._tick_widths()
+
         # Growth (and preemption when the pool is dry) for every slot
-        # about to write past its allocated blocks.
+        # about to write past its allocated blocks.  Preemption is only
+        # ever for BASELINE coverage — the one position a plain decode
+        # write needs (round-11 semantics, unchanged).  The speculative
+        # window is claimed OPPORTUNISTICALLY on top: if the pool can't
+        # cover seq_len + width, the slot drafts fewer tokens this tick
+        # (down to zero) rather than evicting a neighbour — speculation
+        # is a throughput bet, and a bet must never cost another
+        # request its progress (two spec slots preempting each other's
+        # windows would ping-pong without forward progress).
         active = [
             s for s, r in enumerate(self.scheduler.slots) if r is not None
         ]
@@ -374,35 +537,207 @@ class ServeEngine:
                         "request — num_blocks below one sequence"
                     )
                 self.stats.bump("preempted")
+        for slot, req in enumerate(self.scheduler.slots):
+            if req is None or widths[slot] == 0:
+                continue
+            w = widths[slot]
+            seq_len = int(self.scheduler.seq_lens[slot])
+            while w > 0 and not self.scheduler.cover(slot, seq_len + w):
+                w -= 1  # pool can't fund the window: draft less
+            widths[slot] = w
 
         active = [
             s for s, r in enumerate(self.scheduler.slots) if r is not None
         ]
         if active:
             worked = True
-            self._rng, sub = jax.random.split(self._rng)
-            t0 = time.monotonic()
-            toks, self._pool = self._decode_fn(
-                self.params, self._pool,
-                jnp.asarray(self.scheduler.block_tables),
-                jnp.asarray(self.scheduler.seq_lens),
-                jnp.asarray(self._cur_tokens),
-                jnp.asarray(self.scheduler.temperatures), sub,
-            )
-            toks = np.asarray(toks)
-            dt = time.monotonic() - t0
-            self.stats.bump("decode_steps")
-            self.stats.note_token_latency(dt, n_tokens=len(active))
-            for slot in active:
-                self.scheduler.seq_lens[slot] += 1
-                tok = int(toks[slot])
-                self._cur_tokens[slot] = tok
-                done = self.scheduler.append_token(slot, tok)
-                if done:
-                    self._complete(slot)
+            if any(widths[s] > 0 for s in active):
+                self._spec_tick(active, widths)
+            else:
+                self._decode_tick(active)
         self._refresh_gauges()
         self._maybe_export()
         return worked
+
+    def _tick_widths(self) -> List[int]:
+        """Drafted tokens per slot this tick (0 = plain decode)."""
+        widths = [0] * self.config.num_slots
+        if self.spec_k == 0:
+            return widths
+        for slot, req in enumerate(self.scheduler.slots):
+            if req is None:
+                continue
+            k = self.spec_k if req.spec is None else min(
+                req.spec, self.spec_k
+            )
+            remaining = req.max_new_tokens - len(req.generated)
+            widths[slot] = max(0, min(k, remaining - 1))
+        return widths
+
+    def _tick_top_ks(self):
+        """``top_ks`` operand for this tick, or None when NO slot uses
+        top-k — the None variant compiles without the full-vocab sort,
+        so greedy/temperature-only traffic (the common mix) never pays
+        sorted-vocab work per dispatch.  The sorted variant compiles
+        once on the first top-k tick, like a fresh prefill bucket."""
+        import jax.numpy as jnp
+
+        if not np.any(self.scheduler.top_ks > 0):
+            return None
+        return jnp.asarray(self.scheduler.top_ks)
+
+    def _decode_tick(self, active: List[int]) -> None:
+        """One token for every active slot — the non-speculative path
+        (and the fallback when no active slot drafts this tick)."""
+        import jax.numpy as jnp
+
+        t0 = time.monotonic()
+        seq_lens = jnp.asarray(self.scheduler.seq_lens)
+        cur = jnp.asarray(self._cur_tokens)
+        tables = jnp.asarray(self.scheduler.block_tables)
+        toks, self._pool = self._decode_fn(
+            self.params, self._pool, tables, seq_lens, cur,
+            jnp.asarray(self.scheduler.temperatures),
+            jnp.asarray(self.scheduler.sample_seeds),
+            self._tick_top_ks(),
+        )
+        if self.draft_module is not None:
+            # Mirror the write into the draft cache so its frontier
+            # claim below stays TRUE: a fallback tick on a speculative
+            # engine (pool pressure shrank every window to zero) must
+            # not leave a silent gap that degrades every later draft
+            # proposal for the sequence.
+            _, self._draft_pool = self._draft_step_fn(
+                self.draft_params, self._draft_pool, tables, seq_lens,
+                cur, cur, jnp.ones((self.config.num_slots,), bool),
+                seq_lens + 1,
+            )
+            self.stats.bump("draft_steps")
+        toks = np.asarray(toks)
+        dt = time.monotonic() - t0
+        self.stats.bump("decode_steps")
+        self.stats.note_token_latency(dt, n_tokens=len(active))
+        for slot in active:
+            self.scheduler.seq_lens[slot] += 1
+            self.scheduler.draft_lens[slot] = self.scheduler.seq_lens[slot]
+            tok = int(toks[slot])
+            self._cur_tokens[slot] = tok
+            done = self.scheduler.append_token(slot, tok)
+            if done:
+                self._complete(slot)
+
+    def _spec_tick(self, active: List[int], widths: List[int]) -> None:
+        """One draft-propose / target-verify round.
+
+        1. the draft model proposes up to K tokens per slot — K+1
+           dispatches of its fixed-width decode program chained on
+           device (the first dispatch doubles as the catch-up write for
+           slots whose draft cache trails by the bonus token);
+        2. the target scores every slot's (current token + drafts)
+           window in ONE K+1-wide verify dispatch, sampling its own
+           token at each position with the request's position-keyed
+           streams;
+        3. host-side accept/reject keeps each slot's longest agreeing
+           draft prefix plus the target's token at the first
+           disagreement (== the bonus token when everything agreed),
+           emits that variable-width batch, and rolls both caches back
+           to the emitted frontier (blocks past it return to the pool).
+
+        Greedy slots emit exactly the tokens sequential greedy decode
+        would: every accepted draft MATCHED the target argmax, and the
+        corrected token IS the target argmax at the first mismatch.
+        """
+        import jax.numpy as jnp
+
+        sched = self.scheduler
+        K = self.spec_k
+        t0 = time.monotonic()
+        limits = np.zeros((self.config.num_slots,), np.int32)
+        for slot in active:
+            limits[slot] = int(sched.seq_lens[slot]) + widths[slot] + 1
+        gaps = np.where(
+            np.asarray([r is not None for r in sched.slots]),
+            sched.seq_lens - sched.draft_lens, 0,
+        ).astype(np.int32)
+        # Dispatch-0 token: the emitted token AT draft_lens — the
+        # bonus-token catch-up write for gap-1 slots, the current token
+        # (= proposal seed) for everyone else.
+        start = np.zeros((self.config.num_slots,), np.int32)
+        for slot in active:
+            req = sched.slots[slot]
+            if gaps[slot]:
+                start[slot] = req.generated[
+                    int(sched.draft_lens[slot]) - req.prompt_len
+                ]
+            else:
+                start[slot] = self._cur_tokens[slot]
+        cur = jnp.asarray(self._cur_tokens)
+        limits_j = jnp.asarray(limits)
+        tables = jnp.asarray(sched.block_tables)
+        ones = jnp.ones((self.config.num_slots,), bool)
+        outs = []
+        prev = cur
+        for j in range(K + 1):
+            if j == 0:
+                override, mask = jnp.asarray(start), ones
+            elif j == 1:
+                override, mask = cur, jnp.asarray(gaps > 0)
+            else:
+                override, mask = cur, jnp.zeros_like(ones)
+            prev, self._draft_pool = self._draft_step_fn(
+                self.draft_params, self._draft_pool, tables,
+                jnp.asarray(sched.draft_lens + j), prev,
+                override, mask, limits_j,
+            )
+            outs.append(prev)
+        self.stats.bump("draft_steps", K + 1)
+        outs = np.stack([np.asarray(o) for o in outs])  # (K+1, W)
+
+        # Per-slot proposals: the K chain outputs starting at the
+        # slot's gap offset.
+        window = np.zeros((self.config.num_slots, K + 1), np.int32)
+        window[:, 0] = self._cur_tokens
+        for slot in active:
+            g = int(gaps[slot])
+            window[slot, 1: K + 1] = outs[g: g + K, slot]
+
+        sampled, self._pool = self._verify_fn(
+            self.params, self._pool, tables,
+            jnp.asarray(sched.seq_lens), jnp.asarray(window),
+            limits_j, jnp.asarray(sched.temperatures),
+            jnp.asarray(sched.sample_seeds), self._tick_top_ks(),
+        )
+        sampled = np.asarray(sampled)  # (W, K+1)
+        self.stats.bump("verify_steps")
+        dt = time.monotonic() - t0
+
+        total_emitted = 0
+        for slot in active:
+            w = widths[slot]
+            drafts = window[slot, 1: w + 1]
+            target = sampled[slot, : w + 1]
+            accepted = 0
+            while accepted < w and drafts[accepted] == target[accepted]:
+                accepted += 1
+            emit = [int(t) for t in drafts[:accepted]]
+            emit.append(int(target[accepted]))
+            seq_was = int(sched.seq_lens[slot])
+            draft_was = int(sched.draft_lens[slot])
+            n, done = sched.append_tokens(slot, emit)
+            new_len = seq_was + n
+            # Roll BOTH caches back to the emitted frontier: the target
+            # wrote the whole window, the draft chain wrote K+1
+            # positions from its own frontier; everything past new_len
+            # is rejected garbage whose blocks return to the pool.
+            sched.truncate_slot_to(slot, new_len)
+            sched.draft_lens[slot] = min(draft_was + K + 1, new_len)
+            self._cur_tokens[slot] = emit[n - 1]
+            total_emitted += n
+            self.stats.note_spec_slot(w, min(accepted, n), n)
+            if done:
+                self._complete(slot)
+        self.stats.bump("spec_ticks")
+        self.stats.note_token_latency(dt, n_tokens=total_emitted)
 
     def run_until_idle(self, max_steps: int = 1_000_000) -> None:
         """Drive the loop synchronously until queue and slots drain."""
@@ -534,6 +869,8 @@ class ServeEngine:
                 item["prompt"], int(item["max_new_tokens"]),
                 temperature=float(item.get("temperature", 0.0)),
                 eos_token_id=item.get("eos_token_id"),
+                top_k=item.get("top_k"),
+                spec=item.get("spec"),
                 deadline_s=item.get("deadline_s"),
                 on_token=on_token, rid=rid,
             )
@@ -577,7 +914,21 @@ class ServeEngine:
 
     # -- telemetry -----------------------------------------------------------
     def _refresh_gauges(self) -> None:
-        self.stats.set_gauges(**self.scheduler.snapshot())
+        gauges = self.scheduler.snapshot()
+        if self.spec_k > 0:
+            counters = self.stats.counters
+            drafted = counters.get("spec_drafted", 0)
+            gauges["spec_acceptance_rate"] = (
+                counters.get("spec_accepted", 0) / drafted if drafted
+                else 0.0
+            )
+            elapsed = max(time.monotonic() - self._started_t, 1e-9)
+            # Goodput = EMITTED tokens/s — what clients actually see,
+            # vs the drafted+verified work the chip performed.
+            gauges["spec_goodput_tokens_per_sec"] = (
+                counters.get("spec_emitted", 0) / elapsed
+            )
+        self.stats.set_gauges(**gauges)
 
     def snapshot(self) -> dict:
         """The live serve snapshot (schema:

@@ -59,6 +59,11 @@ __all__ = [
     "PagedKVCache",
     "paged_prefill",
     "paged_decode_step",
+    "paged_verify_step",
+    "sample_tokens",
+    "make_slot_keys",
+    "extend_block_coverage",
+    "truncate_to",
 ]
 
 # Physical block 0 is never allocated: it is the write target for
@@ -116,6 +121,61 @@ class BlockAllocator:
                 )
             self._live.discard(b)
             self._free.append(b)
+
+
+def extend_block_coverage(
+    allocator: BlockAllocator,
+    blocks: List[int],
+    table_row,
+    upto_pos: int,
+    block_size: int,
+) -> bool:
+    """Grow ``blocks``/``table_row`` until cache position ``upto_pos``
+    is writable.  All-or-nothing: either every missing block is
+    allocated (True) or none are (False = pool dry) — a partially
+    covered multi-token write would scatter past its allocation.
+
+    The multi-token append primitive of the speculative-decoding path:
+    a verify step writes K+1 positions in one dispatch, so coverage is
+    claimed for the whole window BEFORE the dispatch, and
+    :func:`truncate_to` returns the rejected tail's blocks afterwards.
+    """
+    need = (upto_pos // block_size) + 1 - len(blocks)
+    if need <= 0:
+        return True
+    ids = allocator.alloc(need)
+    if ids is None:
+        return False
+    start = len(blocks)
+    blocks.extend(ids)
+    table_row[start: start + len(ids)] = ids
+    return True
+
+
+def truncate_to(
+    allocator: BlockAllocator,
+    blocks: List[int],
+    table_row,
+    n_tokens: int,
+    block_size: int,
+) -> int:
+    """Shrink a sequence's block coverage to exactly ``n_tokens`` cache
+    slots: blocks past the covering prefix are freed back to the pool
+    and their table entries restored to the trash block.  Returns the
+    number of blocks freed.
+
+    Pure ``seq_lens``/allocator arithmetic — the rollback half of a
+    speculative verify tick (rejected drafts' cache slots are garbage
+    the visibility mask already hides; this returns their BLOCKS).
+    """
+    keep = -(-n_tokens // block_size) if n_tokens > 0 else 0
+    freed = blocks[keep:]
+    if not freed:
+        return 0
+    del blocks[keep:]
+    allocator.free(freed)
+    table_row[keep: keep + len(freed)] = TRASH_BLOCK
+    return len(freed)
 
 
 class PagedKVCache:
@@ -220,6 +280,7 @@ def paged_decode_step(
     seq_lens: jax.Array,
     tokens: jax.Array,
     compute_dtype=jnp.float32,
+    write_limit: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """One token for every slot of the fixed-width active set.
 
@@ -232,6 +293,13 @@ def paged_decode_step(
         tokens: ``(W,)`` int32 — the token each slot feeds this step
             (inactive slots: anything; their row is masked by pointing
             at the trash block and never being read).
+        write_limit: optional ``(W,)`` int32 — positions ``>= limit``
+            write into the trash block instead of the slot's own blocks.
+            The draft chain of the speculative path dispatches this
+            program at positions past some slots' allocated coverage
+            (uniform chain length over non-uniform per-slot widths);
+            the limit redirects those strays.  ``None`` = the plain
+            serve decode program, graph-identical to pre-spec rounds.
 
     Returns:
         ``(logits (W, V) f32, updated pool)``.
@@ -251,9 +319,16 @@ def paged_decode_step(
     # garbage from ever indexing out of the table.
     safe_pos = jnp.minimum(pos, params["wpe"].shape[0] - 1)
     x = _embed(params, tokens, c) + params["wpe"][safe_pos].astype(c)
+    blk_idx = pos // Bs
+    if write_limit is not None:
+        # Chain positions may run past the table width; the clamp keeps
+        # the gather in bounds and the limit sends the write to trash.
+        blk_idx = jnp.minimum(blk_idx, M - 1)
     write_blk = jnp.take_along_axis(
-        block_tables, (pos // Bs)[:, None], axis=1
+        block_tables, blk_idx[:, None], axis=1
     )[:, 0]
+    if write_limit is not None:
+        write_blk = jnp.where(pos < write_limit, write_blk, TRASH_BLOCK)
     write_off = pos % Bs
     scale = cfg.head_dim ** -0.5
     # Visible: cache positions [0, pos] inclusive — the current token's
@@ -305,23 +380,165 @@ def paged_decode_step(
     return logits, {"k": k_new, "v": v_new}
 
 
+def paged_verify_step(
+    cfg: GPTConfig,
+    params: Dict[str, Any],
+    pool: Dict[str, jax.Array],
+    block_tables: jax.Array,
+    seq_lens: jax.Array,
+    tokens: jax.Array,
+    write_limit: jax.Array,
+    compute_dtype=jnp.float32,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """``T`` tokens for every slot in ONE dispatch — the target model's
+    speculative verification program.
+
+    Where :func:`paged_decode_step` feeds one token per slot at
+    ``seq_lens``, this feeds a ``(W, T)`` window — each slot's current
+    token followed by its ``K = T - 1`` drafted tokens — at positions
+    ``seq_lens + [0, T)``, writes all ``T`` k/v entries into the slot's
+    blocks, and returns logits at EVERY window position, so the target
+    scores K draft proposals at the cost of one (wider) dispatch
+    instead of K sequential ones.  Causality within the window is the
+    static path's frontier: query ``i`` sees cache positions
+    ``<= seq_lens + i`` (its own fresh write included — the scatter
+    lands before the gather, exactly like the decode step).
+
+    Args:
+        tokens: ``(W, T)`` int32 window per slot.  Slots speculating
+            fewer than ``T - 1`` tokens pad with anything; their
+            ``write_limit`` trashes the pad writes and the engine
+            ignores the pad logits.
+        write_limit: ``(W,)`` int32 — positions ``>= limit`` write into
+            the trash block (inactive slots carry 0: every write
+            trashed).
+
+    Returns:
+        ``(logits (W, T, V) f32, updated pool)``.
+
+    Fixed ``(W, T)`` width for the engine's lifetime: accept/reject,
+    rollback, and per-slot draft widths are all operand values, so the
+    speculative steady state stays on the compiled-once program set.
+    """
+    c = compute_dtype
+    Bs = pool["k"].shape[2]
+    W, M = block_tables.shape
+    T = tokens.shape[1]
+    S = M * Bs
+    pos = seq_lens[:, None] + jnp.arange(T)[None, :]          # (W, T)
+    safe_pos = jnp.minimum(pos, params["wpe"].shape[0] - 1)
+    x = _embed(params, tokens, c) + params["wpe"][safe_pos].astype(c)
+    write_blk = jnp.take_along_axis(
+        block_tables, jnp.minimum(pos // Bs, M - 1), axis=1
+    )
+    write_blk = jnp.where(pos < write_limit[:, None], write_blk,
+                          TRASH_BLOCK)
+    write_off = pos % Bs
+    scale = cfg.head_dim ** -0.5
+    visible = jnp.arange(S)[None, None, :] <= pos[:, :, None]  # (W, T, S)
+
+    def block(carry, layer):
+        x, = carry
+        p, k_pool, v_pool = layer  # (N, Bs, H, Dh) each
+        h = _layer_norm(x, p["ln1_g"], p["ln1_b"])
+        qkv = h @ resolve_weight(p, "qkv_w", c) + p["qkv_b"].astype(c)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(z):
+            return z.reshape(W, T, cfg.n_head, cfg.head_dim)
+
+        k_pool = k_pool.at[write_blk, write_off].set(
+            heads(k).astype(k_pool.dtype)
+        )
+        v_pool = v_pool.at[write_blk, write_off].set(
+            heads(v).astype(v_pool.dtype)
+        )
+        ctx_k = k_pool[block_tables].reshape(W, S, cfg.n_head, cfg.head_dim)
+        ctx_v = v_pool[block_tables].reshape(W, S, cfg.n_head, cfg.head_dim)
+        scores = jnp.einsum(
+            "wthd,wshd->whts", heads(q).astype(jnp.float32),
+            ctx_k.astype(jnp.float32),
+        ) * scale
+        scores = jnp.where(visible[:, None], scores, _NEG_INF)
+        probs = jax.nn.softmax(scores, axis=-1)
+        att = jnp.einsum(
+            "whts,wshd->wthd", probs, ctx_v.astype(jnp.float32)
+        ).reshape(W, T, cfg.d_model).astype(c)
+        x = x + att @ resolve_weight(p, "proj_w", c) + p["proj_b"].astype(c)
+        if cfg.n_experts > 0:
+            # Routed set = the W*T window tokens (see generate() caveat).
+            x, _ = _moe_residual(x, p, cfg, groups=1)
+        else:
+            x = _mlp_residual(x, p, c)
+        return (x,), (k_pool, v_pool)
+
+    (x,), (k_new, v_new) = jax.lax.scan(
+        block, (x,), (params["blocks"], pool["k"], pool["v"])
+    )
+    logits = _head_logits(params, x, c)
+    return logits, {"k": k_new, "v": v_new}
+
+
+def make_slot_keys(
+    base_key: jax.Array,
+    seeds: jax.Array,
+    positions: jax.Array,
+) -> jax.Array:
+    """Per-slot sampling keys ``fold_in(fold_in(base, seed), position)``.
+
+    The serving sampler's whole RNG discipline: ``seed`` is stable per
+    REQUEST (assigned at submit), ``position`` is the cache position of
+    the logits being sampled — both deterministic functions of the
+    request's own history, never of the batch around it.  So a request
+    re-decoded after a recompute preemption (possibly in a different
+    slot, among different neighbours) regenerates bitwise-identical
+    tokens at any temperature, which is what makes the speculative
+    rollback path (and index-based client dedup) safe beyond greedy.
+    """
+    def one(seed, p):
+        return jax.random.fold_in(jax.random.fold_in(base_key, seed), p)
+
+    return jax.vmap(one)(seeds, positions)
+
+
 def sample_tokens(
     logits: jax.Array,
-    rng: jax.Array,
+    keys: jax.Array,
     temperatures: jax.Array,
+    top_ks: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Per-slot sampling decision: greedy where ``temperature <= 0``,
-    categorical at ``logits / temperature`` elsewhere.  Shape-static
-    (W,) → (W,) int32 so it fuses into the decode program.
+    categorical at ``logits / temperature`` elsewhere, optionally
+    truncated to the ``top_ks[w]`` highest-probability tokens.
+    Shape-static ``(W, V)`` → ``(W,)`` int32 so it fuses into the
+    decode/verify programs.
 
-    Per-request top-k/top-p are intentionally not offered: they would
-    either force per-slot sorted-vocab work into every step or bucket
-    requests by sampler config; greedy/temperature covers the serving
-    SLO bench and the static path keeps the full sampler family.
+    Args:
+        keys: ``(W,)`` per-slot PRNG keys (:func:`make_slot_keys`) —
+            one independent stream per slot, so a slot's draw never
+            depends on who else is in the batch.
+        top_ks: optional ``(W,)`` int32 — ``k <= 0`` disables the
+            truncation for that slot.  The filter is a full-vocab sort
+            + threshold mask (k is an operand VALUE, never a shape), so
+            any per-request k rides the same compiled program.
+
+    Per-request top-p is intentionally not offered; greedy/temperature/
+    top-k covers the serving SLO bench and the static path keeps the
+    full sampler family.
     """
     greedy = jnp.argmax(logits, axis=-1)
+    masked = logits
+    if top_ks is not None:
+        v = logits.shape[-1]
+        sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        kth = jnp.take_along_axis(
+            sorted_desc, jnp.clip(top_ks - 1, 0, v - 1)[:, None], axis=-1
+        )
+        masked = jnp.where(
+            (top_ks > 0)[:, None] & (logits < kth), _NEG_INF, logits
+        )
     temps = jnp.maximum(temperatures, 1e-6)[:, None]
-    sampled = jax.random.categorical(rng, logits / temps)
+    sampled = jax.vmap(jax.random.categorical)(keys, masked / temps)
     return jnp.where(
         temperatures <= 0.0, greedy, sampled
     ).astype(jnp.int32)

@@ -108,6 +108,23 @@ class ServeStats:
             self.counters["completed"] += 1
             self._e2e.add(e2e_s)
 
+    def note_spec_slot(self, drafted: int, accepted: int,
+                       emitted: int) -> None:
+        """One slot's accounting for one speculative verify tick.
+        Spec counters exist only on engines that actually speculate
+        (lazily created), so plain engines' snapshots — and their
+        OpenMetrics render — stay byte-identical to pre-spec rounds."""
+        if accepted > drafted:
+            raise ValueError(
+                f"spec accounting bug: accepted {accepted} > drafted "
+                f"{drafted}"
+            )
+        with self._lock:
+            for key, n in (("spec_drafted", drafted),
+                           ("spec_accepted", accepted),
+                           ("spec_emitted", emitted)):
+                self.counters[key] = self.counters.get(key, 0) + n
+
     def set_gauges(self, **gauges: float) -> None:
         with self._lock:
             self.gauges.update(gauges)

@@ -32,7 +32,9 @@ from typing import Callable, Deque, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ray_lightning_tpu.serve.kv_cache import BlockAllocator, TRASH_BLOCK
+from ray_lightning_tpu.serve.kv_cache import (
+    BlockAllocator, TRASH_BLOCK, extend_block_coverage, truncate_to,
+)
 
 __all__ = ["Request", "RequestState", "Scheduler", "default_buckets"]
 
@@ -54,6 +56,13 @@ class Request:
     max_new_tokens: int
     temperature: float = 0.0
     eos_token_id: Optional[int] = None
+    # Shape-static top-k truncation for temperature sampling (ridden as
+    # an int32 operand value; None/0 = off).
+    top_k: Optional[int] = None
+    # Speculative-decoding draft count for this request: None = the
+    # engine default, 0 = plain target decode, K > 0 = up to K drafted
+    # tokens verified per tick (capped per tick by the tokens left).
+    spec: Optional[int] = None
     # Seconds from arrival the FIRST token must land by (TTFT SLO at
     # admission; None = no deadline).
     deadline_s: Optional[float] = None
@@ -73,6 +82,11 @@ class Request:
     preemptions: int = 0
     # Admission ordinal — the preemption victim ordering key.
     _seq_no: int = -1
+    # Submission ordinal — the request's sampling-stream identity.
+    # Assigned ONCE at submit (never re-assigned on preemption requeue),
+    # so a recompute re-decode replays the exact same per-position key
+    # stream (kv_cache.make_slot_keys).
+    sample_seed: int = 0
 
     @property
     def prompt_len(self) -> int:
@@ -146,7 +160,15 @@ class Scheduler:
         )
         self.seq_lens = np.zeros((num_slots,), np.int32)
         self.temperatures = np.zeros((num_slots,), np.float32)
+        self.top_ks = np.zeros((num_slots,), np.int32)
+        self.sample_seeds = np.zeros((num_slots,), np.int32)
+        # Draft-cache frontier per slot (speculative decoding): the
+        # draft pool shares this table's block ids, valid through
+        # position draft_lens[slot] - 1.  Trails seq_lens by at most 1
+        # (the bonus-token tick), never leads it.
+        self.draft_lens = np.zeros((num_slots,), np.int32)
         self._admit_counter = 0
+        self._submit_counter = 0
 
     # -- queue side ----------------------------------------------------------
     @property
@@ -168,6 +190,8 @@ class Scheduler:
             req.state = RequestState.REJECTED
             return False
         req.state = RequestState.QUEUED
+        req.sample_seed = self._submit_counter
+        self._submit_counter += 1
         self.queue.append(req)
         return True
 
@@ -234,6 +258,9 @@ class Scheduler:
             row[: len(ids)] = ids
             self.seq_lens[slot] = req.prompt_len
             self.temperatures[slot] = req.temperature
+            self.top_ks[slot] = req.top_k or 0
+            self.sample_seeds[slot] = req.sample_seed
+            self.draft_lens[slot] = req.prompt_len
             admissions.append((slot, req, bucket))
         return admissions, expired
 
@@ -266,10 +293,12 @@ class Scheduler:
         )
         return done
 
-    def needs_block(self, slot: int) -> bool:
-        """True when the NEXT decode write for ``slot`` crosses into an
-        unallocated block."""
-        pos = int(self.seq_lens[slot])
+    def needs_block(self, slot: int, upto_pos: Optional[int] = None) -> bool:
+        """True when a write at ``upto_pos`` (default: the NEXT decode
+        write, ``seq_lens[slot]``) crosses into an unallocated block.
+        Speculative ticks pass ``seq_lens + width`` — the last position
+        the verify window scatters."""
+        pos = int(self.seq_lens[slot]) if upto_pos is None else int(upto_pos)
         return pos // self.block_size >= len(self._blocks[slot])
 
     def grow(self, slot: int) -> bool:
@@ -285,6 +314,54 @@ class Scheduler:
         self._blocks[slot].extend(ids)
         self.block_tables[slot, len(self._blocks[slot]) - 1] = ids[0]
         return True
+
+    def append_tokens(self, slot: int, tokens: Sequence[int],
+                      now: Optional[float] = None) -> Tuple[int, bool]:
+        """Record a TICK's worth of generated tokens for ``slot`` —
+        the variable-width emission of a speculative verify (accepted
+        prefix + corrected/bonus token).  Stops early at eos or the
+        request's ``max_new_tokens``; returns ``(n_emitted, done)``.
+        ``on_token`` fires per token with its stream index, exactly as
+        the one-token path does, so client-side index dedup is
+        width-agnostic."""
+        req = self.slots[slot]
+        assert req is not None, f"append_tokens on empty slot {slot}"
+        emitted = 0
+        for tok in tokens:
+            if len(req.generated) >= req.max_new_tokens:
+                return emitted, True
+            done = self.append_token(slot, int(tok), now=now)
+            emitted += 1
+            if done:
+                return emitted, True
+        return emitted, len(req.generated) >= req.max_new_tokens
+
+    def truncate_slot_to(self, slot: int, n_tokens: int) -> int:
+        """Roll the slot's cache coverage back to ``n_tokens`` positions
+        (the post-accept frontier of a speculative tick): ``seq_lens``
+        shrinks to the value, blocks past the covering prefix return to
+        the pool, their table entries go back to trash.  Returns blocks
+        freed."""
+        freed = truncate_to(
+            self.allocator, self._blocks[slot], self.block_tables[slot],
+            n_tokens, self.block_size,
+        )
+        self.seq_lens[slot] = n_tokens
+        self.draft_lens[slot] = min(int(self.draft_lens[slot]), n_tokens)
+        return freed
+
+    def cover(self, slot: int, upto_pos: int) -> bool:
+        """Multi-block :meth:`grow`: allocate until position
+        ``upto_pos`` is writable (all-or-nothing).  False = pool dry."""
+        if upto_pos // self.block_size >= self.max_blocks_per_seq:
+            raise RuntimeError(
+                f"slot {slot} coverage request past max_blocks_per_seq "
+                f"{self.max_blocks_per_seq} — engine width-cap bug"
+            )
+        return extend_block_coverage(
+            self.allocator, self._blocks[slot], self.block_tables[slot],
+            upto_pos, self.block_size,
+        )
 
     def preempt_youngest(self, protect: Optional[int] = None
                          ) -> Optional[Request]:
@@ -327,6 +404,9 @@ class Scheduler:
         self.block_tables[slot, :] = TRASH_BLOCK
         self.seq_lens[slot] = 0
         self.temperatures[slot] = 0.0
+        self.top_ks[slot] = 0
+        self.sample_seeds[slot] = 0
+        self.draft_lens[slot] = 0
 
     # -- introspection -------------------------------------------------------
     def snapshot(self) -> dict:

@@ -159,16 +159,46 @@ def _render_serve(serve: Dict[str, Any]) -> list:
             lines.append(f"# HELP {_PREFIX}_serve_{name} {help_}")
             lines.append(f"{_PREFIX}_serve_{name} {gauges[name]}")
     counters = serve.get("counters", {})
+    spec_tokens = {
+        kind: counters[f"spec_{kind}"]
+        for kind in ("drafted", "accepted", "emitted")
+        if f"spec_{kind}" in counters
+    }
     if counters:
         lines.append(f"# TYPE {_PREFIX}_serve_requests counter")
         lines.append(
             f"# HELP {_PREFIX}_serve_requests serve events by kind"
         )
         for kind in sorted(counters):
+            if kind.startswith("spec_") and not kind == "spec_ticks":
+                continue  # the rlt_serve_spec_* family below
             lines.append(
                 f'{_PREFIX}_serve_requests_total'
                 f'{{kind="{_esc(kind)}"}} {counters[kind]}'
             )
+    # Speculative decoding (engines with a draft model): token-level
+    # draft/accept/emit accounting + the derived SLO gauges.
+    if spec_tokens:
+        lines.append(f"# TYPE {_PREFIX}_serve_spec_tokens counter")
+        lines.append(
+            f"# HELP {_PREFIX}_serve_spec_tokens speculative tokens "
+            f"by stage (drafted -> accepted -> emitted)"
+        )
+        for kind, value in sorted(spec_tokens.items()):
+            lines.append(
+                f'{_PREFIX}_serve_spec_tokens_total'
+                f'{{kind="{_esc(kind)}"}} {value}'
+            )
+    for name, help_ in (
+        ("spec_acceptance_rate",
+         "accepted / drafted over the engine lifetime"),
+        ("spec_goodput_tokens_per_sec",
+         "client-visible emitted tokens per second"),
+    ):
+        if name in gauges:
+            lines.append(f"# TYPE {_PREFIX}_serve_{name} gauge")
+            lines.append(f"# HELP {_PREFIX}_serve_{name} {help_}")
+            lines.append(f"{_PREFIX}_serve_{name} {gauges[name]}")
     latency = serve.get("latency", {})
     for family, summary in sorted(latency.items()):
         metric = f"serve_{family}_latency_ms"

@@ -37,6 +37,7 @@ __all__ = [
     "validate_serve_reply",
     "validate_serve_snapshot",
     "validate_bench_serve",
+    "validate_bench_spec_decode",
     "validate_mpmd_stage_item",
     "validate_mpmd_xfer",
     "validate_mpmd_snapshot",
@@ -324,6 +325,8 @@ _SERVE_REQUEST_REQUIRED = {
 _SERVE_REQUEST_OPTIONAL = {
     "temperature": (int, float),
     "eos_token_id": (int, type(None)),
+    "top_k": (int, type(None)),       # shape-static sampler truncation
+    "spec": (int, type(None)),        # per-request draft count cap
     "deadline_s": (int, float, type(None)),
 }
 
@@ -410,6 +413,19 @@ def validate_serve_snapshot(doc: Any,
     for key, value in doc["gauges"].items():
         if not isinstance(value, (int, float)) or isinstance(value, bool):
             problems.append(f"{where}: gauge {key!r} is not numeric")
+    rate = doc["gauges"].get("spec_acceptance_rate")
+    if isinstance(rate, (int, float)) and not 0.0 <= rate <= 1.0:
+        problems.append(
+            f"{where}: spec_acceptance_rate {rate} outside [0, 1]"
+        )
+    counters = doc["counters"]
+    if all(isinstance(counters.get(k), int)
+           for k in ("spec_accepted", "spec_drafted")):
+        if counters["spec_accepted"] > counters["spec_drafted"]:
+            problems.append(
+                f"{where}: spec_accepted {counters['spec_accepted']} > "
+                f"spec_drafted {counters['spec_drafted']}"
+            )
     for family, summary in doc["latency"].items():
         if family not in _SERVE_LATENCY_KEYS:
             problems.append(f"{where}: unknown latency family {family!r}")
@@ -479,6 +495,74 @@ def validate_bench_serve(block: Any, where: str = "serve") -> List[str]:
             arm, _BENCH_SERVE_SWEEP_REQUIRED, _BENCH_SERVE_SWEEP_OPTIONAL,
             f"{where}.rate_sweep[{i}]",
         )
+    return problems
+
+
+# The bench_serve.py speculative-decoding A/B block: the spec arm and
+# its non-spec baseline must both pin their recompile counters (the
+# zero-recompile steady state is the contract, not a best-effort), and
+# the acceptance sweep scans tokens/s across draft quality.
+_BENCH_SPEC_REQUIRED = {
+    "spec_k": int,
+    "tokens_per_sec": (int, float),            # spec arm, emitted
+    "baseline_tokens_per_sec": (int, float),   # non-spec decode arm
+    "vs_baseline": (int, float),               # the >= 1.5x headline
+    "acceptance_rate": (int, float),
+    "recompiles_steady_state": int,
+    "baseline_recompiles_steady_state": int,
+}
+_BENCH_SPEC_OPTIONAL = {
+    "draft_layers": int,
+    "target_layers": int,
+    "drafted": int,
+    "accepted": int,
+    "emitted": int,
+    "greedy_parity": bool,        # spec tokens == non-spec tokens
+    "requests": int,
+    "max_new_tokens": int,
+    "acceptance_sweep": list,     # per-noise arms
+}
+_BENCH_SPEC_SWEEP_REQUIRED = {
+    "noise": (int, float),        # identity-tail perturbation scale
+    "acceptance_rate": (int, float),
+    "tokens_per_sec": (int, float),
+    "vs_baseline": (int, float),
+}
+
+
+def validate_bench_spec_decode(block: Any,
+                               where: str = "spec_decode") -> List[str]:
+    """Validate the ``spec_decode`` block of a bench artifact (absent
+    on pre-speculation rounds)."""
+    problems = _check_fields(
+        block, _BENCH_SPEC_REQUIRED, _BENCH_SPEC_OPTIONAL, where
+    )
+    if problems:
+        return problems
+    if block["spec_k"] < 1:
+        problems.append(f"{where}: spec_k must be >= 1")
+    if not 0.0 <= block["acceptance_rate"] <= 1.0:
+        problems.append(
+            f"{where}: acceptance_rate {block['acceptance_rate']} "
+            "outside [0, 1]"
+        )
+    for key in ("recompiles_steady_state",
+                "baseline_recompiles_steady_state"):
+        if block[key] < 0:
+            problems.append(f"{where}: negative {key}")
+    for i, arm in enumerate(block.get("acceptance_sweep", [])):
+        arm_problems = _check_fields(
+            arm, _BENCH_SPEC_SWEEP_REQUIRED, {},
+            f"{where}.acceptance_sweep[{i}]",
+        )
+        # Per-arm guard: an earlier arm's failure must not suppress
+        # THIS arm's range check.
+        if not arm_problems and not 0.0 <= arm["acceptance_rate"] <= 1.0:
+            arm_problems.append(
+                f"{where}.acceptance_sweep[{i}]: acceptance_rate "
+                "outside [0, 1]"
+            )
+        problems += arm_problems
     return problems
 
 

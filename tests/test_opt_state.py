@@ -251,6 +251,9 @@ def test_quantized_state_stream_roundtrip_bitwise():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow  # tier-1 budget (round-16 audit: >10s): the stream
+# round-trip above pins int8 byte-exactness and the N→M reshard fits
+# pin resume; this full restart fit runs outside the sweep
 def test_int8_restart_resume_bitwise(tmp_path):
     """Same-policy resume through a restart checkpoint is bit-exact:
     the int8 payload round-trips as raw bytes, so the resumed fit's
@@ -516,6 +519,9 @@ def test_shard_update_layout():
         for s in jax.tree_util.tree_leaves(sh0.opt_state))
 
 
+@pytest.mark.slow  # tier-1 budget (round-16 audit: >10s):
+# test_shard_update_layout pins the sharding layout fast; the full
+# 8-device bitwise fit parity runs outside the sweep
 def test_update_sharding_fit_parity_cpu_mesh(tmp_path):
     """The arm's acceptance pin: a fit with the sharded update matches
     the replicated-update formulation bitwise on the 8-device CPU mesh

@@ -35,6 +35,7 @@ from ray_lightning_tpu.telemetry.schema import (  # noqa: E402
     validate_bench_opt_state,
     validate_bench_residual_policy,
     validate_bench_serve,
+    validate_bench_spec_decode,
     validate_bench_telemetry,
     validate_chrome_trace,
     validate_flight_bundle,
@@ -371,6 +372,92 @@ def _self_test_serve() -> list:
         problems.append(
             "self-test serve reply: validator accepted an unknown type"
         )
+    problems += _self_test_spec_decode(stats)
+    return problems
+
+
+def _self_test_spec_decode(stats) -> list:
+    """Speculative-decoding producers vs their schema: a snapshot with
+    the engine's real spec counter/gauge names, the per-request wire
+    fields, and the bench spec_decode block — plus negatives (an
+    acceptance rate outside [0, 1] and accepted > drafted must FAIL)."""
+    stats.bump("spec_drafted", 12)
+    stats.bump("spec_accepted", 9)
+    stats.bump("spec_emitted", 12)
+    stats.bump("spec_ticks", 3)
+    stats.set_gauges(spec_acceptance_rate=0.75,
+                     spec_goodput_tokens_per_sec=40.0)
+    problems = validate_serve_snapshot(
+        stats.snapshot(), "self-test spec snapshot"
+    )
+    problems += validate_serve_request(
+        {
+            "type": "serve_request", "rid": "abc", "prompt": [1, 2],
+            "max_new_tokens": 4, "temperature": 0.7, "top_k": 8,
+            "spec": 4, "eos_token_id": None, "deadline_s": None,
+            "reply": ["127.0.0.1", 12345],
+        },
+        "self-test spec request",
+    )
+    problems += validate_bench_spec_decode(
+        {
+            "spec_k": 4, "draft_layers": 2, "target_layers": 8,
+            "tokens_per_sec": 900.0, "baseline_tokens_per_sec": 400.0,
+            "vs_baseline": 2.25, "acceptance_rate": 0.92,
+            "recompiles_steady_state": 0,
+            "baseline_recompiles_steady_state": 0,
+            "drafted": 480, "accepted": 441, "emitted": 560,
+            "greedy_parity": True, "requests": 32, "max_new_tokens": 16,
+            "acceptance_sweep": [{
+                "noise": 0.02, "acceptance_rate": 0.71,
+                "tokens_per_sec": 700.0, "vs_baseline": 1.75,
+            }],
+        },
+        "self-test bench spec_decode",
+    )
+    if not validate_bench_spec_decode({"spec_k": 4}):
+        problems.append(
+            "self-test spec_decode: validator accepted a block missing "
+            "the A/B arms"
+        )
+    if not validate_bench_spec_decode(
+        {
+            "spec_k": 4, "tokens_per_sec": 1.0,
+            "baseline_tokens_per_sec": 1.0, "vs_baseline": 1.0,
+            "acceptance_rate": 1.5, "recompiles_steady_state": 0,
+            "baseline_recompiles_steady_state": 0,
+        }
+    ):
+        problems.append(
+            "self-test spec_decode: validator accepted acceptance > 1"
+        )
+    broken_sweep = validate_bench_spec_decode(
+        {
+            "spec_k": 4, "tokens_per_sec": 1.0,
+            "baseline_tokens_per_sec": 1.0, "vs_baseline": 1.0,
+            "acceptance_rate": 0.9, "recompiles_steady_state": 0,
+            "baseline_recompiles_steady_state": 0,
+            "acceptance_sweep": [
+                {"noise": 0.01},  # arm 0 broken (missing fields)
+                {"noise": 0.02, "acceptance_rate": 1.5,
+                 "tokens_per_sec": 1.0, "vs_baseline": 1.0},
+            ],
+        }
+    )
+    if not any("acceptance_sweep[1]" in p for p in broken_sweep):
+        problems.append(
+            "self-test spec_decode: arm-0 failure suppressed arm-1's "
+            "range check"
+        )
+    bad = stats.snapshot()
+    bad["counters"]["spec_accepted"] = (
+        bad["counters"]["spec_drafted"] + 1
+    )
+    if not validate_serve_snapshot(bad):
+        problems.append(
+            "self-test spec snapshot: validator accepted "
+            "accepted > drafted"
+        )
     return problems
 
 
@@ -442,6 +529,11 @@ def scan_bench_files() -> list:
         serve = doc.get("serve")
         if serve is not None:  # pre-serving rounds lack it
             problems += validate_bench_serve(serve, f"{name}:serve")
+        spec = doc.get("spec_decode") or (serve or {}).get("spec_decode")
+        if spec is not None:  # pre-speculation rounds lack it
+            problems += validate_bench_spec_decode(
+                spec, f"{name}:spec_decode"
+            )
         mpmd = doc.get("mpmd")
         if mpmd is not None:  # pre-MPMD rounds lack it
             problems += validate_bench_mpmd(mpmd, f"{name}:mpmd")

@@ -65,4 +65,10 @@ RLT_OPT_STATE_DTYPE=int8 RLT_UPDATE_SHARDING=on \
 RLT_REMAT_POLICY=bf16-resid timeout 1800 python bench.py \
   2>&1 | tee "tools/hw_logs/${stamp}_bench_hbm_diet.log"
 
+log "serve A/B: speculative decoding K sweep (spec_decode block)"
+for k in 2 4 8; do
+  RLT_SPEC_K=$k timeout 1800 python bench_serve.py \
+    2>&1 | tee "tools/hw_logs/${stamp}_bench_serve_spec_k${k}.log"
+done
+
 log "done — logs in tools/hw_logs/${stamp}_*.log"

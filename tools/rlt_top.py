@@ -110,13 +110,18 @@ def _render_serve(serve: Dict[str, Any]) -> list:
     queue/slot/block occupancy and the SLO latency percentiles."""
     g = serve.get("gauges", {})
     c = serve.get("counters", {})
+    spec = ""
+    if c.get("spec_drafted"):
+        # Speculative engines: draft-acceptance is the tokens/s lever.
+        spec = (f"  spec acc {g.get('spec_acceptance_rate', 0):.2f}"
+                f" ({c.get('spec_accepted', 0)}/{c.get('spec_drafted', 0)})")
     lines = [
         "",
         f"serve: queue {g.get('queue_depth', 0):.0f}  slots "
         f"{g.get('slots_active', 0):.0f}/{g.get('num_slots', 0):.0f}  "
         f"blocks {g.get('blocks_live', 0):.0f}/{g.get('num_blocks', 0):.0f}"
         f"  done {c.get('completed', 0)}  rej {c.get('rejected', 0)}"
-        f"  preempt {c.get('preempted', 0)}",
+        f"  preempt {c.get('preempted', 0)}" + spec,
     ]
     latency = serve.get("latency", {})
     if latency:
