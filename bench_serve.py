@@ -40,6 +40,21 @@ draft-equal logits — so the headline arm measures the program
 machinery at a known ~1.0 acceptance rate, and the acceptance sweep
 perturbs the tail to scan realistic acceptance regimes without
 training anything.
+
+A fifth phase benches **disaggregated serving** (the ``serve_disagg``
+block, ``validate_bench_serve_disagg``): a real actor fleet —
+``RLT_DISAGG_REPLICAS`` (default 2) decode replicas +
+``RLT_DISAGG_PREFILL`` (default 1) prefill workers, each its own
+process — behind the load-aware router, driven open-loop at a
+fraction of measured monolith capacity, reporting throughput vs the
+monolith (process contention makes this an honest <1x on the 2-core
+CPU container; the TPU arm in tools/hw_session.sh is where
+disaggregation pays) and pinning per-replica steady-state recompiles
+at ZERO from the replicas' beat counters.  The **chaos arm** then
+SIGKILLs the busiest decode replica mid-sweep under Poisson load:
+zero lost requests (failover re-submission onto survivors), with
+failover detection latency and client-deduped re-emission counts in
+the block.  ``RLT_DISAGG_REPLICAS=0`` skips the phase.
 """
 
 from __future__ import annotations
@@ -60,7 +75,8 @@ from ray_lightning_tpu.serve.engine import ServeConfig, ServeEngine
 from ray_lightning_tpu.serve.metrics import ServeStats
 from ray_lightning_tpu.telemetry import compile_event_count
 from ray_lightning_tpu.telemetry.schema import (
-    validate_bench_serve, validate_bench_spec_decode,
+    validate_bench_serve, validate_bench_serve_disagg,
+    validate_bench_spec_decode,
 )
 
 PROMPT_LEN = 16
@@ -280,6 +296,178 @@ def _spec_block(on_tpu: bool) -> dict:
     }
 
 
+DISAGG_REQUESTS = 24
+DISAGG_CHAOS_REQUESTS = 24
+
+
+def _fleet_recompiles(router, ids, timeout=15.0) -> dict:
+    """Per-replica compile-event counters from FRESH beats: wait out at
+    least one beat interval so the reading postdates the work being
+    measured, then require a recent beat from every queried replica."""
+    time.sleep(0.6)  # > 2 beat intervals at the fleet default 0.25s
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        snap = router.snapshot()
+        entries = {r["id"]: r for r in snap["replicas"]
+                   if r["id"] in ids and r.get("alive")}
+        if len(entries) == len(ids) and all(
+            "recompiles" in e
+            and e.get("last_beat_age_s") is not None
+            and e["last_beat_age_s"] < 1.0
+            for e in entries.values()
+        ):
+            return {rid: e["recompiles"] for rid, e in entries.items()}
+        time.sleep(0.1)
+    snap = router.snapshot()
+    return {r["id"]: r.get("recompiles", 0) for r in snap["replicas"]
+            if r["id"] in ids}
+
+
+def _disagg_poisson(client, prompts, rate_rps, seed,
+                    kill_at=None, kill_fn=None):
+    """Open-loop Poisson submission through the router; returns
+    (rids, killed_at_index).  ``kill_fn`` fires once after the
+    ``kill_at``-th submission — the mid-sweep chaos trigger."""
+    import random
+
+    rng = random.Random(seed)
+    rids = []
+    t0 = time.perf_counter()
+    next_t = 0.0
+    killed = None
+    for i, p in enumerate(prompts):
+        next_t += rng.expovariate(rate_rps)
+        lag = t0 + next_t - time.perf_counter()
+        if lag > 0:
+            time.sleep(lag)
+        rids.append(client.submit(p, MAX_NEW))
+        if kill_fn is not None and killed is None and i + 1 >= kill_at:
+            kill_fn()
+            killed = i
+    return rids, killed
+
+
+def _disagg_block(module, params, serve_cfg, monolith_rps,
+                  cfg) -> dict:
+    """Phase 5: the disaggregated fleet A/B + kill-a-replica chaos."""
+    from ray_lightning_tpu.serve.client import ServeClient
+    from ray_lightning_tpu.serve.dist import launch_actor_fleet
+
+    n_replicas = int(os.environ.get("RLT_DISAGG_REPLICAS", "2") or 2)
+    n_prefill = int(os.environ.get("RLT_DISAGG_PREFILL", "1") or 1)
+    fleet = launch_actor_fleet(
+        module, params, serve_cfg, n_replicas=n_replicas,
+        n_prefill=n_prefill, lost_after_s=2.0,
+    )
+    client = ServeClient(fleet.queue_handle())
+    replica_ids = [r.id for r in fleet.replicas]
+    try:
+        # Warmup: every replica compiles its bucket prefill/import +
+        # decode programs (uniform prompt length = one bucket; spread
+        # enough requests that least-loaded placement hits them all).
+        warm = [client.submit(p, MAX_NEW)
+                for p in _prompts(4 * n_replicas, cfg.vocab_size,
+                                  seed=100)]
+        for rid in warm:
+            client.result(rid, timeout=600)
+        base_rec = _fleet_recompiles(fleet.router, replica_ids)
+
+        # Headline: open loop at ~0.9x monolith capacity.
+        rate = max(0.9 * monolith_rps, 0.5)
+        t0 = time.perf_counter()
+        rids, _ = _disagg_poisson(
+            client, _prompts(DISAGG_REQUESTS, cfg.vocab_size, seed=201),
+            rate, seed=21,
+        )
+        completed = 0
+        for rid in rids:
+            try:
+                client.result(rid, timeout=600)
+                completed += 1
+            except Exception:  # noqa: BLE001 - counted below
+                pass
+        wall = time.perf_counter() - t0
+        after_rec = _fleet_recompiles(fleet.router, replica_ids)
+        recompiles = sum(after_rec.get(r, 0) - base_rec.get(r, 0)
+                         for r in replica_ids)
+        rps = completed / wall
+
+        # Chaos arm: SIGKILL the busiest replica mid-sweep.
+        client.re_emitted_tokens = 0
+        survivor_base = dict(after_rec)
+
+        def kill_busiest():
+            with fleet.router._lock:
+                loads = {r: 0 for r in replica_ids}
+                for t in fleet.router._inflight.values():
+                    if t.replica in loads:
+                        loads[t.replica] += 1
+            victim_id = max(loads, key=lambda r: loads[r])
+            next(r for r in fleet.replicas
+                 if r.id == victim_id).kill(hard=True)
+            kill_busiest.victim = victim_id
+
+        t0 = time.perf_counter()
+        rids, _ = _disagg_poisson(
+            client, _prompts(DISAGG_CHAOS_REQUESTS, cfg.vocab_size,
+                             seed=202),
+            rate, seed=22,
+            kill_at=DISAGG_CHAOS_REQUESTS // 3, kill_fn=kill_busiest,
+        )
+        chaos_completed, lost = 0, 0
+        for rid in rids:
+            try:
+                client.result(rid, timeout=600)
+                chaos_completed += 1
+            except Exception:  # noqa: BLE001 - every non-completion is
+                # a LOST request; the acceptance bar is zero
+                lost += 1
+        victim = getattr(kill_busiest, "victim", replica_ids[0])
+        survivors = [r for r in replica_ids if r != victim]
+        surv_rec = _fleet_recompiles(fleet.router, survivors)
+        survivor_recompiles = sum(
+            surv_rec.get(r, 0) - survivor_base.get(r, 0)
+            for r in survivors
+        )
+        counters = fleet.router.counters
+        detect = fleet.router.last_failover_detect_s
+        with fleet.router._lock:
+            kv_imports = sum(
+                (m.snapshot or {}).get("counters", {}).get(
+                    "kv_imports", 0)
+                for m in fleet.router._replicas.values()
+            )
+        return {
+            "replicas": n_replicas,
+            "prefill_workers": n_prefill,
+            "requests": DISAGG_REQUESTS,
+            "requests_per_sec": round(rps, 3),
+            "monolith_requests_per_sec": round(monolith_rps, 3),
+            "vs_monolith": round(rps / monolith_rps, 3),
+            "kv_imports": int(kv_imports),
+            "prefill_dispatches": counters["prefill_dispatches"],
+            "recompiles_steady_state": int(recompiles),
+            "chaos": {
+                "killed_replica": victim,
+                "submitted": DISAGG_CHAOS_REQUESTS,
+                "completed": chaos_completed,
+                "lost_requests": lost,
+                "failed_over_requests":
+                    counters["failed_over_requests"],
+                "failover_detect_s": (
+                    None if detect is None else round(detect, 3)
+                ),
+                "re_emitted_tokens": client.re_emitted_tokens,
+                "survivor_recompiles_steady_state":
+                    int(survivor_recompiles),
+                "offered_rps": round(rate, 3),
+            },
+        }
+    finally:
+        client.close()
+        fleet.close()
+
+
 def main() -> None:
     on_tpu = _detect_backend() == "tpu"
     if on_tpu:
@@ -357,14 +545,28 @@ def main() -> None:
     # Phase 4: speculative-decoding A/B + acceptance sweep.
     spec_block = _spec_block(on_tpu)
 
+    # Phase 5: disaggregated fleet A/B + kill-a-replica chaos.
+    disagg_block = None
+    if int(os.environ.get("RLT_DISAGG_REPLICAS", "2") or 0) > 0:
+        disagg_block = _disagg_block(module, params, serve_cfg,
+                                     cont_rps, cfg)
+
     problems = validate_bench_serve(serve_block)
     problems += validate_bench_spec_decode(spec_block)
+    if disagg_block is not None:
+        problems += validate_bench_serve_disagg(disagg_block)
+        if disagg_block["chaos"]["lost_requests"]:
+            problems.append(
+                "serve_disagg.chaos: "
+                f"{disagg_block['chaos']['lost_requests']} request(s) "
+                "LOST across the replica kill — failover bar is zero"
+            )
     if problems:  # the gate that keeps this producer honest
         for p in problems:
             sys.stderr.write(f"bench_serve schema: {p}\n")
         raise SystemExit(1)
 
-    print(json.dumps({
+    out = {
         "metric": "serve_requests_per_sec"
         if on_tpu else "serve_requests_per_sec_cpu",
         "value": serve_block["requests_per_sec"],
@@ -374,7 +576,10 @@ def main() -> None:
         "requests": HEADLINE_REQUESTS,
         "serve": serve_block,
         "spec_decode": spec_block,
-    }))
+    }
+    if disagg_block is not None:
+        out["serve_disagg"] = disagg_block
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

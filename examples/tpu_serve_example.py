@@ -11,6 +11,11 @@ Run (CPU):
     # speculative decoding through an early-exit draft:
     JAX_PLATFORMS=cpu python examples/tpu_serve_example.py \
         --smoke-test --spec 4
+    # disaggregated fleet: 2 decode replicas fed by 1 prefill worker
+    # behind the load-aware router (docs/SERVING.md "Disaggregated
+    # serving"):
+    JAX_PLATFORMS=cpu python examples/tpu_serve_example.py \
+        --smoke-test --replicas 2 --prefill-workers 1
 """
 
 from __future__ import annotations
@@ -34,6 +39,15 @@ def main() -> None:
                         help="speculative decoding: draft K tokens per "
                         "tick through a 1-layer early-exit draft of the "
                         "trained model (0 = off)")
+    parser.add_argument("--replicas", type=int, default=1, metavar="N",
+                        help="decode replicas; N > 1 (or any prefill "
+                        "workers) serves through the disaggregated "
+                        "router fleet instead of one engine")
+    parser.add_argument("--prefill-workers", type=int, default=0,
+                        metavar="M",
+                        help="dedicated prefill workers shipping KV "
+                        "handoffs to the decode replicas (0 = replicas "
+                        "prefill locally)")
     parser.add_argument("--smoke-test", action="store_true")
     args = parser.parse_args()
     if args.smoke_test:
@@ -64,14 +78,30 @@ def main() -> None:
 
         draft, draft_params = early_exit_draft(module, trainer.params, 1)
         draft_kw = dict(draft_module=draft, draft_params=draft_params)
-    engine = ServeEngine(
-        module, trainer.params,
-        ServeConfig(num_slots=args.num_slots, block_size=16,
-                    spec_k=args.spec),
-        telemetry_dir="rlt_logs/serve_example/telemetry",
-        **draft_kw,
-    ).start()
-    client = ServeClient(engine.queue_handle())
+    serve_cfg = ServeConfig(num_slots=args.num_slots, block_size=16,
+                            spec_k=args.spec)
+    engine = fleet = None
+    if args.replicas > 1 or args.prefill_workers > 0:
+        # Disaggregated: N engines (+ M prefill workers) behind the
+        # load-aware router — the client code below is UNCHANGED, the
+        # router speaks the engine's wire dialect.
+        from ray_lightning_tpu.serve.dist import launch_inproc_fleet
+
+        fleet = launch_inproc_fleet(
+            module, trainer.params, serve_cfg,
+            n_replicas=args.replicas, n_prefill=args.prefill_workers,
+            telemetry_dir="rlt_logs/serve_example/telemetry",
+            **draft_kw,
+        )
+        handle = fleet.queue_handle()
+    else:
+        engine = ServeEngine(
+            module, trainer.params, serve_cfg,
+            telemetry_dir="rlt_logs/serve_example/telemetry",
+            **draft_kw,
+        ).start()
+        handle = engine.queue_handle()
+    client = ServeClient(handle)
     try:
         rng = np.random.default_rng(0)
         rids = [
@@ -88,22 +118,44 @@ def main() -> None:
         for rid in rids:
             client.result(rid, timeout=120)
 
-        snap = engine.snapshot()
-        lat = snap["latency"]
-        print(f"completed={snap['counters']['completed']} "
-              f"ttft_p50={lat['ttft']['p50_ms']:.1f}ms "
-              f"token_p50={lat['token']['p50_ms']:.1f}ms")
-        if args.spec > 0:
-            print(f"spec: acceptance="
-                  f"{snap['gauges']['spec_acceptance_rate']:.2f} "
-                  f"drafted={snap['counters']['spec_drafted']} "
-                  f"emitted={snap['counters']['spec_emitted']}")
-        assert snap["counters"]["completed"] == args.requests
+        if fleet is not None:
+            # Completions reach the router on the next beat; give the
+            # feed a moment so the printed count matches.
+            import time as _time
+
+            deadline = _time.monotonic() + 5
+            while (fleet.router.snapshot()["counters"]["completed"]
+                   < args.requests and _time.monotonic() < deadline):
+                _time.sleep(0.05)
+            rsnap = fleet.router.snapshot()
+            done = rsnap["counters"]["completed"]
+            print(f"router: completed={done} over "
+                  f"{len(rsnap['replicas'])} replica(s), "
+                  f"prefill_dispatches="
+                  f"{rsnap['counters']['prefill_dispatches']}")
+            per = {e["id"]: e.get("slots_active") for e
+                   in rsnap["replicas"]}
+            print(f"per-replica slots: {per}")
+        else:
+            snap = engine.snapshot()
+            lat = snap["latency"]
+            print(f"completed={snap['counters']['completed']} "
+                  f"ttft_p50={lat['ttft']['p50_ms']:.1f}ms "
+                  f"token_p50={lat['token']['p50_ms']:.1f}ms")
+            if args.spec > 0:
+                print(f"spec: acceptance="
+                      f"{snap['gauges']['spec_acceptance_rate']:.2f} "
+                      f"drafted={snap['counters']['spec_drafted']} "
+                      f"emitted={snap['counters']['spec_emitted']}")
+            assert snap["counters"]["completed"] == args.requests
         print("OK — watch live with: "
               "python tools/rlt_top.py rlt_logs/serve_example/telemetry")
     finally:
         client.close()
-        engine.stop()
+        if fleet is not None:
+            fleet.close()
+        else:
+            engine.stop()
 
 
 main()

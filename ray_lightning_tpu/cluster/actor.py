@@ -627,14 +627,18 @@ class ProcessActor:
         except OSError:
             pass
         # Reclaim tmpfs the dead child may have leaked: a stage worker
-        # killed mid-transfer leaves rlt-seg segments whose owner pid is
-        # gone — sweeping at every kill keeps /dev/shm bounded even for
-        # crash-looping fleets (the next SegmentStore() would sweep too,
-        # but only if one is ever created again).
+        # killed mid-transfer leaves rlt-seg segments (and a serve
+        # prefill worker killed mid-handoff leaves rlt-kv ones) whose
+        # owner pid is gone — sweeping every family at every kill keeps
+        # /dev/shm bounded even for crash-looping fleets (the next
+        # SegmentStore() would sweep too, but only its own prefix, and
+        # only if one is ever created again).
         try:
-            from ray_lightning_tpu.cluster.shm import sweep_stale_segments
+            from ray_lightning_tpu.cluster.shm import (
+                ALL_PREFIXES, sweep_stale_segments,
+            )
 
-            sweep_stale_segments()
+            sweep_stale_segments(ALL_PREFIXES)
         except Exception:  # noqa: BLE001 - janitorial, never raises out
             pass
 

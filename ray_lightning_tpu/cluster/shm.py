@@ -22,7 +22,8 @@ from typing import List
 
 from ray_lightning_tpu import native
 
-__all__ = ["SegmentStore", "segment_dir", "sweep_stale_segments"]
+__all__ = ["SegmentStore", "segment_dir", "sweep_stale_segments",
+           "ALL_PREFIXES"]
 
 _NAME_RE = re.compile(r"^(?P<prefix>.+)-(?P<pid>\d+)-[0-9a-f]{32}$")
 
@@ -37,10 +38,19 @@ def _pid_alive(pid: int) -> bool:
     return True
 
 
-def sweep_stale_segments(prefix: str = "rlt-seg") -> int:
+# Every segment family the queue plane creates: MPMD activation
+# transfers ("rlt-seg") and serve-plane KV handoffs ("rlt-kv").  Teardown
+# sweeps that don't know which producer died pass the tuple.
+ALL_PREFIXES = ("rlt-seg", "rlt-kv")
+
+
+def sweep_stale_segments(prefix="rlt-seg") -> int:
     """Unlink segments whose owner pid is gone (tmpfs is RAM: a SIGKILL'd
     driver must not leak its spilled payloads until reboot).  Runs
-    opportunistically at store creation — the plasma-janitor analogue."""
+    opportunistically at store creation — the plasma-janitor analogue.
+    ``prefix`` is one family name or a tuple of them
+    (:data:`ALL_PREFIXES` for a whole-plane sweep)."""
+    prefixes = (prefix,) if isinstance(prefix, str) else tuple(prefix)
     removed = 0
     try:
         entries = os.listdir(segment_dir())
@@ -48,7 +58,7 @@ def sweep_stale_segments(prefix: str = "rlt-seg") -> int:
         return 0
     for entry in entries:
         m = _NAME_RE.match(entry)
-        if not m or m.group("prefix") != prefix:
+        if not m or m.group("prefix") not in prefixes:
             continue
         if _pid_alive(int(m.group("pid"))):
             continue

@@ -45,6 +45,7 @@ __all__ = [
     "QueueChannel",
     "encode_tree",
     "decode_tree",
+    "resolve_payload",
     "SHM_THRESHOLD_BYTES",
 ]
 
@@ -64,6 +65,33 @@ def encode_tree(tree: Any) -> bytes:
 
 def decode_tree(payload: bytes) -> Any:
     return rpc.loads(payload)
+
+
+def resolve_payload(item: Dict[str, Any], unlink: bool = True) -> bytes:
+    """Payload bytes of a ``data``/``shm`` wire item (the one-of pair
+    every queue-plane tensor frame uses: MPMD activation transfers and
+    the serve plane's KV handoffs alike).
+
+    Segment lifetime is write-once/read-once, CONSUMER-owned: an
+    ``shm`` payload is read and then unlinked here, so tmpfs is
+    reclaimed the moment the bytes are out.  The producer's teardown
+    sweep (``sweep_stale_segments``) is the crash backstop for frames
+    that never reach a consumer — a producer killed ``-9`` mid-handoff
+    leaves segments whose owner pid is gone, and the next sweep (actor
+    kill, engine close, router failover) collects them.
+    """
+    shm_path = item.get("shm")
+    if shm_path is None:
+        return item["data"]
+    from ray_lightning_tpu.cluster.shm import SegmentStore
+
+    payload = SegmentStore.get(shm_path)
+    if unlink:
+        try:
+            os.unlink(shm_path)
+        except OSError:
+            pass
+    return payload
 
 
 class Mailbox:
@@ -157,21 +185,7 @@ class StageInbox:
             item["kind"], int(item["step"]), int(item["mb"]),
             int(item.get("chunk", 0)),
         )
-        shm_path = item.get("shm")
-        if shm_path is not None:
-            from ray_lightning_tpu.cluster.shm import SegmentStore
-
-            payload = SegmentStore.get(shm_path)
-            # Write-once/read-once: the consumer reclaims tmpfs as soon
-            # as the bytes are out (the producer's teardown sweep is the
-            # crash backstop).
-            try:
-                os.unlink(shm_path)
-            except OSError:
-                pass
-        else:
-            payload = item["data"]
-        self.mailbox.deliver(key, decode_tree(payload))
+        self.mailbox.deliver(key, decode_tree(resolve_payload(item)))
 
     def close(self) -> None:
         self._closed.set()

@@ -23,7 +23,11 @@ compiled ONCE and re-dispatched forever:
   verifies them in ONE fixed-width dispatch (``spec_k``/``spec=``
   knobs; lossless for greedy, position-keyed sampling elsewhere);
 * :mod:`.metrics` — the jax-free SLO stats engine the bench and the
-  exporters share.
+  exporters share;
+* :mod:`.dist` — **disaggregated multi-replica serving**: prefill
+  workers shipping paged-KV blocks over the queue plane to N decode
+  replicas behind a load-aware router with heartbeat failover
+  (imported lazily — ``from ray_lightning_tpu.serve.dist import ...``).
 
 See ``docs/SERVING.md`` for architecture, knobs and the bench
 methodology (``bench_serve.py``).

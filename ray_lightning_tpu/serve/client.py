@@ -65,6 +65,10 @@ class ServeClient:
         self._pending: Dict[str, _Pending] = {}
         self._lock = threading.Lock()
         self._closed = threading.Event()
+        # Tokens whose index had already streamed (preemption or router
+        # failover re-emissions, deduped below) — the disagg bench's
+        # re-emission accounting.
+        self.re_emitted_tokens = 0
         self._reader = threading.Thread(
             target=self._read_loop, name="rlt-serve-client", daemon=True
         )
@@ -174,6 +178,7 @@ class ServeClient:
                     pend.tokens.append(tok)
                 elif idx < len(pend.tokens):
                     pend.tokens[idx] = tok  # preemption re-emission
+                    self.re_emitted_tokens += 1
                 pend.stream.put(("token", (idx, tok)))
             elif kind == "serve_done":
                 pend.status = item.get("status")

@@ -1,0 +1,215 @@
+"""KV-handoff wire format: prefill worker → decode replica frames.
+
+The disaggregated serving plane's tensor frames reuse the queue-plane
+conventions the MPMD transfer lane established (``mpmd/transfer.py``):
+every frame is a small typed dict whose bulk payload rides EITHER
+inline (``data`` bytes, chunk-sent by ``cluster/queue.py`` past 8MB —
+the cross-host DCN form) OR as a tmpfs segment path (``shm`` — the
+same-host zero-copy form, ``SegmentStore`` prefix ``rlt-kv``).
+Consumers resolve either through ``transfer.resolve_payload`` (read
+once, unlink once).
+
+Frame families (envelopes schema-pinned in ``telemetry/schema.py``;
+the tensor payload itself is an ``encode_tree`` blob, deliberately
+outside the schema like MPMD activation bytes):
+
+* ``serve_prefill_dispatch`` — router → prefill worker: the full
+  client request plus the target decode replica's inbox address;
+* ``serve_kv_handoff`` — prefill worker → decode replica: the request
+  plus its exported per-layer KV blocks and final-position logits
+  (``validate_serve_kv_handoff``);
+* ``serve_replica_hello`` / ``serve_replica_beat`` — member → router:
+  registration (inbox address + capabilities) and the periodic
+  liveness/occupancy/completion feed the router's failover and
+  placement decisions run on.
+
+Everything here is jax-free given payload bytes, so the schema gate
+(``tools/check_telemetry_schema.py``) drives the REAL producers.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+__all__ = [
+    "KV_SEGMENT_PREFIX",
+    "CachedSender",
+    "request_fields",
+    "make_dispatch_item",
+    "make_handoff_item",
+    "make_hello_item",
+    "make_beat_item",
+    "encode_kv_payload",
+    "decode_kv_payload",
+]
+
+
+class CachedSender:
+    """One persistent ``QueueHandle`` per destination address, evicted
+    on send failure so the next attempt reconnects fresh — the send
+    helper the router (dispatch/replies) and the prefill workers
+    (handoffs) share, so dead-peer handling can only evolve in ONE
+    place."""
+
+    def __init__(self):
+        self._handles: Dict[Tuple[str, int], Any] = {}
+
+    def put(self, addr, item: Dict[str, Any]) -> None:
+        from ray_lightning_tpu.cluster.queue import QueueHandle
+
+        addr = (addr[0], int(addr[1]))
+        handle = self._handles.get(addr)
+        if handle is None:
+            handle = QueueHandle(addr[0], addr[1])
+            self._handles[addr] = handle
+        try:
+            handle.put(item)
+        except BaseException:
+            self._handles.pop(addr, None)
+            raise
+
+    def close(self) -> None:
+        for handle in self._handles.values():
+            handle.close()
+        self._handles.clear()
+
+# Serve-plane handoff segments get their own family so teardown sweeps
+# (engine close, router failover, actor kill) can collect dead prefill
+# handoffs without touching a co-resident MPMD fit's rlt-seg frames.
+KV_SEGMENT_PREFIX = "rlt-kv"
+
+
+def request_fields(
+    rid: str,
+    prompt: Sequence[int],
+    max_new_tokens: int,
+    *,
+    reply: Sequence,
+    sample_seed: int,
+    temperature: float = 0.0,
+    eos_token_id: Optional[int] = None,
+    top_k: Optional[int] = None,
+    spec: Optional[int] = None,
+    deadline_s: Optional[float] = None,
+) -> Dict[str, Any]:
+    """The canonical request dict that rides inside dispatch/handoff
+    frames (a ``serve_request`` body with the router's fleet-wide
+    ``sample_seed`` attached)."""
+    return {
+        "type": "serve_request",
+        "rid": str(rid),
+        "prompt": [int(t) for t in prompt],
+        "max_new_tokens": int(max_new_tokens),
+        "temperature": float(temperature),
+        "eos_token_id": eos_token_id,
+        "top_k": None if top_k is None else int(top_k),
+        "spec": None if spec is None else int(spec),
+        "deadline_s": deadline_s,
+        "sample_seed": int(sample_seed),
+        "reply": list(reply),
+    }
+
+
+def make_dispatch_item(req: Dict[str, Any], kv_to: Tuple[str, int],
+                       same_host: bool = False) -> Dict[str, Any]:
+    """Router → prefill worker: run ``req``'s prompt and hand the KV
+    off to the decode replica inbox at ``kv_to``.  ``same_host`` gates
+    the tmpfs-segment payload form — the router computes it from the
+    worker's and replica's advertised hosts; the default is the
+    conservative inline-bytes form, which works anywhere (a tmpfs path
+    shipped across hosts would fail every large handoff)."""
+    return {
+        "type": "serve_prefill_dispatch",
+        "rid": req["rid"],
+        "req": dict(req),
+        "kv_to": [kv_to[0], int(kv_to[1])],
+        "same_host": bool(same_host),
+    }
+
+
+def make_handoff_item(
+    req: Dict[str, Any],
+    bucket: int,
+    *,
+    data: Optional[bytes] = None,
+    shm: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Prefill worker → decode replica: the prefilled request.  Exactly
+    one of ``data``/``shm`` carries the ``encode_kv_payload`` blob."""
+    if (data is None) == (shm is None):
+        raise ValueError("exactly one of data/shm payload required")
+    item: Dict[str, Any] = {
+        "type": "serve_kv_handoff",
+        "rid": req["rid"],
+        "bucket": int(bucket),
+        "prompt_len": len(req["prompt"]),
+        "req": dict(req),
+    }
+    if data is not None:
+        item["data"] = data
+    else:
+        item["shm"] = shm
+    return item
+
+
+def make_hello_item(role: str, member_id: str, inbox: Tuple[str, int],
+                    **caps: Any) -> Dict[str, Any]:
+    """Member registration: the router learns the inbox address and the
+    capabilities placement runs on (``num_slots``, ``max_queue``,
+    ``spec_k``, ``max_prompt_len``)."""
+    return {
+        "type": "serve_replica_hello",
+        "role": role,
+        "id": str(member_id),
+        "inbox": [inbox[0], int(inbox[1])],
+        **caps,
+    }
+
+
+def make_beat_item(
+    role: str,
+    member_id: str,
+    *,
+    done: Sequence[Tuple[str, str]] = (),
+    failed: Sequence[Tuple[str, str]] = (),
+    snapshot: Optional[Dict[str, Any]] = None,
+    recompiles: Optional[int] = None,
+    closing: bool = False,
+) -> Dict[str, Any]:
+    """Periodic member liveness + completion feed.  ``done`` carries
+    terminal ``(rid, status)`` pairs since the last beat (the router's
+    in-flight pruning signal); ``failed`` carries ``(rid, error)``
+    pairs a prefill worker could not hand off (the router re-routes
+    them)."""
+    item: Dict[str, Any] = {
+        "type": "serve_replica_beat",
+        "role": role,
+        "id": str(member_id),
+        "ts": time.time(),
+        "done": [[str(r), str(s)] for r, s in done],
+        "failed": [[str(r), str(e)] for r, e in failed],
+    }
+    if snapshot is not None:
+        item["snapshot"] = snapshot
+    if recompiles is not None:
+        item["recompiles"] = int(recompiles)
+    if closing:
+        item["closing"] = True
+    return item
+
+
+def encode_kv_payload(kv: Dict[str, Any], logits: Any) -> bytes:
+    """Serialize a prefill's exported blocks + final-position logits
+    (the handoff frame's bulk payload)."""
+    from ray_lightning_tpu.mpmd.transfer import encode_tree
+
+    return encode_tree({"kv": kv, "logits": logits})
+
+
+def decode_kv_payload(item: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of :func:`encode_kv_payload` over a handoff frame
+    (resolves data/shm; shm segments are read once and unlinked)."""
+    from ray_lightning_tpu.mpmd.transfer import decode_tree, resolve_payload
+
+    return decode_tree(resolve_payload(item))

@@ -1,0 +1,320 @@
+"""Prefill workers: dedicated prompt capacity for the disaggregated
+serving plane.
+
+Long prompts are the serving plane's head-of-line blocker: a monolith
+engine interleaves bucketed prefill dispatches with the fixed-width
+decode tick, so every admission stalls every in-flight token stream
+for one trunk forward.  A prefill worker moves that work onto its OWN
+device (its own mesh/params): it runs the SAME ``paged_prefill``
+program the engine would, exports the resulting per-layer KV blocks to
+host (``PagedKVCache.export_blocks``), and ships them — plus the
+final-position logits — to the chosen decode replica's inbox as a
+``serve_kv_handoff`` frame.  The replica scatters them into its own
+free blocks and goes straight to decode: decode ticks never pay for
+prompts again.
+
+Transport mirrors the MPMD lane: same-host payloads ride
+``SegmentStore`` tmpfs segments (prefix ``rlt-kv``; the consuming
+replica unlinks on read), cross-host payloads ride inline bytes
+through the chunk-sending ``QueueHandle``.  Unconsumed segments (a
+replica died between handoff and read) are TTL-pruned here, swept by
+pid at every teardown (engine close, router failover, actor kill).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Tuple
+
+from ray_lightning_tpu.serve.dist.handoff import (
+    KV_SEGMENT_PREFIX, CachedSender, encode_kv_payload, make_beat_item,
+    make_handoff_item, make_hello_item,
+)
+
+__all__ = ["PrefillRunner"]
+
+log = logging.getLogger(__name__)
+
+# Same-host handoffs above this ride tmpfs segments (the MPMD lane's
+# threshold — kernel socket buffers both ways vs one tmpfs write).
+_SHM_THRESHOLD_BYTES = 256 << 10
+# Unconsumed segments older than this are presumed addressed to a dead
+# replica and unlinked (consumed ones are already gone — the replica
+# unlinks on read, so this unlink is an ENOENT no-op for them).
+_SEGMENT_TTL_S = 120.0
+
+
+class PrefillRunner:
+    """One prefill worker: inbox + compiled prefill programs + the
+    handoff send path.  Transport/process-agnostic — drive it on a
+    thread in the driver process (tests, the example) or inside a
+    :class:`~ray_lightning_tpu.cluster.actor.ProcessActor`
+    (``replica.py::run_prefill_worker``)."""
+
+    def __init__(self, worker_id: str, module, params, serve_cfg,
+                 beat_handle, *, beat_s: float = 0.25,
+                 shm_threshold: int = _SHM_THRESHOLD_BYTES,
+                 segment_ttl_s: float = _SEGMENT_TTL_S):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_lightning_tpu.cluster.queue import DriverQueue
+        from ray_lightning_tpu.models.generate import _reject_unmerged_lora
+        from ray_lightning_tpu.serve.kv_cache import (
+            PagedKVCache, paged_prefill,
+        )
+        from ray_lightning_tpu.serve.scheduler import derive_geometry
+
+        self.worker_id = worker_id
+        self.module = module
+        self.cfg = module.config
+        self.serve_cfg = serve_cfg
+        _reject_unmerged_lora(params)
+        self.params = jax.tree.map(jnp.asarray, params)
+        self._c = module._compute_dtype()
+        self.max_model_len, self.buckets = derive_geometry(
+            serve_cfg, self.cfg
+        )
+        # The worker's pool only ever holds ONE in-flight prompt (the
+        # dispatch loop is sequential): the largest bucket's blocks
+        # plus the reserved trash block.
+        self.cache = PagedKVCache(
+            self.cfg, self.buckets[-1] // serve_cfg.block_size + 1,
+            serve_cfg.block_size, dtype=self._c,
+        )
+        self._pool = self.cache.init_pool()
+        cfg, c = self.cfg, self._c
+
+        def _prefill(params, pool, tokens, prompt_len, block_ids):
+            return paged_prefill(cfg, params, pool, tokens, prompt_len,
+                                 block_ids, compute_dtype=c)
+
+        # One executable per bucket length, like the engine's set.
+        self._prefill_fn = jax.jit(_prefill)
+        self._inbox = DriverQueue()
+        self._beat_handle = beat_handle
+        self.beat_s = beat_s
+        self._shm_threshold = shm_threshold
+        self._segment_ttl_s = segment_ttl_s
+        self._store = None           # SegmentStore, lazily created
+        self._live_segments: List[Tuple[str, float]] = []
+        self._out = CachedSender()
+        self._feed_lock = threading.Lock()
+        self._done: List[Tuple[str, str]] = []
+        self._failed: List[Tuple[str, str]] = []
+        self._last_beat = 0.0
+        self.prefills = 0
+        # Hard-kill simulation (InprocPrefill.kill(hard=True)): a dead
+        # process sends no final beat — suppress the closing flag so
+        # the router takes the death path, not the planned-drain one.
+        self.suppress_final = False
+
+    @property
+    def handle(self):
+        return self._inbox.handle
+
+    def hello(self) -> None:
+        """Register with the router: inbox address + the geometry caps
+        placement and validation run on."""
+        self._beat_handle.put(make_hello_item(
+            "prefill", self.worker_id,
+            (self._inbox.handle.host, self._inbox.handle.port),
+            max_prompt_len=self.buckets[-1],
+            max_model_len=self.max_model_len,
+            block_size=self.serve_cfg.block_size,
+        ))
+
+    # -- the loop ------------------------------------------------------------
+    def step(self, timeout: float = 0.1) -> bool:
+        """Process at most one dispatch; returns True when one was."""
+        import queue as _pyqueue
+
+        try:
+            item = self._inbox.get(timeout=timeout)
+        except _pyqueue.Empty:
+            return False
+        try:
+            self._process(item)
+        except Exception as e:  # noqa: BLE001 - a bad dispatch must
+            # surface as a failed rid the router re-routes, never kill
+            # the worker loop
+            rid = item.get("rid") if isinstance(item, dict) else None
+            log.warning("prefill %s: dispatch failed: %s",
+                        self.worker_id, e, exc_info=True)
+            if rid is not None:
+                with self._feed_lock:
+                    self._failed.append((str(rid), repr(e)))
+        return True
+
+    def run(self, stop=None) -> None:
+        """Serve dispatches until ``stop()`` goes true (a
+        ``threading.Event.is_set`` inproc, the fault plane's
+        ``drain_requested`` inside an actor).
+
+        Beats ride their OWN thread, so they keep flowing while the
+        work loop sits inside a multi-second prefill compile — the same
+        asymmetry the training monitor's heartbeat publisher relies on.
+        A beat-starved worker would be declared lost and its dispatches
+        redundantly re-routed on its very first compile."""
+        self.hello()
+        done = threading.Event()
+
+        def beat_loop():
+            while not done.is_set():
+                self._maybe_beat()
+                done.wait(min(self.beat_s, 0.1))
+
+        beater = threading.Thread(
+            target=beat_loop, name=f"rlt-prefill-beat-{self.worker_id}",
+            daemon=True,
+        )
+        beater.start()
+        try:
+            while not (stop() if stop is not None else False):
+                self.step(timeout=min(self.beat_s, 0.1))
+        finally:
+            done.set()
+            beater.join(timeout=10)
+            if not self.suppress_final:
+                try:
+                    # Final done/failed feed, flagged as a PLANNED
+                    # drain — without `closing` the router would read
+                    # this scale-down as a death: failure counters, a
+                    # burnt respawn-governor slot, and a replacement
+                    # worker the operator just tried to remove.
+                    self._maybe_beat(force=True, closing=True)
+                except Exception:  # noqa: BLE001 - router may be gone
+                    pass
+            self.close()
+
+    def _process(self, item: Dict[str, Any]) -> None:
+        import jax.numpy as jnp
+        import numpy as np
+
+        if not (isinstance(item, dict)
+                and item.get("type") == "serve_prefill_dispatch"):
+            raise ValueError(
+                f"unexpected item on prefill inbox: {type(item).__name__}"
+            )
+        req = item["req"]
+        rid = str(req["rid"])
+        prompt = [int(t) for t in req["prompt"]]
+        bucket = next(b for b in self.buckets if b >= len(prompt))
+        n_blocks = bucket // self.serve_cfg.block_size
+        ids = self.cache.allocator.alloc(n_blocks)
+        assert ids is not None, "worker pool sized for the largest bucket"
+        try:
+            padded = np.zeros((bucket,), np.int32)
+            padded[: len(prompt)] = prompt
+            logits, self._pool = self._prefill_fn(
+                self.params, self._pool, jnp.asarray(padded),
+                np.int32(len(prompt)), jnp.asarray(np.asarray(ids,
+                                                              np.int32)),
+            )
+            kv = self.cache.export_blocks(self._pool, ids)
+        finally:
+            self.cache.allocator.free(ids)
+        payload = encode_kv_payload(kv, np.asarray(logits))
+        shm_path = None
+        if item.get("same_host", False) \
+                and len(payload) >= self._shm_threshold:
+            shm_path = self._segment_store().put(payload)
+            with self._feed_lock:  # beat thread prunes concurrently
+                self._live_segments.append((shm_path, time.monotonic()))
+            out = make_handoff_item(req, bucket, shm=shm_path)
+        else:
+            out = make_handoff_item(req, bucket, data=payload)
+        try:
+            self._put(tuple(item["kv_to"]), out)
+        except (OSError, ConnectionError) as e:
+            # The replica's inbox is unreachable (dying or dead): give
+            # the segment back ourselves (no consumer will unlink it)
+            # and report the rid so the router re-routes.
+            if shm_path is not None:
+                self._unlink(shm_path)
+            with self._feed_lock:
+                self._failed.append((rid, repr(e)))
+            return
+        self.prefills += 1
+        with self._feed_lock:
+            self._done.append((rid, "handoff"))
+
+    # -- transport helpers ---------------------------------------------------
+    def _segment_store(self):
+        if self._store is None:
+            from ray_lightning_tpu.cluster.shm import SegmentStore
+
+            self._store = SegmentStore(prefix=KV_SEGMENT_PREFIX)
+        return self._store
+
+    def _put(self, addr: Tuple[str, int], item: Dict[str, Any]) -> None:
+        self._out.put(addr, item)
+
+    @staticmethod
+    def _unlink(path: str) -> None:
+        import os
+
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+    def _prune_segments(self, now: float) -> None:
+        """TTL janitor for handoffs whose replica died between send and
+        read — the pid-based sweep cannot collect them (this producer
+        is alive); the TTL can."""
+        with self._feed_lock:  # work thread appends concurrently
+            expired = [p for p, t in self._live_segments
+                       if now - t > self._segment_ttl_s]
+            self._live_segments = [
+                (p, t) for p, t in self._live_segments
+                if now - t <= self._segment_ttl_s
+            ]
+        for path in expired:
+            self._unlink(path)
+
+    def _maybe_beat(self, force: bool = False,
+                    closing: bool = False) -> None:
+        now = time.monotonic()
+        if not force and now - self._last_beat < self.beat_s:
+            return
+        self._last_beat = now
+        self._prune_segments(now)
+        with self._feed_lock:
+            done, self._done = self._done, []
+            failed, self._failed = self._failed, []
+        try:
+            self._beat_handle.put(make_beat_item(
+                "prefill", self.worker_id, done=done, failed=failed,
+                closing=closing,
+            ))
+        except (OSError, ConnectionError):
+            # Router gone (shutting down); keep draining dispatches.
+            with self._feed_lock:
+                self._done, self._failed = done + self._done, \
+                    failed + self._failed
+
+    def close(self, consume_grace_s: float = 5.0) -> None:
+        self._inbox.shutdown()
+        self._out.close()
+        if self._store is None:
+            return
+        # A handoff already DELIVERED to a busy replica's inbox may not
+        # be read yet — unlinking it now would turn an accepted request
+        # into a terminal "invalid" on a planned scale-down.  The
+        # consumer unlinks on read, so wait out a short grace for the
+        # tracked segments to disappear before reclaiming leftovers
+        # (a replica that never reads within the grace is the dead-
+        # handoff case the TTL/sweep janitors exist for anyway).
+        import os
+
+        deadline = time.monotonic() + consume_grace_s
+        while time.monotonic() < deadline:
+            with self._feed_lock:
+                paths = [p for p, _ in self._live_segments]
+            if not any(os.path.exists(p) for p in paths):
+                break
+            time.sleep(0.05)
+        self._store.unlink_all()

@@ -1,0 +1,433 @@
+"""Decode replicas + fleet construction for disaggregated serving.
+
+A decode replica is ONE :class:`~ray_lightning_tpu.serve.engine.
+ServeEngine` — its own mesh/params/pool — plus the fleet plumbing: a
+hello that registers its inbox and capabilities with the router, and a
+periodic beat carrying its live ``ServeStats`` snapshot, its terminal
+``(rid, status)`` feed (the router's in-flight pruning signal), and
+its process's compile-event counter (the bench pins ZERO steady-state
+recompiles per replica from exactly this field).
+
+Two deployment shapes over the SAME runner code:
+
+* **in-process** (:class:`InprocReplica` / :class:`InprocPrefill`) —
+  engines on driver threads, beats over real TCP loopback.  The cheap
+  shape for tests and the example; ``kill(hard=True)`` simulates
+  abrupt death (beats stop, inbox refuses, no cleanup) for failover
+  drills;
+* **actor** (:class:`ActorReplica` / :class:`ActorPrefill`) — one
+  :class:`~ray_lightning_tpu.cluster.actor.ProcessActor` per member,
+  each owning its own devices (the TPU shape; the CPU container proves
+  the dataflow with 1-device actors).  Graceful stop rides the
+  existing control lane (``request_drain`` → the runner loop drains,
+  stops its engine, sweeps); chaos kills ride SIGKILL.
+
+``launch_inproc_fleet`` / ``launch_actor_fleet`` wire N replicas + M
+prefill workers + a started :class:`~.router.Router` into a
+:class:`ServeFleet` with one ``close()``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_lightning_tpu.serve.dist.handoff import (
+    make_beat_item, make_hello_item,
+)
+from ray_lightning_tpu.serve.dist.router import RestartGovernor, Router
+
+__all__ = [
+    "DecodeReplicaRunner",
+    "InprocReplica",
+    "InprocPrefill",
+    "ActorReplica",
+    "ActorPrefill",
+    "ServeFleet",
+    "launch_inproc_fleet",
+    "launch_actor_fleet",
+    "run_decode_replica",
+    "run_prefill_worker",
+]
+
+
+class DecodeReplicaRunner:
+    """The replica-side loop around one engine: hello, then beats until
+    stopped.  The engine's serve thread does the actual work."""
+
+    def __init__(self, replica_id: str, engine, beat_handle,
+                 beat_s: float = 0.25):
+        self.replica_id = replica_id
+        self.engine = engine
+        self._beat_handle = beat_handle
+        self.beat_s = beat_s
+        self.suppress_final = False  # hard-kill simulation: no last beat
+        self._last = 0.0
+
+    def hello(self) -> None:
+        engine = self.engine
+        handle = engine.queue_handle()
+        self._beat_handle.put(make_hello_item(
+            "decode", self.replica_id, (handle.host, handle.port),
+            num_slots=engine.config.num_slots,
+            max_queue=engine.config.max_queue,
+            spec_k=engine.spec_k,
+            max_prompt_len=engine.max_prompt_len,
+            max_model_len=engine.max_model_len,
+            block_size=engine.config.block_size,
+        ))
+
+    def publish_beat(self, closing: bool = False) -> None:
+        from ray_lightning_tpu.telemetry import compile_event_count
+
+        self._beat_handle.put(make_beat_item(
+            "decode", self.replica_id,
+            done=self.engine.drain_done(),
+            snapshot=self.engine.snapshot(),
+            recompiles=compile_event_count(),
+            closing=closing,
+        ))
+
+    def run(self, stop=None) -> None:
+        """Beat until ``stop()`` goes true, then stop the engine (which
+        sweeps stale ``rlt-kv`` segments) and publish the final feed —
+        completions that landed between the last beat and the stop must
+        still reach the router."""
+        self.hello()
+        self.engine.start()
+        try:
+            while not (stop() if stop is not None else False):
+                time.sleep(min(self.beat_s, 0.05))
+                self._maybe_beat()
+        finally:
+            self.engine.stop()
+            if not self.suppress_final:
+                try:
+                    self.publish_beat(closing=True)
+                except (OSError, ConnectionError):
+                    pass  # router already gone
+
+    def _maybe_beat(self) -> None:
+        now = time.monotonic()
+        if now - self._last < self.beat_s:
+            return
+        self._last = now
+        try:
+            self.publish_beat()
+        except (OSError, ConnectionError):
+            pass  # router restarting/gone; keep serving
+
+
+# ---------------------------------------------------------------------------
+# Actor entry points (module-level so cloudpickle ships them by reference)
+# ---------------------------------------------------------------------------
+
+def run_decode_replica(replica_id: str, module, params,
+                       cfg_kwargs: Dict[str, Any],
+                       beat_addr: Tuple[str, int],
+                       beat_s: float = 0.25,
+                       draft_module=None, draft_params=None) -> dict:
+    """Actor main for one decode replica: serve until the driver sends
+    a drain over the control lane (``ProcessActor.request_drain``) or
+    kills the process.  Returns the final SLO snapshot."""
+    from ray_lightning_tpu.cluster.queue import QueueHandle
+    from ray_lightning_tpu.fault import drain
+    from ray_lightning_tpu.serve.engine import ServeConfig, ServeEngine
+
+    engine = ServeEngine(
+        module, params, ServeConfig(**cfg_kwargs),
+        draft_module=draft_module, draft_params=draft_params,
+    )
+    runner = DecodeReplicaRunner(
+        replica_id, engine, QueueHandle(*beat_addr), beat_s=beat_s
+    )
+    runner.run(stop=drain.drain_requested)
+    return engine.snapshot()
+
+
+def run_prefill_worker(worker_id: str, module, params, serve_cfg,
+                       beat_addr: Tuple[str, int],
+                       beat_s: float = 0.25) -> int:
+    """Actor main for one prefill worker.  Returns prompts prefilled."""
+    from ray_lightning_tpu.cluster.queue import QueueHandle
+    from ray_lightning_tpu.fault import drain
+    from ray_lightning_tpu.serve.dist.prefill import PrefillRunner
+
+    runner = PrefillRunner(
+        worker_id, module, params, serve_cfg,
+        QueueHandle(*beat_addr), beat_s=beat_s,
+    )
+    runner.run(stop=drain.drain_requested)
+    return runner.prefills
+
+
+# ---------------------------------------------------------------------------
+# Driver-side member handles (the Router's liveness/teardown interface)
+# ---------------------------------------------------------------------------
+
+class InprocReplica:
+    """A decode replica on driver threads (engine serve thread + beat
+    thread).  ``kill(hard=True)`` simulates abrupt death for failover
+    drills: the serve loop halts mid-stream, the inbox refuses new
+    frames, beats stop — everything a SIGKILL'd actor looks like from
+    the router's side, without the process."""
+
+    role = "decode"
+
+    def __init__(self, replica_id: str, engine, beat_handle,
+                 beat_s: float = 0.2):
+        self.id = replica_id
+        self.engine = engine
+        self._runner = DecodeReplicaRunner(
+            replica_id, engine, beat_handle, beat_s=beat_s
+        )
+        self._stop = threading.Event()
+        self._dead = False
+        self._thread = threading.Thread(
+            target=self._runner.run, args=(self._stop.is_set,),
+            name=f"rlt-serve-{replica_id}", daemon=True,
+        )
+        self._thread.start()
+
+    def is_alive(self) -> bool:
+        return not self._dead and self._thread.is_alive()
+
+    def kill(self, hard: bool = False) -> None:
+        if self._dead:
+            return
+        self._dead = True
+        self._runner.suppress_final = hard
+        if hard:
+            # Abrupt death: halt the serve loop wherever it is and make
+            # the inbox refuse (a dead process's port would).
+            self.engine._stop.set()
+            if self.engine._inbox is not None:
+                self.engine._inbox.shutdown()
+        self._stop.set()
+        if not hard:
+            self._thread.join(timeout=30)
+
+
+class InprocPrefill:
+    """A prefill worker on a driver thread."""
+
+    role = "prefill"
+
+    def __init__(self, worker_id: str, module, params, serve_cfg,
+                 beat_handle, beat_s: float = 0.2):
+        from ray_lightning_tpu.serve.dist.prefill import PrefillRunner
+
+        self.id = worker_id
+        self.runner = PrefillRunner(
+            worker_id, module, params, serve_cfg, beat_handle,
+            beat_s=beat_s,
+        )
+        self._stop = threading.Event()
+        self._dead = False
+        self._thread = threading.Thread(
+            target=self.runner.run, args=(self._stop.is_set,),
+            name=f"rlt-serve-{worker_id}", daemon=True,
+        )
+        self._thread.start()
+
+    def is_alive(self) -> bool:
+        return not self._dead and self._thread.is_alive()
+
+    def kill(self, hard: bool = False) -> None:
+        if self._dead:
+            return
+        self._dead = True
+        self.runner.suppress_final = hard
+        if hard:
+            self.runner._inbox.shutdown()
+        self._stop.set()
+        if not hard:
+            self._thread.join(timeout=30)
+
+
+class _ActorMember:
+    """Shared ProcessActor plumbing for actor-backed members."""
+
+    def __init__(self, member_id: str, name_prefix: str):
+        from ray_lightning_tpu.cluster.actor import ProcessActor
+
+        self.id = member_id
+        self.actor = ProcessActor(name=f"{name_prefix}-{member_id}")
+        self._fut = None
+
+    def is_alive(self) -> bool:
+        return self.actor.is_alive()
+
+    def kill(self, hard: bool = False) -> None:
+        if hard and self.actor._proc.poll() is None:
+            # Chaos: SIGKILL, no grace — the failure the failover path
+            # exists for.  actor.kill() below reaps and sweeps.
+            self.actor._proc.kill()
+        elif not hard and self.actor.is_alive():
+            try:
+                # Graceful: the runner loop polls the drain flag, stops
+                # its engine (segment sweep included) and returns.
+                self.actor.request_drain(wait=False)
+                if self._fut is not None:
+                    self._fut.result(timeout=60)
+            except Exception:  # noqa: BLE001 - a wedged drain falls
+                # through to the hard kill below
+                pass
+        self.actor.kill()
+
+
+class ActorReplica(_ActorMember):
+    role = "decode"
+
+    def __init__(self, replica_id: str, module, params,
+                 cfg_kwargs: Dict[str, Any], beat_addr: Tuple[str, int],
+                 beat_s: float = 0.25, draft_module=None,
+                 draft_params=None):
+        super().__init__(replica_id, "rlt-serve-replica")
+        self._fut = self.actor.submit(
+            run_decode_replica, replica_id, module, params, cfg_kwargs,
+            beat_addr, beat_s, draft_module, draft_params,
+        )
+
+
+class ActorPrefill(_ActorMember):
+    role = "prefill"
+
+    def __init__(self, worker_id: str, module, params, serve_cfg,
+                 beat_addr: Tuple[str, int], beat_s: float = 0.25):
+        super().__init__(worker_id, "rlt-serve-prefill")
+        self._fut = self.actor.submit(
+            run_prefill_worker, worker_id, module, params, serve_cfg,
+            beat_addr, beat_s,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Fleet construction
+# ---------------------------------------------------------------------------
+
+class ServeFleet:
+    """One handle on router + replicas + workers with one teardown."""
+
+    def __init__(self, router: Router, replicas: List[Any],
+                 workers: List[Any]):
+        self.router = router
+        self.replicas = replicas
+        self.workers = workers
+
+    def queue_handle(self):
+        return self.router.queue_handle()
+
+    def close(self) -> None:
+        # Router first: a planned teardown must not read as member
+        # deaths (spurious failovers/respawns on the way down).
+        self.router.stop()
+        for member in self.workers + self.replicas:
+            try:
+                member.kill()
+            except Exception:  # noqa: BLE001 - teardown is best-effort
+                pass
+
+
+def _host_params(params):
+    """Numpy-ify a param tree once so actor shipping (cloudpickle) does
+    not serialize device buffers."""
+    import jax
+    import numpy as np
+
+    return jax.tree.map(np.asarray, params)
+
+
+def _cfg_kwargs(serve_cfg) -> Dict[str, Any]:
+    from dataclasses import asdict
+
+    kw = asdict(serve_cfg)
+    if kw.get("prefill_buckets") is not None:
+        kw["prefill_buckets"] = list(kw["prefill_buckets"])
+    return kw
+
+
+def launch_inproc_fleet(module, params, serve_cfg, *, n_replicas: int = 2,
+                        n_prefill: int = 0, draft_module=None,
+                        draft_params=None, beat_s: float = 0.1,
+                        lost_after_s: float = 1.0,
+                        **router_kwargs) -> ServeFleet:
+    """N engines + M prefill workers on driver threads behind a started
+    router — the cheap fleet for tests/examples (real TCP beat/handoff
+    wire, no subprocesses)."""
+    from ray_lightning_tpu.serve.engine import ServeConfig, ServeEngine
+
+    router = Router(lost_after_s=lost_after_s, **router_kwargs)
+
+    def make_engine():
+        return ServeEngine(
+            module, params, ServeConfig(**_cfg_kwargs(serve_cfg)),
+            draft_module=draft_module, draft_params=draft_params,
+        )
+
+    replicas = [
+        InprocReplica(f"r{i}", make_engine(), router.beat_handle,
+                      beat_s=beat_s)
+        for i in range(n_replicas)
+    ]
+    workers = [
+        InprocPrefill(f"p{i}", module, params, serve_cfg,
+                      router.beat_handle, beat_s=beat_s)
+        for i in range(n_prefill)
+    ]
+    if n_prefill:
+        router._prefill_factory = lambda: InprocPrefill(
+            f"p{uuid.uuid4().hex[:6]}", module, params, serve_cfg,
+            router.beat_handle, beat_s=beat_s,
+        )
+    for r in replicas:
+        router.add_replica(r)
+    for w in workers:
+        router.add_prefill(w)
+    router.start()
+    router.wait_ready(timeout=60)
+    return ServeFleet(router, replicas, workers)
+
+
+def launch_actor_fleet(module, params, serve_cfg, *, n_replicas: int = 2,
+                       n_prefill: int = 1, draft_module=None,
+                       draft_params=None, beat_s: float = 0.25,
+                       lost_after_s: float = 2.0,
+                       governor: Optional[RestartGovernor] = None,
+                       startup_timeout_s: float = 180.0,
+                       **router_kwargs) -> ServeFleet:
+    """The real fleet: one ProcessActor per member, each owning its own
+    devices (1 CPU device per actor on this container; a TPU host's
+    chips in production), beats and handoffs over the queue plane."""
+    router = Router(lost_after_s=lost_after_s, governor=governor,
+                    **router_kwargs)
+    beat_addr = (router.beat_handle.host, router.beat_handle.port)
+    params = _host_params(params)
+    draft_params = (_host_params(draft_params)
+                    if draft_params is not None else None)
+    cfg_kwargs = _cfg_kwargs(serve_cfg)
+    replicas = [
+        ActorReplica(f"r{i}", module, params, cfg_kwargs, beat_addr,
+                     beat_s=beat_s, draft_module=draft_module,
+                     draft_params=draft_params)
+        for i in range(n_replicas)
+    ]
+    workers = [
+        ActorPrefill(f"p{i}", module, params, serve_cfg, beat_addr,
+                     beat_s=beat_s)
+        for i in range(n_prefill)
+    ]
+    if n_prefill:
+        router._prefill_factory = lambda: ActorPrefill(
+            f"p{uuid.uuid4().hex[:6]}", module, params, serve_cfg,
+            beat_addr, beat_s=beat_s,
+        )
+    for r in replicas:
+        router.add_replica(r)
+    for w in workers:
+        router.add_prefill(w)
+    router.start()
+    router.wait_ready(timeout=startup_timeout_s)
+    return ServeFleet(router, replicas, workers)

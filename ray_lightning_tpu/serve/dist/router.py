@@ -1,0 +1,802 @@
+"""Load-aware router for the disaggregated serving fleet.
+
+One router fronts N decode replicas and M prefill workers (ISSUE 12 /
+ROADMAP item 1 — the multi-replica half of "serve heavy traffic").
+Clients talk to it exactly as they talk to a single engine — the same
+``serve_request`` wire items on :meth:`Router.queue_handle`, replies
+streamed straight from whichever replica serves them to the client's
+reply queue (the router is on the SUBMISSION path only; token streams
+never funnel through it).
+
+Responsibilities, all jax-free host logic:
+
+* **admission** — the router tracks every request it routed until a
+  terminal status comes back on a replica beat, so per-replica load is
+  router-side truth, not a stale gauge.  When every live replica is at
+  capacity (``num_slots + max_queue``), submission gets the typed
+  ``rejected`` reply — the same backpressure contract a single engine
+  gives, fleet-wide;
+* **placement** — least-loaded by in-flight count (free-block and
+  slot-occupancy gauges from the latest ``ServeStats`` beat snapshot
+  break ties), with stickiness: a request re-routed after a prefill
+  failure prefers the replica it was already bound to, and ``spec>0``
+  requests are placed only on draft-capable replicas;
+* **prefill dispatch** — with prefill workers registered, a routed
+  request first goes to the least-busy worker
+  (``serve_prefill_dispatch``), which runs the prompt and ships the KV
+  blocks straight to the chosen replica's inbox
+  (``serve_kv_handoff``).  No workers = direct submission (the
+  monolith-within-disagg baseline);
+* **fault tolerance** — replica/worker liveness is beat-based
+  (``lost_after_s`` without a beat, or the process handle reports
+  dead).  A dead DECODE replica fails over: its in-flight requests are
+  re-submitted to survivors through the engines' recompute-preemption
+  path — the fleet-wide ``sample_seed`` the router stamped at
+  admission makes the re-emitted stream bitwise-identical at any
+  temperature, and clients dedup on token index, so no request is
+  lost.  A dead PREFILL worker is respawned under the sliding-window
+  :class:`RestartGovernor` (the restart-governance policy of the
+  training plane, serve-shaped) and its pending prompts re-dispatched.
+  Either death triggers an ``rlt-kv`` stale-segment sweep so dead
+  handoffs never leak tmpfs.
+
+Telemetry: :meth:`snapshot` is schema-pinned
+(``telemetry/schema.py::validate_router_snapshot``), exported as
+``router-live.json`` + the per-replica-labelled ``rlt_serve_*``
+OpenMetrics family (``telemetry/export_prom.py``), and rendered by the
+``rlt_top`` router pane.
+
+Known limit (cross-host hardening follow-up): routing sends are
+synchronous under the router lock, so a member host that BLACKHOLES
+TCP (SYN dropped, no RST — rare next to process death, which fails
+fast) can wedge the control plane for up to one connect timeout
+(~60s) before the death path runs.  The fix shape is a per-member
+outbox thread (the MPMD stage-inbox pattern); on the single-host
+fleets this round proves, ``is_alive()`` catches every death first.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from ray_lightning_tpu.serve.dist.handoff import (
+    CachedSender, make_dispatch_item, request_fields,
+)
+
+__all__ = ["Router", "RestartGovernor"]
+
+log = logging.getLogger(__name__)
+
+
+class RestartGovernor:
+    """Sliding-window restart budget (the strategy layer's restart
+    governance, serve-shaped): at most ``max_restarts`` permits per
+    trailing ``window_s``.  A worker that dies once a day respawns
+    forever; a crash-looping one exhausts the window and stays down —
+    loudly, via the router's ``prefill_respawns_denied`` counter."""
+
+    def __init__(self, max_restarts: int = 3, window_s: float = 3600.0):
+        if max_restarts < 0:
+            raise ValueError(f"max_restarts must be >= 0: {max_restarts}")
+        self.max_restarts = max_restarts
+        self.window_s = window_s
+        self._attempts: List[float] = []
+
+    def permit(self, now: Optional[float] = None) -> bool:
+        now = time.monotonic() if now is None else now
+        self._attempts = [t for t in self._attempts
+                          if now - t < self.window_s]
+        if len(self._attempts) >= self.max_restarts:
+            return False
+        self._attempts.append(now)
+        return True
+
+
+class _Member:
+    """Router-side record of one fleet member (decode replica or
+    prefill worker)."""
+
+    def __init__(self, handle, role: str):
+        self.handle = handle
+        self.role = role
+        self.id: str = handle.id
+        self.inbox: Optional[Tuple[str, int]] = None
+        self.caps: Dict[str, Any] = {}
+        self.registered_t = time.monotonic()
+        self.last_beat: Optional[float] = None
+        self.snapshot: Dict[str, Any] = {}
+        self.recompiles: Optional[int] = None
+        self.alive = True
+
+    def beat_age_s(self, now: float) -> float:
+        return now - (self.last_beat
+                      if self.last_beat is not None else self.registered_t)
+
+
+class _Track:
+    """One routed request until a terminal status comes back."""
+
+    __slots__ = ("req", "replica", "worker", "resubmits", "t0")
+
+    def __init__(self, req: Dict[str, Any], t0: float):
+        self.req = req
+        self.replica: Optional[str] = None
+        self.worker: Optional[str] = None
+        self.resubmits = 0
+        self.t0 = t0
+
+
+class Router:
+    """The disaggregated fleet's front door (see module docstring)."""
+
+    def __init__(
+        self,
+        *,
+        lost_after_s: float = 2.0,
+        hello_grace_s: float = 120.0,
+        governor: Optional[RestartGovernor] = None,
+        prefill_factory: Optional[Callable[[], Any]] = None,
+        telemetry_dir: Optional[str] = None,
+        prom_file: Optional[str] = None,
+        prom_port: Optional[int] = None,
+        export_every_s: float = 1.0,
+        poll_interval_s: float = 0.02,
+    ):
+        from ray_lightning_tpu.cluster.queue import DriverQueue
+
+        # Heartbeat-lost threshold: a replica whose beats stop for this
+        # long is declared dead and failed over.  The hello grace covers
+        # member startup (actor spawn + model build) before first beat.
+        self.lost_after_s = lost_after_s
+        self.hello_grace_s = hello_grace_s
+        self.governor = governor or RestartGovernor()
+        self._prefill_factory = prefill_factory
+        self._beats = DriverQueue()
+        self._requests = DriverQueue()
+        self._replicas: Dict[str, _Member] = {}
+        self._workers: Dict[str, _Member] = {}
+        self._inflight: Dict[str, _Track] = {}
+        # Failover re-submissions that found every candidate saturated:
+        # retried each poll — a failed-over request is never dropped.
+        self._retry: deque = deque()
+        self.counters: Dict[str, int] = {
+            "routed": 0, "completed": 0, "rejected": 0, "expired": 0,
+            "invalid": 0, "failovers": 0, "failed_over_requests": 0,
+            "prefill_dispatches": 0, "direct_submits": 0,
+            "replica_deaths": 0, "worker_deaths": 0,
+            "replica_drains": 0, "worker_drains": 0,
+            "prefill_respawns": 0, "prefill_respawns_denied": 0,
+        }
+        # Staleness of the last dead replica's final beat at detection —
+        # the failover-latency component the router can observe.
+        self.last_failover_detect_s: Optional[float] = None
+        self._seed_counter = 0
+        self._out = CachedSender()
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._poll_interval_s = poll_interval_s
+        self._export_every_s = export_every_s
+        self._last_export = 0.0
+        self._live_path = None
+        self._exporter = None
+        if telemetry_dir:
+            import os
+
+            os.makedirs(telemetry_dir, exist_ok=True)
+            self._live_path = f"{telemetry_dir}/router-live.json"
+        if prom_file or prom_port is not None:
+            from ray_lightning_tpu.telemetry.export_prom import PromExporter
+
+            self._exporter = PromExporter(textfile=prom_file,
+                                          port=prom_port)
+
+    # -- fleet membership ----------------------------------------------------
+    @property
+    def beat_handle(self):
+        """Picklable handle members publish hellos/beats to."""
+        return self._beats.handle
+
+    def queue_handle(self):
+        """Picklable submission handle for :class:`ServeClient` — the
+        router speaks the engine's wire dialect."""
+        return self._requests.handle
+
+    def add_replica(self, handle) -> None:
+        with self._lock:
+            self._replicas[handle.id] = _Member(handle, "decode")
+
+    def add_prefill(self, handle) -> None:
+        with self._lock:
+            self._workers[handle.id] = _Member(handle, "prefill")
+
+    def wait_ready(self, timeout: float = 120.0) -> None:
+        """Block until every registered member has hello'd its inbox."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self.poll()
+            with self._lock:
+                members = (list(self._replicas.values())
+                           + list(self._workers.values()))
+                if members and all(m.inbox is not None for m in members
+                                   if m.alive):
+                    return
+            time.sleep(0.02)
+        raise TimeoutError(
+            "serve fleet members did not register within "
+            f"{timeout}s (actor startup wedged?)"
+        )
+
+    # -- the poll loop -------------------------------------------------------
+    def poll(self, now: Optional[float] = None) -> None:
+        """One control-plane iteration: drain member beats, drain
+        client submissions, detect deaths (failover/respawn), retry
+        deferred failovers, refresh exports."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._drain_beats(now)
+            self._drain_requests(now)
+            self._check_liveness(now)
+            self._drain_retry(now)
+            self._maybe_export()
+
+    def start(self) -> "Router":
+        if self._thread is not None:
+            raise RuntimeError("router already started")
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="rlt-serve-router", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll()
+            except Exception:  # noqa: BLE001 - the control plane must
+                # survive a bad frame; the failure mode to avoid is a
+                # silently dead router stranding every client
+                log.warning("router poll raised", exc_info=True)
+            time.sleep(self._poll_interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        self._beats.shutdown()
+        self._requests.shutdown()
+        self._out.close()
+        if self._exporter is not None:
+            self._exporter.close()
+        self._sweep_segments()
+
+    # -- beats ---------------------------------------------------------------
+    def _member(self, role: str, member_id: str) -> Optional[_Member]:
+        pool = self._replicas if role == "decode" else self._workers
+        return pool.get(member_id)
+
+    def _drain_beats(self, now: float) -> None:
+        import queue as _pyqueue
+
+        while True:
+            try:
+                item = self._beats.get_nowait()
+            except _pyqueue.Empty:
+                return
+            if not isinstance(item, dict):
+                continue
+            kind = item.get("type")
+            if kind == "serve_replica_hello":
+                m = self._member(str(item.get("role")), str(item.get("id")))
+                if m is not None:
+                    m.inbox = (item["inbox"][0], int(item["inbox"][1]))
+                    m.caps = {k: v for k, v in item.items()
+                              if k not in ("type", "role", "id", "inbox")}
+                    m.last_beat = now
+            elif kind == "serve_replica_beat":
+                self._ingest_beat(item, now)
+
+    def _ingest_beat(self, item: Dict[str, Any], now: float) -> None:
+        m = self._member(str(item.get("role")), str(item.get("id")))
+        if m is None:
+            return
+        m.last_beat = now
+        if "snapshot" in item:
+            m.snapshot = item["snapshot"]
+        if "recompiles" in item:
+            m.recompiles = int(item["recompiles"])
+        for rid, status in item.get("done", []):
+            if m.role == "decode":
+                self._complete(str(rid), str(status))
+            else:
+                track = self._inflight.get(str(rid))
+                if track is not None and track.worker == m.id:
+                    track.worker = None  # handoff landed; replica owns it
+        for rid, err in item.get("failed", []):
+            track = self._inflight.get(str(rid))
+            # Ownership guard (mirrors the done-loop above): a stale
+            # failure report from a worker this rid was already routed
+            # AWAY from (its replica died first) must not yank the
+            # request off its healthy new placement.
+            if track is not None and track.worker == m.id:
+                self._on_handoff_failure(str(rid), str(err), now)
+        if item.get("closing") and m.alive:
+            self._on_member_closing(m, now)
+
+    def _complete(self, rid: str, status: str) -> None:
+        track = self._inflight.pop(rid, None)
+        if track is None:
+            return
+        key = status if status in ("rejected", "expired", "invalid") \
+            else "completed"
+        self.counters[key] += 1
+
+    def _on_member_closing(self, m: _Member, now: float) -> None:
+        """Planned member drain (the ``closing`` flag on a final beat —
+        an operator scale-down, NOT a crash): stop routing to it and
+        re-place its remaining work, without burning failure counters,
+        respawn budget, or a spurious ``failovers`` increment in the
+        telemetry surface.  The member's own teardown (engine stop +
+        segment sweep) is the operator's — no reap here."""
+        m.alive = False
+        remaining = [rid for rid, t in self._inflight.items()
+                     if (t.replica if m.role == "decode" else t.worker)
+                     == m.id]
+        log.info("serve %s %s draining (planned) — re-placing %d "
+                 "request(s)", m.role, m.id, len(remaining))
+        self.counters["replica_drains" if m.role == "decode"
+                      else "worker_drains"] += 1
+        for rid in remaining:
+            track = self._inflight[rid]
+            track.worker = None
+            if m.role == "decode":
+                track.replica = None
+            track.resubmits += 1
+            self._route(rid, track, now,
+                        exclude={m.id} if m.role == "decode"
+                        else frozenset(),
+                        must_place=True)
+        self._sweep_segments()
+
+    def _on_handoff_failure(self, rid: str, err: str, now: float) -> None:
+        """A prefill worker could not deliver to the chosen replica —
+        trust the signal and re-route AWAY from it (if that replica is
+        healthy, losing one placement is cheap; if it is dying, beats
+        will confirm shortly)."""
+        track = self._inflight.get(rid)
+        if track is None:
+            return
+        exclude = {track.replica} if track.replica else set()
+        track.worker = None
+        track.replica = None
+        track.resubmits += 1
+        self._route(rid, track, now, exclude=exclude, must_place=True)
+
+    # -- client submissions --------------------------------------------------
+    def _drain_requests(self, now: float) -> None:
+        import queue as _pyqueue
+
+        while True:
+            try:
+                item = self._requests.get_nowait()
+            except _pyqueue.Empty:
+                return
+            try:
+                self.submit_request(item, now=now)
+            except Exception as e:  # noqa: BLE001 - a bad request must
+                # never take the router down; when the reply address is
+                # recoverable the client gets the engine's typed
+                # "invalid" reply instead of blocking to its timeout
+                log.warning("router: malformed request: %s", e)
+                try:
+                    rid = str(item.get("rid"))
+                    reply = tuple(item["reply"])
+                except Exception:  # noqa: BLE001 - nothing to tell
+                    continue
+                self.counters["invalid"] += 1
+                self._reply(reply, {
+                    "type": "serve_done", "rid": rid,
+                    "status": "invalid", "error": str(e), "tokens": [],
+                })
+
+    def submit_request(self, item: Dict[str, Any],
+                       now: Optional[float] = None) -> str:
+        """Admit one ``serve_request`` wire item: stamp the fleet-wide
+        sampling seed, validate against the fleet geometry, place it.
+        Returns the rid; rejection/invalid outcomes reply to the
+        client's queue exactly as a single engine would."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if not isinstance(item, dict) \
+                    or item.get("type") != "serve_request":
+                raise ValueError("not a serve_request item")
+            rid = str(item["rid"])
+            reply = tuple(item["reply"])
+            seed = item.get("sample_seed")
+            if seed is None:
+                # The fleet-wide sampling-stream identity: stamped HERE
+                # (not per engine) so a failover re-submission to any
+                # replica replays the identical token stream.
+                seed = self._seed_counter
+                self._seed_counter += 1
+            req = request_fields(
+                rid, item["prompt"], int(item["max_new_tokens"]),
+                reply=reply, sample_seed=seed,
+                temperature=float(item.get("temperature", 0.0)),
+                eos_token_id=item.get("eos_token_id"),
+                top_k=item.get("top_k"),
+                spec=item.get("spec"),
+                deadline_s=item.get("deadline_s"),
+            )
+            problem = self._validate(req)
+            if problem is not None:
+                self.counters["invalid"] += 1
+                self._reply(reply, {
+                    "type": "serve_done", "rid": rid, "status": "invalid",
+                    "error": problem, "tokens": [],
+                })
+                return rid
+            track = _Track(req, now)
+            self._inflight[rid] = track
+            self.counters["routed"] += 1
+            self._route(rid, track, now)
+            return rid
+
+    def _validate(self, req: Dict[str, Any]) -> Optional[str]:
+        """Cheap fleet-geometry validation so prefill workers never see
+        a prompt they cannot bucket (the engines re-validate anyway)."""
+        if not req["prompt"]:
+            return "prompt must contain at least one token"
+        if req["max_new_tokens"] < 1:
+            return "max_new_tokens must be >= 1"
+        # Live replicas only: a dead member's (possibly smaller) limits
+        # must not keep rejecting prompts the surviving fleet serves.
+        caps = [m.caps for m in self._replicas.values()
+                if m.caps and m.alive]
+        if caps:
+            max_prompt = min(c.get("max_prompt_len", 1 << 30)
+                             for c in caps)
+            max_len = min(c.get("max_model_len", 1 << 30) for c in caps)
+            if len(req["prompt"]) > max_prompt:
+                return (f"prompt ({len(req['prompt'])}) exceeds the "
+                        f"fleet's largest prefill bucket ({max_prompt})")
+            if len(req["prompt"]) + req["max_new_tokens"] > max_len:
+                return (f"prompt + max_new_tokens exceeds the fleet's "
+                        f"max_model_len ({max_len})")
+        return None
+
+    # -- placement -----------------------------------------------------------
+    def _assigned(self, replica_id: str) -> int:
+        return sum(1 for t in self._inflight.values()
+                   if t.replica == replica_id)
+
+    def _pending(self, worker_id: str) -> int:
+        return sum(1 for t in self._inflight.values()
+                   if t.worker == worker_id)
+
+    def _blocks_free(self, m: _Member) -> float:
+        gauges = m.snapshot.get("gauges", {}) if m.snapshot else {}
+        return float(gauges.get("blocks_free", 0.0))
+
+    def _route(self, rid: str, track: _Track, now: float,
+               exclude: Set[str] = frozenset(),
+               must_place: bool = False) -> None:
+        """Pick a replica (and a prefill worker when any are live) for
+        ``rid``.  ``must_place`` marks failover/re-route submissions:
+        instead of a typed rejection they park on the retry queue until
+        capacity frees up — a request the fleet already accepted is
+        never lost to a transient squeeze."""
+        req = track.req
+        live = [m for m in self._replicas.values()
+                if m.alive and m.inbox is not None and m.id not in exclude]
+        spec = req.get("spec")
+        if spec is not None and spec > 0:
+            capable = [m for m in live if m.caps.get("spec_k", 0) > 0]
+            if not capable:
+                # A draft-less engine would fail the request as
+                # "invalid" — never send a spec request there.  No
+                # capable replica in the FLEET: terminal invalid.
+                # Capable but not currently routable (excluded after a
+                # transient handoff failure, or not hello'd yet): an
+                # already-accepted request parks until it is, a fresh
+                # one gets the typed retryable rejection.
+                if any(m.caps.get("spec_k", 0) > 0
+                       for m in self._replicas.values() if m.alive):
+                    if must_place:
+                        self._park(rid)
+                    else:
+                        self._finish_unroutable(
+                            rid, track, "rejected",
+                            "draft-capable replica temporarily "
+                            "unavailable",
+                        )
+                else:
+                    self._finish_unroutable(
+                        rid, track, "invalid",
+                        "spec > 0 but no draft-capable replica in "
+                        "the fleet",
+                    )
+                return
+            live = capable
+        if not live:
+            if must_place and any(m.alive for m in self._replicas.values()):
+                self._park(rid)
+                return
+            self._finish_unroutable(
+                rid, track,
+                "error" if must_place else "rejected",
+                "no live decode replica",
+            )
+            return
+        candidates = [
+            m for m in live
+            if self._assigned(m.id) < (m.caps.get("num_slots", 1)
+                                       + m.caps.get("max_queue", 0))
+        ]
+        if not candidates:
+            if must_place:
+                self._park(rid)
+                return
+            self.counters["rejected"] += 1
+            self._inflight.pop(rid, None)
+            self._reply(tuple(req["reply"]), {
+                "type": "serve_done", "rid": rid, "status": "rejected",
+                "reason": "rejected", "tokens": [],
+            })
+            return
+        # Stickiness: a request already bound to a live replica (spec
+        # drafts mid-re-route after a prefill hiccup) stays there — its
+        # draft cache and its queue position are warm.
+        target = next((m for m in candidates if m.id == track.replica),
+                      None)
+        if target is None:
+            target = min(
+                candidates,
+                key=lambda m: (self._assigned(m.id),
+                               -self._blocks_free(m), m.id),
+            )
+        track.replica = target.id
+        workers = [w for w in self._workers.values()
+                   if w.alive and w.inbox is not None]
+        if workers:
+            worker = min(workers,
+                         key=lambda w: (self._pending(w.id), w.id))
+            try:
+                # tmpfs zero-copy only when the worker and the replica
+                # advertise the same host; otherwise the payload rides
+                # inline bytes over the (chunk-sending) queue.
+                self._put(worker.inbox, make_dispatch_item(
+                    req, target.inbox,
+                    same_host=worker.inbox[0] == target.inbox[0]))
+                track.worker = worker.id
+                self.counters["prefill_dispatches"] += 1
+                return
+            except (OSError, ConnectionError):
+                self._on_worker_death(worker, now)
+                # fall through to direct submission this once
+        try:
+            self._put(target.inbox, req)
+            self.counters["direct_submits"] += 1
+        except (OSError, ConnectionError):
+            self._on_replica_death(target, now)
+
+    def _park(self, rid: str) -> None:
+        if rid not in self._retry:
+            self._retry.append(rid)
+
+    def _finish_unroutable(self, rid: str, track: _Track, status: str,
+                           error: str) -> None:
+        self._inflight.pop(rid, None)
+        self.counters["invalid" if status == "invalid" else "rejected"] \
+            += 1
+        done: Dict[str, Any] = {
+            "type": "serve_done", "rid": rid, "status": status,
+            "tokens": [],
+        }
+        if status == "rejected":
+            done["reason"] = "rejected"
+        else:
+            done["error"] = error
+        self._reply(tuple(track.req["reply"]), done)
+
+    def _drain_retry(self, now: float) -> None:
+        pending, self._retry = list(self._retry), deque()
+        for rid in pending:
+            track = self._inflight.get(rid)
+            if track is None:
+                continue
+            track.replica = None
+            self._route(rid, track, now, must_place=True)
+
+    # -- liveness / failover -------------------------------------------------
+    def _check_liveness(self, now: float) -> None:
+        for m in list(self._replicas.values()):
+            if m.alive and self._is_lost(m, now):
+                self._on_replica_death(m, now)
+        for w in list(self._workers.values()):
+            if w.alive and self._is_lost(w, now):
+                self._on_worker_death(w, now)
+
+    def _is_lost(self, m: _Member, now: float) -> bool:
+        try:
+            if not m.handle.is_alive():
+                return True
+        except Exception:  # noqa: BLE001 - a broken handle IS dead
+            return True
+        grace = self.lost_after_s if m.last_beat is not None \
+            else self.hello_grace_s
+        return m.beat_age_s(now) > grace
+
+    def _on_replica_death(self, m: _Member, now: float) -> None:
+        """Serving-side fault tolerance: fail the dead replica's
+        in-flight requests over to survivors.  Re-submission rides the
+        engines' recompute-preemption path — tokens re-emit from index
+        0 with the SAME router-stamped sample seed, clients dedup on
+        index, so the stream is bitwise-continuous and nothing is
+        lost."""
+        if not m.alive:
+            return
+        m.alive = False
+        self.counters["replica_deaths"] += 1
+        self.last_failover_detect_s = m.beat_age_s(now)
+        victims = [rid for rid, t in self._inflight.items()
+                   if t.replica == m.id]
+        log.warning(
+            "serve replica %s lost (last beat %.1fs ago) — failing over "
+            "%d in-flight request(s)", m.id, m.beat_age_s(now),
+            len(victims),
+        )
+        if victims:
+            self.counters["failovers"] += 1
+            self.counters["failed_over_requests"] += len(victims)
+        for rid in victims:
+            track = self._inflight[rid]
+            track.replica = None
+            track.worker = None
+            track.resubmits += 1
+            self._route(rid, track, now, exclude={m.id}, must_place=True)
+        self._reap(m)
+
+    def _on_worker_death(self, w: _Member, now: float) -> None:
+        if not w.alive:
+            return
+        w.alive = False
+        self.counters["worker_deaths"] += 1
+        pending = [rid for rid, t in self._inflight.items()
+                   if t.worker == w.id]
+        log.warning(
+            "serve prefill worker %s lost — re-dispatching %d pending "
+            "prompt(s)", w.id, len(pending),
+        )
+        for rid in pending:
+            track = self._inflight[rid]
+            track.worker = None
+            track.resubmits += 1
+            self._route(rid, track, now, must_place=True)
+        if self._prefill_factory is not None:
+            if self.governor.permit(now):
+                try:
+                    self.add_prefill(self._prefill_factory())
+                    self.counters["prefill_respawns"] += 1
+                except Exception:  # noqa: BLE001 - a failed respawn
+                    # must not take the router down; the governor slot
+                    # is burnt either way (that is the point)
+                    log.warning("prefill respawn failed", exc_info=True)
+            else:
+                self.counters["prefill_respawns_denied"] += 1
+                log.warning(
+                    "prefill worker %s NOT respawned: restart window "
+                    "exhausted (%d per %.0fs)", w.id,
+                    self.governor.max_restarts, self.governor.window_s,
+                )
+        self._reap(w)
+
+    def _reap(self, m: _Member) -> None:
+        """Best-effort corpse cleanup OFF the control-plane thread: the
+        member is already marked dead and unrouted, and ``kill()`` on a
+        false-positive death (process alive, beats merely stalled) can
+        block tens of seconds in a drain/join — under the router lock
+        that would freeze every client of the fleet."""
+        def kill_quietly():
+            try:
+                m.handle.kill()
+            except Exception:  # noqa: BLE001 - reaping is best-effort
+                pass
+
+        threading.Thread(target=kill_quietly, name="rlt-router-reap",
+                         daemon=True).start()
+        self._sweep_segments()
+
+    def _sweep_segments(self) -> None:
+        """Dead prefill handoffs (producer pid gone, never consumed)
+        must not leak tmpfs — mirrored by ``ServeEngine.stop``."""
+        try:
+            from ray_lightning_tpu.cluster.shm import sweep_stale_segments
+
+            sweep_stale_segments("rlt-kv")
+        except Exception:  # noqa: BLE001 - janitorial, never raises out
+            pass
+
+    # -- wire helpers --------------------------------------------------------
+    def _put(self, addr: Tuple[str, int], item: Dict[str, Any]) -> None:
+        self._out.put(addr, item)
+
+    def _reply(self, addr: Tuple[str, int], item: Dict[str, Any]) -> None:
+        try:
+            self._put(addr, item)
+        except (OSError, ConnectionError):
+            pass  # client went away; nothing to tell it
+
+    # -- telemetry -----------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """The router's live snapshot (schema:
+        ``telemetry/schema.py::validate_router_snapshot``)."""
+        now = time.monotonic()
+        with self._lock:
+            replicas = []
+            for m in self._replicas.values():
+                gauges = (m.snapshot.get("gauges", {})
+                          if m.snapshot else {})
+                entry: Dict[str, Any] = {
+                    "id": m.id,
+                    "alive": bool(m.alive),
+                    "inflight": self._assigned(m.id),
+                    "last_beat_age_s": (
+                        round(now - m.last_beat, 3)
+                        if m.last_beat is not None else None
+                    ),
+                }
+                for key in ("slots_active", "num_slots", "queue_depth",
+                            "blocks_free", "num_blocks",
+                            "spec_acceptance_rate"):
+                    if key in gauges:
+                        entry[key] = float(gauges[key])
+                if m.recompiles is not None:
+                    entry["recompiles"] = m.recompiles
+                replicas.append(entry)
+            workers = [
+                {
+                    "id": w.id,
+                    "alive": bool(w.alive),
+                    "pending": self._pending(w.id),
+                    "last_beat_age_s": (
+                        round(now - w.last_beat, 3)
+                        if w.last_beat is not None else None
+                    ),
+                }
+                for w in self._workers.values()
+            ]
+            return {
+                "ts": time.time(),
+                "counters": dict(self.counters),
+                "replicas": replicas,
+                "workers": workers,
+            }
+
+    def _maybe_export(self) -> None:
+        if self._exporter is None and self._live_path is None:
+            return
+        now = time.monotonic()
+        if now - self._last_export < self._export_every_s:
+            return
+        self._last_export = now
+        snap = self.snapshot()
+        if self._exporter is not None:
+            self._exporter.update({"router": snap})
+        if self._live_path is not None:
+            import json
+            import os
+
+            tmp = self._live_path + ".tmp"
+            try:
+                with open(tmp, "w") as f:
+                    json.dump({"ts": snap["ts"], "router": snap}, f)
+                os.replace(tmp, self._live_path)
+            except OSError:
+                pass  # a full disk must not take the router down

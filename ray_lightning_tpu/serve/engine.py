@@ -19,6 +19,18 @@ background thread (`serve_forever` semantics).  Requests arrive either
 in-process (:meth:`submit`) or over the DriverQueue plane
 (:meth:`queue_handle` + ``serve/client.py``) — same admission path,
 same backpressure.
+
+Disaggregated mode (``serve/dist/``): the inbox also accepts
+``serve_kv_handoff`` items — a request a PREFILL WORKER already ran,
+its per-layer KV blocks and final-position logits riding the queue
+plane.  Admission then scatters the blocks into this engine's own pool
+(``kv_cache.import_blocks`` — one compiled program per bucket block
+count, like the prefill set) and samples the first token from the
+shipped logits, so the request goes straight to the fixed-width
+decode/verify programs with ZERO extra recompiles.  Wire requests may
+also PRESET ``sample_seed`` (the router's fleet-wide submission
+ordinal) so a failover re-submission to a different replica replays
+the identical sampling stream.
 """
 
 from __future__ import annotations
@@ -26,6 +38,7 @@ from __future__ import annotations
 import threading
 import time
 import uuid
+from collections import deque
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -134,7 +147,7 @@ class ServeEngine:
         from ray_lightning_tpu.serve.kv_cache import PagedKVCache
         from ray_lightning_tpu.serve.metrics import ServeStats
         from ray_lightning_tpu.serve.scheduler import (
-            Scheduler, default_buckets,
+            Scheduler, derive_geometry,
         )
 
         def _prep(tree):
@@ -183,12 +196,15 @@ class ServeEngine:
             self._draft_c = draft_module._compute_dtype()
         self.spec_k = cfg.spec_k if draft_module is not None else 0
 
-        self.max_model_len = cfg.max_model_len or self.cfg.seq_len
-        if self.max_model_len > self.cfg.seq_len:
+        if (cfg.max_model_len or 0) > self.cfg.seq_len:
             raise ValueError(
-                f"max_model_len {self.max_model_len} exceeds the "
+                f"max_model_len {cfg.max_model_len} exceeds the "
                 f"positional table ({self.cfg.seq_len})"
             )
+        # Shared derivation rule (scheduler.derive_geometry): prefill
+        # workers run the SAME function, so handoff geometry can never
+        # drift between a worker and its replicas.
+        self.max_model_len, buckets = derive_geometry(cfg, self.cfg)
         blocks_per_seq = -(-self.max_model_len // cfg.block_size)
         num_blocks = cfg.num_blocks
         if num_blocks is None:
@@ -204,22 +220,9 @@ class ServeEngine:
         self.cache = PagedKVCache(
             self.cfg, num_blocks, cfg.block_size, dtype=self._c
         )
-        buckets = list(cfg.prefill_buckets or default_buckets(
-            cfg.block_size, max(1, self.max_model_len - 1)
-        ))
-        # A bucket longer than max_model_len cannot run (the prefill
-        # indexes the positional table at [0, T)), so the longest
-        # RETAINED bucket bounds the admissible prompt length — submit()
-        # enforces it, so Scheduler.bucket_for can never raise inside
-        # the serve loop.  The bound only bites when max_model_len is
-        # not bucket-aligned (docs/SERVING.md "Knobs").
-        buckets = sorted(b for b in buckets if b <= self.max_model_len)
-        if not buckets:
-            raise ValueError(
-                f"no prefill bucket fits max_model_len "
-                f"{self.max_model_len} (block_size {cfg.block_size} too "
-                f"large? smallest bucket is one block)"
-            )
+        # The longest RETAINED bucket bounds the admissible prompt
+        # length — submit() enforces it, so Scheduler.bucket_for can
+        # never raise inside the serve loop.
         self.max_prompt_len = buckets[-1]
         self.scheduler = Scheduler(
             cfg.num_slots, self.cache.allocator, cfg.block_size,
@@ -248,6 +251,11 @@ class ServeEngine:
         self._build_programs()
 
         self._handles: Dict[str, ServeHandle] = {}
+        # Terminal (rid, status) pairs since the last drain_done() —
+        # the completion feed a disaggregated replica's beats carry so
+        # the router can prune its in-flight tracking.  Bounded: an
+        # undreained feed (no router) must never grow without bound.
+        self._done_feed: deque = deque(maxlen=4096)
         self._error: Optional[BaseException] = None
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -275,8 +283,8 @@ class ServeEngine:
         import jax.numpy as jnp
 
         from ray_lightning_tpu.serve.kv_cache import (
-            make_slot_keys, paged_decode_step, paged_prefill,
-            paged_verify_step, sample_tokens,
+            import_blocks, make_slot_keys, paged_decode_step,
+            paged_prefill, paged_verify_step, sample_tokens,
         )
 
         cfg, c = self.cfg, self._c
@@ -308,10 +316,31 @@ class ServeEngine:
             )[0]
             return first, pool
 
+        def _first(logits, prompt_len, temp, seed, top_k):
+            # Disaggregated admission: the prefill worker shipped the
+            # final-position logits with the KV blocks; sampling them
+            # HERE with this engine's keys is bitwise the tail of
+            # _prefill — local and imported admissions emit identical
+            # first tokens.
+            keys = make_slot_keys(
+                base_key, seed[None], (prompt_len - 1)[None]
+            )
+            return sample_tokens(
+                logits[None], keys, temp[None], top_k[None]
+            )[0]
+
         self._decode_fn = jax.jit(_decode, donate_argnums=donate)
         # One python callable; XLA compiles one executable per bucket
         # length (tokens/block_ids shapes) — the bucketed prefill set.
         self._prefill_fn = jax.jit(_prefill, donate_argnums=donate)
+        # Disaggregated KV import: one executable per bucket block
+        # count (block_ids shape), mirroring the prefill set — fleet
+        # warmup compiles them all, steady state never recompiles.
+        self._import_fn = jax.jit(
+            import_blocks,
+            donate_argnums=(0,) if jax.default_backend() == "tpu" else (),
+        )
+        self._first_fn = jax.jit(_first)
 
         if self.draft_module is None:
             return
@@ -370,7 +399,9 @@ class ServeEngine:
                top_k: Optional[int] = None,
                spec: Optional[int] = None,
                deadline_s: Optional[float] = None,
-               on_token=None, rid: Optional[str] = None) -> ServeHandle:
+               sample_seed: Optional[int] = None,
+               on_token=None, rid: Optional[str] = None,
+               _handoff: Optional[dict] = None) -> ServeHandle:
         """Enqueue one request (thread-safe).  Returns a handle; a
         backpressure rejection is visible immediately as
         ``handle.status == "rejected"`` (and ``result()`` raises).
@@ -378,7 +409,16 @@ class ServeEngine:
         ``spec`` caps this request's speculative draft count: None =
         the engine's ``spec_k`` default, 0 = plain target decode, K =
         at most K drafted tokens verified per tick (clamped to the
-        engine width)."""
+        engine width).
+
+        ``sample_seed`` presets the request's sampling-stream identity
+        (None = this engine's submission ordinal).  The disaggregated
+        router assigns fleet-wide seeds so re-submitting a failed-over
+        request to ANY replica replays the identical token stream.
+
+        ``_handoff`` (internal, ``serve/dist/``) carries a prefill
+        worker's exported KV payload — admission imports it instead of
+        running the local prefill program."""
         from ray_lightning_tpu.serve.scheduler import Request
 
         prompt = [int(t) for t in prompt]
@@ -406,6 +446,12 @@ class ServeEngine:
                     "spec > 0 on an engine without a draft model — "
                     "build the ServeEngine with draft_module/draft_params"
                 )
+        if sample_seed is not None:
+            sample_seed = int(sample_seed)
+            if sample_seed < 0:
+                raise ValueError(
+                    f"sample_seed must be >= 0, got {sample_seed}"
+                )
         if len(prompt) + max_new_tokens > self.max_model_len:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens "
@@ -430,14 +476,19 @@ class ServeEngine:
             rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
             temperature=float(temperature), eos_token_id=eos_token_id,
             top_k=top_k, spec=spec,
-            deadline_s=deadline_s, on_token=on_token,
+            deadline_s=deadline_s, sample_seed=sample_seed,
+            on_token=on_token,
         )
+        if _handoff is not None:
+            req._handoff = _handoff
         handle = ServeHandle(rid, req)
         with self._lock:
             self.stats.bump("submitted")
             accepted = self.scheduler.submit(req)
             if accepted:
                 self._handles[rid] = handle
+            else:
+                self._done_feed.append((rid, "rejected"))
         if not accepted:
             self.stats.bump("rejected")
             req.finished_t = time.monotonic()
@@ -470,22 +521,50 @@ class ServeEngine:
         now = time.monotonic()
         for slot, req, bucket in admissions:
             self.stats.note_admitted(now - req.arrival_t)
-            self.stats.bump("prefills")
-            padded = np.zeros((bucket,), np.int32)
-            padded[: req.prompt_len] = req.prompt
             ids = np.asarray(
                 self.scheduler._blocks[slot][: bucket
                                              // self.config.block_size],
                 np.int32,
             )
-            padded = jnp.asarray(padded)
             ids = jnp.asarray(ids)
-            first, self._pool = self._prefill_fn(
-                self.params, self._pool, padded,
-                np.int32(req.prompt_len), ids,
-                np.float32(req.temperature), np.int32(req.sample_seed),
-                np.int32(req.top_k or 0),
-            )
+            handoff = getattr(req, "_handoff", None)
+            padded = None
+            if handoff is None or self.draft_module is not None:
+                # The padded prompt feeds the local prefill and/or the
+                # draft prefill; a KV import on a draft-less engine —
+                # the disaggregated steady state — needs neither, so
+                # skip the bucket-sized host→device copy entirely.
+                padded_np = np.zeros((bucket,), np.int32)
+                padded_np[: req.prompt_len] = req.prompt
+                padded = jnp.asarray(padded_np)
+            if handoff is not None:
+                # A prefill worker already ran this prompt: scatter its
+                # exported blocks into OUR allocator's blocks and
+                # sample the first token from the shipped logits —
+                # bitwise what the local prefill would have produced,
+                # without the trunk forward.
+                req._handoff = None  # the payload is large; drop it
+                self.stats.bump("kv_imports")
+                self._pool = self._import_fn(
+                    self._pool,
+                    {k: jnp.asarray(v) for k, v in handoff["kv"].items()},
+                    ids,
+                )
+                first = self._first_fn(
+                    jnp.asarray(handoff["logits"]),
+                    np.int32(req.prompt_len),
+                    np.float32(req.temperature),
+                    np.int32(req.sample_seed), np.int32(req.top_k or 0),
+                )
+            else:
+                self.stats.bump("prefills")
+                first, self._pool = self._prefill_fn(
+                    self.params, self._pool, padded,
+                    np.int32(req.prompt_len), ids,
+                    np.float32(req.temperature),
+                    np.int32(req.sample_seed),
+                    np.int32(req.top_k or 0),
+                )
             if self.draft_module is not None:
                 # The draft cache tracks every admission (one bucketed
                 # draft-prefill program per bucket) so any later tick
@@ -755,9 +834,22 @@ class ServeEngine:
     def _finish_handle(self, req) -> None:
         with self._lock:
             handle = self._handles.pop(req.rid, None)
+            self._done_feed.append((req.rid, req.state.value))
         if handle is not None:
             handle._done.set()
         self._reply_done(req)
+
+    def drain_done(self) -> List[Tuple[str, str]]:
+        """Terminal ``(rid, status)`` pairs since the last call — the
+        per-beat completion feed of a disaggregated decode replica
+        (``serve/dist/replica.py``): the router prunes its in-flight
+        tracking from it, which is what makes failover re-submission
+        exact (a request is re-submitted iff no terminal status ever
+        reached the router)."""
+        with self._lock:
+            items = list(self._done_feed)
+            self._done_feed.clear()
+        return items
 
     # -- background thread ---------------------------------------------------
     def start(self) -> "ServeEngine":
@@ -821,6 +913,17 @@ class ServeEngine:
         self._reply_handles.clear()
         if self._exporter is not None:
             self._exporter.close()
+        # Serve-replica teardown reclaims dead prefill handoffs: a
+        # prefill worker killed -9 mid-handoff leaves rlt-kv segments
+        # whose owner pid is gone and which no consumer will ever read
+        # — the engine-close sweep (mirroring the router's failover
+        # sweep) keeps tmpfs bounded across replica restarts.
+        try:
+            from ray_lightning_tpu.cluster.shm import sweep_stale_segments
+
+            sweep_stale_segments("rlt-kv")
+        except Exception:  # noqa: BLE001 - janitorial, never raises out
+            pass
 
     # -- DriverQueue request plane ------------------------------------------
     def queue_handle(self):
@@ -853,10 +956,17 @@ class ServeEngine:
                 )
 
     def _handle_queue_request(self, item: dict) -> None:
-        if not isinstance(item, dict) or item.get("type") != "serve_request":
-            raise ValueError(f"not a serve_request: {type(item).__name__}")
+        if not isinstance(item, dict):
+            raise ValueError(f"not a serve item: {type(item).__name__}")
+        kind = item.get("type")
+        if kind == "serve_kv_handoff":
+            fields = dict(item["req"])
+        elif kind == "serve_request":
+            fields = item
+        else:
+            raise ValueError(f"not a serve request/handoff: {kind!r}")
         rid = str(item["rid"])
-        reply = tuple(item["reply"])  # (host, port)
+        reply = tuple(fields["reply"])  # (host, port)
 
         def on_token(i: int, tok: int) -> None:
             self._reply(reply, {
@@ -865,20 +975,31 @@ class ServeEngine:
             })
 
         try:
+            handoff = (self._decode_handoff(item)
+                       if kind == "serve_kv_handoff" else None)
             handle = self.submit(
-                item["prompt"], int(item["max_new_tokens"]),
-                temperature=float(item.get("temperature", 0.0)),
-                eos_token_id=item.get("eos_token_id"),
-                top_k=item.get("top_k"),
-                spec=item.get("spec"),
-                deadline_s=item.get("deadline_s"),
-                on_token=on_token, rid=rid,
+                fields["prompt"], int(fields["max_new_tokens"]),
+                temperature=float(fields.get("temperature", 0.0)),
+                eos_token_id=fields.get("eos_token_id"),
+                top_k=fields.get("top_k"),
+                spec=fields.get("spec"),
+                deadline_s=fields.get("deadline_s"),
+                sample_seed=fields.get("sample_seed"),
+                on_token=on_token, rid=rid, _handoff=handoff,
             )
-        except (ValueError, TypeError) as e:
-            # TypeError covers malformed field coercion (int(None), ...):
-            # once the reply address is known, every bad request gets
-            # the typed "invalid" reply — a silent drop would leave the
-            # client blocking to its timeout.
+        except (ValueError, TypeError, KeyError, OSError) as e:
+            # TypeError covers malformed field coercion (int(None), ...);
+            # KeyError/OSError cover a torn handoff payload or a segment
+            # that vanished before the read (TTL-pruned after a very
+            # slow handoff, swept by a teardown, or a path from another
+            # host): once the reply address is known, every bad request
+            # gets the typed "invalid" reply — a silent drop would leave
+            # the client blocking to its timeout AND the router counting
+            # a phantom in-flight request against this replica forever.
+            # The done feed carries the terminal status so a router
+            # prunes it like any other.
+            with self._lock:
+                self._done_feed.append((rid, "invalid"))
             self._reply(reply, {
                 "type": "serve_done", "rid": rid, "status": "invalid",
                 "error": str(e), "tokens": [],
@@ -887,6 +1008,31 @@ class ServeEngine:
         handle.request._reply = reply
         if handle.status == "rejected":
             self._reply_done(handle.request)
+
+    def _decode_handoff(self, item: dict) -> dict:
+        """Decode a ``serve_kv_handoff`` frame's ``{"kv", "logits"}``
+        payload (shm segments are read once and unlinked —
+        consumer-owned lifetime).  Geometry drift between the prefill
+        worker and this replica is a deploy bug and fails the request
+        loudly (typed ``invalid`` reply upstream)."""
+        # Runtime import (the dist package imports this module at its
+        # own import time); decode_kv_payload is the one inverse of the
+        # worker's encode_kv_payload — an encoding change lands on both
+        # sides or neither.
+        from ray_lightning_tpu.serve.dist.handoff import decode_kv_payload
+
+        tree = decode_kv_payload(item)
+        bucket = int(item["bucket"])
+        n_blocks = int(tree["kv"]["k"].shape[1])
+        expect = self.scheduler.bucket_for(int(item["prompt_len"]))
+        if bucket != expect or n_blocks * self.config.block_size != bucket:
+            raise ValueError(
+                f"kv handoff geometry mismatch: worker bucket {bucket} "
+                f"({n_blocks} blocks of {self.config.block_size}) vs "
+                f"replica bucket {expect} — prefill worker and decode "
+                f"replica must share block_size/bucket config"
+            )
+        return tree
 
     def _reply_done(self, req) -> None:
         reply = getattr(req, "_reply", None)

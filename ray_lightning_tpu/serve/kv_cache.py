@@ -64,6 +64,7 @@ __all__ = [
     "make_slot_keys",
     "extend_block_coverage",
     "truncate_to",
+    "import_blocks",
 ]
 
 # Physical block 0 is never allocated: it is the write target for
@@ -208,6 +209,52 @@ class PagedKVCache:
     def blocks_for(self, n_tokens: int) -> int:
         """Physical blocks needed to hold ``n_tokens`` cache slots."""
         return -(-n_tokens // self.block_size)
+
+    def export_blocks(self, pool: Dict[str, jax.Array],
+                      block_ids) -> Dict[str, Any]:
+        """Gather ``block_ids``'s k/v content to HOST numpy — the
+        producer half of a disaggregated KV handoff.
+
+        A prefill worker prefills into its OWN pool blocks, exports
+        them here, frees the blocks, and ships the payload over the
+        queue plane; the consuming decode replica scatters it into
+        whatever free blocks ITS allocator hands out
+        (:func:`import_blocks`) — physical ids never cross the wire,
+        only logical block content, so producer and consumer pools
+        need not agree on anything but geometry.
+        """
+        import numpy as np
+
+        ids = np.asarray(list(block_ids), np.int32)
+        if ids.size and (ids.min() <= TRASH_BLOCK
+                         or ids.max() >= self.num_blocks):
+            raise ValueError(
+                f"export_blocks: ids outside (trash, {self.num_blocks})"
+            )
+        return {key: np.asarray(pool[key][:, ids]) for key in ("k", "v")}
+
+
+def import_blocks(
+    pool: Dict[str, jax.Array],
+    payload: Dict[str, jax.Array],
+    block_ids: jax.Array,
+) -> Dict[str, jax.Array]:
+    """Scatter an exported KV payload into ``block_ids`` of ``pool`` —
+    the consumer half of a disaggregated handoff (jittable; the engine
+    compiles one executable per bucket block count, exactly like the
+    bucketed prefill set, so steady-state imports never recompile).
+
+    ``block_ids`` come from the CONSUMER's allocator (never the trash
+    block — the allocator cannot issue it), and the caller rewrites the
+    slot's block table to these ids, so every trash-block invariant of
+    the decode/verify programs is preserved by construction.
+    """
+    return {
+        key: pool[key].at[:, block_ids].set(
+            payload[key].astype(pool[key].dtype)
+        )
+        for key in ("k", "v")
+    }
 
 
 def paged_prefill(

@@ -36,7 +36,8 @@ from ray_lightning_tpu.serve.kv_cache import (
     BlockAllocator, TRASH_BLOCK, extend_block_coverage, truncate_to,
 )
 
-__all__ = ["Request", "RequestState", "Scheduler", "default_buckets"]
+__all__ = ["Request", "RequestState", "Scheduler", "default_buckets",
+           "derive_geometry"]
 
 
 class RequestState(enum.Enum):
@@ -82,11 +83,14 @@ class Request:
     preemptions: int = 0
     # Admission ordinal — the preemption victim ordering key.
     _seq_no: int = -1
-    # Submission ordinal — the request's sampling-stream identity.
-    # Assigned ONCE at submit (never re-assigned on preemption requeue),
-    # so a recompute re-decode replays the exact same per-position key
-    # stream (kv_cache.make_slot_keys).
-    sample_seed: int = 0
+    # The request's sampling-stream identity (kv_cache.make_slot_keys).
+    # None = assigned from the submission ordinal ONCE at submit (never
+    # re-assigned on preemption requeue), so a recompute re-decode
+    # replays the exact same per-position key stream.  A PRESET value
+    # survives submit untouched — the disaggregated router assigns
+    # fleet-wide seeds so a failover re-submission to a DIFFERENT
+    # replica regenerates the identical stream.
+    sample_seed: Optional[int] = None
 
     @property
     def prompt_len(self) -> int:
@@ -116,6 +120,33 @@ def default_buckets(block_size: int, max_prompt_len: int) -> List[int]:
         if b >= max_prompt_len:
             return buckets
         b *= 2
+
+
+def derive_geometry(serve_cfg, model_cfg) -> Tuple[int, List[int]]:
+    """``(max_model_len, retained prefill buckets)`` for a serve config
+    over a model config — THE one derivation rule, shared by
+    :class:`~..engine.ServeEngine` and the disaggregated prefill
+    workers (``serve/dist/prefill.py``), so a worker and its replicas
+    can never disagree on bucket shapes (drift would fail every
+    handoff at the replica's geometry check).
+
+    A bucket longer than ``max_model_len`` cannot run (the prefill
+    indexes the positional table at ``[0, T)``), so the longest
+    RETAINED bucket bounds the admissible prompt length — the bound
+    only bites when ``max_model_len`` is not bucket-aligned
+    (docs/SERVING.md "Knobs")."""
+    max_model_len = serve_cfg.max_model_len or model_cfg.seq_len
+    buckets = list(serve_cfg.prefill_buckets or default_buckets(
+        serve_cfg.block_size, max(1, max_model_len - 1)
+    ))
+    buckets = sorted(b for b in buckets if b <= max_model_len)
+    if not buckets:
+        raise ValueError(
+            f"no prefill bucket fits max_model_len {max_model_len} "
+            f"(block_size {serve_cfg.block_size} too large? smallest "
+            f"bucket is one block)"
+        )
+    return max_model_len, buckets
 
 
 class Scheduler:
@@ -190,7 +221,8 @@ class Scheduler:
             req.state = RequestState.REJECTED
             return False
         req.state = RequestState.QUEUED
-        req.sample_seed = self._submit_counter
+        if req.sample_seed is None:
+            req.sample_seed = self._submit_counter
         self._submit_counter += 1
         self.queue.append(req)
         return True

@@ -89,11 +89,91 @@ def render_openmetrics(snapshot: Dict[str, Any],
     serve = snapshot.get("serve")
     if serve:
         lines.extend(_render_serve(serve))
+    router = snapshot.get("router")
+    if router:
+        lines.extend(_render_router(router))
     mpmd = snapshot.get("mpmd")
     if mpmd:
         lines.extend(_render_mpmd(mpmd))
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
+
+
+def _render_router(router: Dict[str, Any]) -> list:
+    """The disaggregated fleet's section (``router-live.json`` shape —
+    ``telemetry/schema.py::validate_router_snapshot``): the
+    ``rlt_serve_*`` family grown PER-REPLICA labels — occupancy,
+    in-flight, block pool, per-replica spec acceptance — plus the
+    router's own counters (routed/failovers/deaths/respawns) and
+    prefill-worker gauges."""
+    lines = []
+    per_replica = [
+        ("serve_replica_alive", "1 if the replica is serving", "alive"),
+        ("serve_replica_inflight",
+         "requests the router holds in flight on this replica",
+         "inflight"),
+        ("serve_replica_slots_active", "decode slots in flight",
+         "slots_active"),
+        ("serve_replica_num_slots", "decode program width", "num_slots"),
+        ("serve_replica_queue_depth", "requests waiting for admission",
+         "queue_depth"),
+        ("serve_replica_blocks_free", "free KV-cache blocks",
+         "blocks_free"),
+        ("serve_replica_spec_acceptance_rate",
+         "accepted / drafted on this replica", "spec_acceptance_rate"),
+        ("serve_replica_recompiles",
+         "compile events observed in the replica process",
+         "recompiles"),
+    ]
+    replicas = router.get("replicas", [])
+    for metric, help_, key in per_replica:
+        samples = []
+        for entry in replicas:
+            value = entry.get(key)
+            if key == "alive":
+                value = int(bool(value))
+            if isinstance(value, (int, float)) \
+                    and not isinstance(value, bool):
+                samples.append((entry.get("id"), value))
+        if not samples:
+            continue
+        lines.append(f"# TYPE {_PREFIX}_{metric} gauge")
+        lines.append(f"# HELP {_PREFIX}_{metric} {help_}")
+        for replica, value in samples:
+            lines.append(
+                f'{_PREFIX}_{metric}{{replica="{_esc(replica)}"}} {value}'
+            )
+    workers = router.get("workers", [])
+    samples = [
+        (w.get("id"), int(bool(w.get("alive"))), w.get("pending", 0))
+        for w in workers
+    ]
+    if samples:
+        for metric, help_, idx in (
+            ("serve_prefill_alive", "1 if the prefill worker is up", 1),
+            ("serve_prefill_pending",
+             "prompts dispatched and not yet handed off", 2),
+        ):
+            lines.append(f"# TYPE {_PREFIX}_{metric} gauge")
+            lines.append(f"# HELP {_PREFIX}_{metric} {help_}")
+            for row in samples:
+                lines.append(
+                    f'{_PREFIX}_{metric}{{worker="{_esc(row[0])}"}} '
+                    f"{row[idx]}"
+                )
+    counters = router.get("counters", {})
+    if counters:
+        lines.append(f"# TYPE {_PREFIX}_serve_router counter")
+        lines.append(
+            f"# HELP {_PREFIX}_serve_router router events by kind "
+            f"(routed, failovers, deaths, respawns)"
+        )
+        for kind in sorted(counters):
+            lines.append(
+                f'{_PREFIX}_serve_router_total{{kind="{_esc(kind)}"}} '
+                f"{counters[kind]}"
+            )
+    return lines
 
 
 def _render_mpmd(mpmd: Dict[str, Any]) -> list:

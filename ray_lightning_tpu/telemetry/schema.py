@@ -36,8 +36,11 @@ __all__ = [
     "validate_serve_request",
     "validate_serve_reply",
     "validate_serve_snapshot",
+    "validate_serve_kv_handoff",
+    "validate_router_snapshot",
     "validate_bench_serve",
     "validate_bench_spec_decode",
+    "validate_bench_serve_disagg",
     "validate_mpmd_stage_item",
     "validate_mpmd_xfer",
     "validate_mpmd_snapshot",
@@ -328,6 +331,9 @@ _SERVE_REQUEST_OPTIONAL = {
     "top_k": (int, type(None)),       # shape-static sampler truncation
     "spec": (int, type(None)),        # per-request draft count cap
     "deadline_s": (int, float, type(None)),
+    # Disaggregated serving: the router's fleet-wide sampling-stream
+    # identity (absent/None = the engine assigns its own ordinal).
+    "sample_seed": (int, type(None)),
 }
 
 # Engine → client replies: the per-token stream and the completion.
@@ -433,6 +439,135 @@ def validate_serve_snapshot(doc: Any,
         problems += _check_fields(
             summary, _SERVE_LATENCY_FIELDS, {},
             f"{where}.latency.{family}",
+        )
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# Disaggregated serving (serve/dist/): KV handoff envelope, router
+# snapshot, bench block
+# ---------------------------------------------------------------------------
+
+# The prefill worker → decode replica handoff envelope.  Like the MPMD
+# transfer frame, the bulk tensor payload (encode_tree bytes of
+# {"kv", "logits"}) rides EXACTLY ONE of data/shm and is deliberately
+# outside the schema; the request riding in "req" is a full
+# serve_request (validated recursively, sample_seed required — a
+# handoff without the router's fleet-wide seed would break failover
+# stream stability).
+_SERVE_HANDOFF_REQUIRED = {
+    "type": str,          # always "serve_kv_handoff"
+    "rid": str,
+    "bucket": int,        # prefill bucket length (tokens)
+    "prompt_len": int,
+    "req": dict,
+}
+_SERVE_HANDOFF_OPTIONAL = {
+    "data": bytes,
+    "shm": str,
+}
+
+
+def validate_serve_kv_handoff(item: Any,
+                              where: str = "serve_kv_handoff"
+                              ) -> List[str]:
+    problems = _validate_typed(
+        item, "serve_kv_handoff", _SERVE_HANDOFF_REQUIRED,
+        _SERVE_HANDOFF_OPTIONAL, where,
+    )
+    if problems:
+        return problems
+    if ("data" in item) == ("shm" in item):
+        problems.append(
+            f"{where}: exactly one of data/shm payload required"
+        )
+    if item["prompt_len"] < 1:
+        problems.append(f"{where}: prompt_len < 1")
+    if item["bucket"] < item["prompt_len"]:
+        problems.append(
+            f"{where}: bucket {item['bucket']} smaller than prompt_len "
+            f"{item['prompt_len']}"
+        )
+    problems += validate_serve_request(item["req"], f"{where}.req")
+    seed = item["req"].get("sample_seed") \
+        if isinstance(item["req"], dict) else None
+    if not isinstance(seed, int) or isinstance(seed, bool):
+        problems.append(f"{where}.req: missing/invalid sample_seed")
+    return problems
+
+
+# router-live.json (Router.snapshot — the rlt_top router pane and the
+# per-replica rlt_serve_* OpenMetrics labels parse this).
+_ROUTER_SNAPSHOT_REQUIRED = {
+    "ts": (int, float),
+    "counters": dict,
+    "replicas": list,
+    "workers": list,
+}
+_ROUTER_REPLICA_OPTIONAL = {
+    "last_beat_age_s": (int, float, type(None)),
+    "slots_active": (int, float),
+    "num_slots": (int, float),
+    "queue_depth": (int, float),
+    "blocks_free": (int, float),
+    "num_blocks": (int, float),
+    "spec_acceptance_rate": (int, float),
+    "recompiles": int,
+}
+_ROUTER_WORKER_OPTIONAL = {
+    "last_beat_age_s": (int, float, type(None)),
+}
+
+
+def _validate_router_member(entry: Any, where: str, count_key: str,
+                            optional: dict) -> List[str]:
+    if not isinstance(entry, dict):
+        return [f"{where}: expected object"]
+    problems = []
+    if not isinstance(entry.get("id"), str):
+        problems.append(f"{where}: missing/invalid id")
+    if not isinstance(entry.get("alive"), bool):
+        problems.append(f"{where}: missing/invalid alive")
+    n = entry.get(count_key)
+    if not isinstance(n, int) or isinstance(n, bool) or n < 0:
+        problems.append(f"{where}: missing/invalid {count_key}")
+    for key, types in optional.items():
+        if key in entry and not isinstance(entry[key], types):
+            problems.append(
+                f"{where}: key {key!r} has type "
+                f"{type(entry[key]).__name__}"
+            )
+    unknown = set(entry) - {"id", "alive", count_key} - set(optional)
+    if unknown:
+        problems.append(f"{where}: unknown keys {sorted(unknown)}")
+    rate = entry.get("spec_acceptance_rate")
+    if isinstance(rate, (int, float)) and not 0.0 <= rate <= 1.0:
+        problems.append(
+            f"{where}: spec_acceptance_rate {rate} outside [0, 1]"
+        )
+    return problems
+
+
+def validate_router_snapshot(doc: Any,
+                             where: str = "router_snapshot") -> List[str]:
+    problems = _check_fields(doc, _ROUTER_SNAPSHOT_REQUIRED, {}, where)
+    if problems:
+        return problems
+    for key, value in doc["counters"].items():
+        if not isinstance(value, int) or isinstance(value, bool) \
+                or value < 0:
+            problems.append(
+                f"{where}: counter {key!r} is not a non-negative int"
+            )
+    for i, entry in enumerate(doc["replicas"]):
+        problems += _validate_router_member(
+            entry, f"{where}.replicas[{i}]", "inflight",
+            _ROUTER_REPLICA_OPTIONAL,
+        )
+    for i, entry in enumerate(doc["workers"]):
+        problems += _validate_router_member(
+            entry, f"{where}.workers[{i}]", "pending",
+            _ROUTER_WORKER_OPTIONAL,
         )
     return problems
 
@@ -563,6 +698,78 @@ def validate_bench_spec_decode(block: Any,
                 "outside [0, 1]"
             )
         problems += arm_problems
+    return problems
+
+
+# The bench_serve.py disaggregated-serving block: the disagg-vs-
+# monolith A/B plus the kill-a-replica chaos arm.  The chaos arm's
+# loss accounting is required when the arm ran — a chaos block that
+# cannot say how many requests survived has failed — and
+# lost_requests is the zero-lost acceptance surface.
+_BENCH_DISAGG_REQUIRED = {
+    "replicas": int,
+    "prefill_workers": int,
+    "requests_per_sec": (int, float),
+    "recompiles_steady_state": int,
+}
+_BENCH_DISAGG_OPTIONAL = {
+    "requests": int,
+    "tokens_per_sec": (int, float, type(None)),
+    "monolith_requests_per_sec": (int, float, type(None)),
+    "vs_monolith": (int, float, type(None)),
+    "kv_imports": int,
+    "prefill_dispatches": int,
+    "p50_ttft_ms": (int, float, type(None)),
+    "p99_ttft_ms": (int, float, type(None)),
+    "chaos": dict,
+}
+_BENCH_DISAGG_CHAOS_REQUIRED = {
+    "killed_replica": str,
+    "submitted": int,
+    "completed": int,
+    "lost_requests": int,
+    "failed_over_requests": int,
+}
+_BENCH_DISAGG_CHAOS_OPTIONAL = {
+    "failover_detect_s": (int, float, type(None)),
+    "re_emitted_tokens": int,
+    "survivor_recompiles_steady_state": int,
+    "offered_rps": (int, float),
+}
+
+
+def validate_bench_serve_disagg(block: Any,
+                                where: str = "serve_disagg") -> List[str]:
+    """Validate the ``serve_disagg`` block of a bench artifact (absent
+    on pre-disaggregation rounds)."""
+    problems = _check_fields(
+        block, _BENCH_DISAGG_REQUIRED, _BENCH_DISAGG_OPTIONAL, where
+    )
+    if problems:
+        return problems
+    if block["replicas"] < 1:
+        problems.append(f"{where}: replicas must be >= 1")
+    if block["prefill_workers"] < 0:
+        problems.append(f"{where}: negative prefill_workers")
+    if block["recompiles_steady_state"] < 0:
+        problems.append(f"{where}: negative recompiles_steady_state")
+    chaos = block.get("chaos")
+    if chaos is not None:
+        chaos_problems = _check_fields(
+            chaos, _BENCH_DISAGG_CHAOS_REQUIRED,
+            _BENCH_DISAGG_CHAOS_OPTIONAL, f"{where}.chaos",
+        )
+        if not chaos_problems:
+            if chaos["lost_requests"] < 0:
+                chaos_problems.append(
+                    f"{where}.chaos: negative lost_requests"
+                )
+            if chaos["completed"] + chaos["lost_requests"] \
+                    > chaos["submitted"]:
+                chaos_problems.append(
+                    f"{where}.chaos: completed + lost > submitted"
+                )
+        problems += chaos_problems
     return problems
 
 

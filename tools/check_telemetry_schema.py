@@ -35,12 +35,15 @@ from ray_lightning_tpu.telemetry.schema import (  # noqa: E402
     validate_bench_opt_state,
     validate_bench_residual_policy,
     validate_bench_serve,
+    validate_bench_serve_disagg,
     validate_bench_spec_decode,
     validate_bench_telemetry,
     validate_chrome_trace,
     validate_flight_bundle,
     validate_mpmd_snapshot,
     validate_mpmd_xfer,
+    validate_router_snapshot,
+    validate_serve_kv_handoff,
     validate_serve_reply,
     validate_serve_request,
     validate_serve_snapshot,
@@ -373,7 +376,133 @@ def _self_test_serve() -> list:
             "self-test serve reply: validator accepted an unknown type"
         )
     problems += _self_test_spec_decode(stats)
+    problems += _self_test_serve_disagg()
     return problems
+
+
+def _self_test_serve_disagg() -> list:
+    """Disaggregated-serving producers vs their schema: a REAL handoff
+    envelope (the serve/dist frame builders), a REAL router snapshot
+    (a Router with stub members fed the real hello/beat items), and
+    the bench serve_disagg block — plus negatives (a handoff with both
+    payload forms, one without the fleet seed, a chaos block whose loss
+    accounting doesn't add up)."""
+    from ray_lightning_tpu.serve.dist.handoff import (
+        make_beat_item, make_handoff_item, make_hello_item,
+        request_fields,
+    )
+    from ray_lightning_tpu.serve.dist.router import Router
+
+    req = request_fields(
+        "abc", [1, 2, 3], 8, reply=("127.0.0.1", 12345), sample_seed=7,
+        temperature=0.7, top_k=8, spec=2,
+    )
+    handoff = make_handoff_item(req, bucket=16, data=b"\x00payload")
+    problems = validate_serve_kv_handoff(handoff, "self-test handoff")
+    problems += validate_serve_kv_handoff(
+        make_handoff_item(req, bucket=16, shm="/dev/shm/rlt-kv-1-abc"),
+        "self-test handoff shm",
+    )
+    if not validate_serve_kv_handoff(
+        {**handoff, "shm": "/dev/shm/x"}
+    ):
+        problems.append(
+            "self-test handoff: validator accepted data AND shm"
+        )
+    seedless = dict(handoff)
+    seedless["req"] = {k: v for k, v in req.items()
+                      if k != "sample_seed"}
+    if not validate_serve_kv_handoff(seedless):
+        problems.append(
+            "self-test handoff: validator accepted a handoff without "
+            "the fleet sample_seed"
+        )
+
+    class _StubHandle:
+        def __init__(self, member_id):
+            self.id = member_id
+
+        def is_alive(self):
+            return True
+
+        def kill(self):
+            pass
+
+    router = Router(lost_after_s=60.0)
+    try:
+        router.add_replica(_StubHandle("r0"))
+        router.add_prefill(_StubHandle("p0"))
+        # Real wire: hello + beat ride the beat queue's TCP loopback
+        # exactly as fleet members send them.
+        beat_handle = router.beat_handle
+        beat_handle.put(make_hello_item(
+            "decode", "r0", ("127.0.0.1", 1), num_slots=8, max_queue=64,
+            spec_k=4, max_prompt_len=64, max_model_len=128,
+            block_size=16,
+        ))
+        beat_handle.put(make_hello_item(
+            "prefill", "p0", ("127.0.0.1", 2), max_prompt_len=64,
+            max_model_len=128, block_size=16,
+        ))
+        beat_handle.put(make_beat_item(
+            "decode", "r0", done=[("x", "finished")],
+            snapshot={"ts": 0.0, "counters": {}, "latency": {},
+                      "gauges": {"slots_active": 1, "num_slots": 8,
+                                 "blocks_free": 20, "num_blocks": 33,
+                                 "queue_depth": 0,
+                                 "spec_acceptance_rate": 0.9}},
+            recompiles=12,
+        ))
+        router.poll()
+        beat_handle.close()
+        snap = router.snapshot()
+        problems += validate_router_snapshot(
+            snap, "self-test router snapshot"
+        )
+        bad = json_roundtrip(snap)
+        bad["replicas"][0]["inflight"] = -1
+        if not validate_router_snapshot(bad):
+            problems.append(
+                "self-test router snapshot: validator accepted a "
+                "negative inflight"
+            )
+    finally:
+        router.stop()
+
+    block = {
+        "replicas": 2, "prefill_workers": 1, "requests": 24,
+        "requests_per_sec": 3.5, "tokens_per_sec": 56.0,
+        "monolith_requests_per_sec": 4.0, "vs_monolith": 0.875,
+        "kv_imports": 24, "prefill_dispatches": 24,
+        "p50_ttft_ms": 40.0, "p99_ttft_ms": 120.0,
+        "recompiles_steady_state": 0,
+        "chaos": {
+            "killed_replica": "r0", "submitted": 24, "completed": 24,
+            "lost_requests": 0, "failed_over_requests": 3,
+            "failover_detect_s": 0.6, "re_emitted_tokens": 11,
+            "survivor_recompiles_steady_state": 0, "offered_rps": 4.0,
+        },
+    }
+    problems += validate_bench_serve_disagg(
+        block, "self-test bench serve_disagg"
+    )
+    if not validate_bench_serve_disagg({"replicas": 2}):
+        problems.append(
+            "self-test serve_disagg: validator accepted a block "
+            "missing the headline"
+        )
+    bad_chaos = json_roundtrip(block)
+    bad_chaos["chaos"]["completed"] = 30
+    if not validate_bench_serve_disagg(bad_chaos):
+        problems.append(
+            "self-test serve_disagg: validator accepted "
+            "completed + lost > submitted"
+        )
+    return problems
+
+
+def json_roundtrip(doc):
+    return json.loads(json.dumps(doc))
 
 
 def _self_test_spec_decode(stats) -> list:
@@ -533,6 +662,12 @@ def scan_bench_files() -> list:
         if spec is not None:  # pre-speculation rounds lack it
             problems += validate_bench_spec_decode(
                 spec, f"{name}:spec_decode"
+            )
+        disagg = (doc.get("serve_disagg")
+                  or (serve or {}).get("serve_disagg"))
+        if disagg is not None:  # pre-disaggregation rounds lack it
+            problems += validate_bench_serve_disagg(
+                disagg, f"{name}:serve_disagg"
             )
         mpmd = doc.get("mpmd")
         if mpmd is not None:  # pre-MPMD rounds lack it

@@ -67,8 +67,20 @@ RLT_REMAT_POLICY=bf16-resid timeout 1800 python bench.py \
 
 log "serve A/B: speculative decoding K sweep (spec_decode block)"
 for k in 2 4 8; do
-  RLT_SPEC_K=$k timeout 1800 python bench_serve.py \
+  RLT_SPEC_K=$k RLT_DISAGG_REPLICAS=0 timeout 1800 python bench_serve.py \
     2>&1 | tee "tools/hw_logs/${stamp}_bench_serve_spec_k${k}.log"
+done
+
+log "serve A/B: disaggregated fleet vs monolith (serve_disagg block)"
+# Replica-count sweep on real chips: each decode replica + prefill
+# worker owns its own device set, so (unlike the contended CPU arm)
+# vs_monolith here measures genuine horizontal scaling + the
+# prefill/decode interference win; the chaos arm's kill-a-replica
+# failover numbers come with each run.
+for n in 2 4; do
+  RLT_DISAGG_REPLICAS=$n RLT_DISAGG_PREFILL=1 timeout 2400 \
+    python bench_serve.py \
+    2>&1 | tee "tools/hw_logs/${stamp}_bench_serve_disagg_r${n}.log"
 done
 
 log "done — logs in tools/hw_logs/${stamp}_*.log"

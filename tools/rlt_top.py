@@ -7,7 +7,9 @@ Reads either artifact the live plane produces (docs/OBSERVABILITY.md):
 * ``heartbeats-rank<k>.jsonl`` — a worker/local fit's raw beat stream
   (queue-less LocalStrategy runs; pass the file or the telemetry dir);
 * ``mpmd-live.json`` — the MPMD pipeline strategy's per-stage
-  occupancy/bubble snapshot (MpmdStrategy fits).
+  occupancy/bubble snapshot (MpmdStrategy fits);
+* ``router-live.json`` — the disaggregated serving router's
+  per-replica occupancy + failover snapshot (serve/dist fleets).
 
 Renders a per-rank table (step, progress, step/data-wait ms, heartbeat
 age, phase, status) plus the monitor's recent events, repainted with
@@ -79,7 +81,8 @@ def load_snapshot(path: str) -> Optional[Dict[str, Any]]:
         # shadow the actively-refreshed mpmd/serve snapshot (each
         # producer rewrites its own file every refresh).
         candidates = []
-        for name in ("live.json", "serve-live.json", "mpmd-live.json"):
+        for name in ("live.json", "serve-live.json", "router-live.json",
+                     "mpmd-live.json"):
             full = os.path.join(path, name)
             try:
                 candidates.append((os.path.getmtime(full), full))
@@ -135,6 +138,52 @@ def _render_serve(serve: Dict[str, Any]) -> list:
     return lines
 
 
+def _render_router(router: Dict[str, Any]) -> list:
+    """The disaggregated-fleet pane (``router-live.json``): per-replica
+    occupancy + failover/respawn counters — the view an operator
+    watches during a replica death."""
+    c = router.get("counters", {})
+    lines = [
+        "",
+        f"router: routed {c.get('routed', 0)}"
+        f"  done {c.get('completed', 0)}"
+        f"  rej {c.get('rejected', 0)}"
+        f"  failovers {c.get('failovers', 0)}"
+        f" ({c.get('failed_over_requests', 0)} req)"
+        f"  deaths r{c.get('replica_deaths', 0)}/p"
+        f"{c.get('worker_deaths', 0)}"
+        f"  respawns {c.get('prefill_respawns', 0)}",
+        "replica  alive  inflight  slots      blocks   beat_age  "
+        "spec_acc",
+    ]
+    for r in router.get("replicas", []):
+        slots = (f"{r.get('slots_active', 0):.0f}/"
+                 f"{r.get('num_slots', 0):.0f}"
+                 if "num_slots" in r else "-")
+        blocks = (f"{r.get('blocks_free', 0):.0f} free"
+                  if "blocks_free" in r else "-")
+        acc = r.get("spec_acceptance_rate")
+        lines.append(
+            f"{str(r.get('id', '?')):>7}"
+            + f"{'yes' if r.get('alive') else 'DEAD':>7}"
+            + _fmt(r.get("inflight"), 10)
+            + slots.rjust(7)
+            + blocks.rjust(13)
+            + _fmt(r.get("last_beat_age_s"), 11)
+            + _fmt(None if acc is None else acc, 10)
+        )
+    workers = router.get("workers", [])
+    if workers:
+        lines.append(
+            "prefill: " + "  ".join(
+                f"{w.get('id')}[{'up' if w.get('alive') else 'DEAD'}"
+                f" pend {w.get('pending', 0)}]"
+                for w in workers
+            )
+        )
+    return lines
+
+
 def _render_mpmd(mpmd: Dict[str, Any]) -> list:
     """The MPMD pipeline pane (``mpmd-live.json``): schedule shape plus
     per-stage step/occupancy/bubble — the pipeline-balance view."""
@@ -172,6 +221,11 @@ def render(snapshot: Optional[Dict[str, Any]], source: str) -> str:
     if "serve" in snapshot and "ranks" not in snapshot:
         return (f"rlt_top {stamp} — serving engine\n"
                 + "\n".join(_render_serve(snapshot["serve"])) + "\n")
+    if "router" in snapshot and "ranks" not in snapshot:
+        return (f"rlt_top {stamp} — serve router "
+                f"({len(snapshot['router'].get('replicas', []))} "
+                f"replica(s))\n"
+                + "\n".join(_render_router(snapshot["router"])) + "\n")
     lines = [
         f"rlt_top {stamp} — {snapshot.get('ranks_reporting', 0)} rank(s), "
         f"{snapshot.get('beats', 0)} beats"
@@ -195,6 +249,8 @@ def render(snapshot: Optional[Dict[str, Any]], source: str) -> str:
         )
     if snapshot.get("serve"):
         lines += _render_serve(snapshot["serve"])
+    if snapshot.get("router"):
+        lines += _render_router(snapshot["router"])
     if snapshot.get("mpmd"):
         lines += _render_mpmd(snapshot["mpmd"])
     events = snapshot.get("events") or []
