@@ -48,6 +48,12 @@ def main() -> None:
                         help="dedicated prefill workers shipping KV "
                         "handoffs to the decode replicas (0 = replicas "
                         "prefill locally)")
+    parser.add_argument("--trace", action="store_true",
+                        help="request-scoped distributed tracing: "
+                        "every component exports span JSONL into the "
+                        "telemetry dir; the example stitches them and "
+                        "prints each request's critical path "
+                        "(docs/OBSERVABILITY.md § Distributed tracing)")
     parser.add_argument("--smoke-test", action="store_true")
     args = parser.parse_args()
     if args.smoke_test:
@@ -80,6 +86,17 @@ def main() -> None:
         draft_kw = dict(draft_module=draft, draft_params=draft_params)
     serve_cfg = ServeConfig(num_slots=args.num_slots, block_size=16,
                             spec_k=args.spec)
+    telemetry_dir = "rlt_logs/serve_example/telemetry"
+    trace_dir = telemetry_dir if args.trace else None
+    if trace_dir:
+        # Fresh traces per run: stale exports from a previous run would
+        # merge into this run's stitched report (trace_stitch reads the
+        # whole dir by design).
+        import glob as _glob
+        import os as _os
+
+        for stale in _glob.glob(f"{trace_dir}/trace-*.json*"):
+            _os.unlink(stale)
     engine = fleet = None
     if args.replicas > 1 or args.prefill_workers > 0:
         # Disaggregated: N engines (+ M prefill workers) behind the
@@ -90,14 +107,14 @@ def main() -> None:
         fleet = launch_inproc_fleet(
             module, trainer.params, serve_cfg,
             n_replicas=args.replicas, n_prefill=args.prefill_workers,
-            telemetry_dir="rlt_logs/serve_example/telemetry",
+            telemetry_dir=telemetry_dir, trace_dir=trace_dir,
             **draft_kw,
         )
         handle = fleet.queue_handle()
     else:
         engine = ServeEngine(
             module, trainer.params, serve_cfg,
-            telemetry_dir="rlt_logs/serve_example/telemetry",
+            telemetry_dir=telemetry_dir, trace_dir=trace_dir,
             **draft_kw,
         ).start()
         handle = engine.queue_handle()
@@ -156,6 +173,17 @@ def main() -> None:
             fleet.close()
         else:
             engine.stop()
+
+    if args.trace:
+        # Components exported their span JSONL at teardown; stitch and
+        # show where each request's TTFT went (same path as
+        # `python tools/trace_stitch.py <telemetry-dir>`).
+        from ray_lightning_tpu.telemetry import trace_collect
+
+        spans = trace_collect.load_trace_dir(trace_dir)
+        print("distributed trace "
+              f"({len(spans)} spans — merge with tools/trace_stitch.py):")
+        print(trace_collect.format_report(spans, slowest_k=3))
 
 
 main()

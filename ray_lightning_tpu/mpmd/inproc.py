@@ -61,6 +61,7 @@ def run_inproc_pipeline_fit(
     interleave: int = 1,
     device_groups: Optional[List[list]] = None,
     recv_timeout_s: float = 120.0,
+    trace_dir: Optional[str] = None,
 ) -> Dict[str, Any]:
     """Run a full MPMD fit with stage workers as threads; returns
     per-step losses (loss worker), per-worker steady-state stats, and
@@ -96,6 +97,7 @@ def run_inproc_pipeline_fit(
             send_next=LocalChannel(mailboxes[(p + 1) % n_workers]),
             send_prev=LocalChannel(mailboxes[(p - 1) % n_workers]),
             recv_timeout_s=recv_timeout_s,
+            trace_dir=trace_dir,
         ))
         runners[p].init_state(full_params)
 

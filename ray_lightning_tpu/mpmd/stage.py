@@ -74,6 +74,7 @@ class StageRunner:
         send_prev=None,
         recv_timeout_s: float = 120.0,
         keep_ckpts: int = 2,
+        trace_dir: Optional[str] = None,
     ):
         if plan.n_stages != n_workers * interleave:
             raise ValueError(
@@ -123,6 +124,23 @@ class StageRunner:
         self._steps_run = 0
         self._acc: Optional[List[Any]] = None
         self._compiled = False
+        # Distributed tracing (docs/OBSERVABILITY.md): the EMBED worker
+        # mints each step's trace identity and stamps it on its SEND
+        # frames; downstream workers adopt it from their first traced
+        # RECV — so a whole step's cross-worker instruction spans share
+        # one trace_id without any side-channel agreement.  Wall-clock
+        # spans, exported as trace-mpmd-stage<k>.jsonl at fit end.
+        import time as _time
+        import uuid as _uuid
+
+        from ray_lightning_tpu.telemetry.spans import SpanTracer
+
+        self._trace_dir = trace_dir
+        self._trace_run = _uuid.uuid4().hex[:8]
+        self.tracer = SpanTracer(
+            enabled=trace_dir is not None, maxlen=65536, rank=worker,
+            clock=_time.time,
+        )
 
     # -- program construction ----------------------------------------------
     def _build_programs(self) -> None:
@@ -365,34 +383,56 @@ class StageRunner:
 
         if self.state is None:
             raise RuntimeError("init_state/load_state must run first")
-        for step in range(start_step, steps):
-            reason = drain_check() if drain_check is not None else None
-            if reason:
-                ckpt = None
-                if restart_dir is not None:
-                    self.write_checkpoint(restart_dir, step)
-                    ckpt = os.path.join(
-                        restart_dir, f"mpmd-step{step:08d}"
+        try:
+            for step in range(start_step, steps):
+                reason = (drain_check() if drain_check is not None
+                          else None)
+                if reason:
+                    ckpt = None
+                    if restart_dir is not None:
+                        self.write_checkpoint(restart_dir, step)
+                        ckpt = os.path.join(
+                            restart_dir, f"mpmd-step{step:08d}"
+                        )
+                    raise PreemptedError(
+                        f"stage worker {self.worker} drained at step "
+                        f"{step}",
+                        checkpoint=ckpt, step=step, rank=self.worker,
+                        reason=reason,
                     )
-                raise PreemptedError(
-                    f"stage worker {self.worker} drained at step {step}",
-                    checkpoint=ckpt, step=step, rank=self.worker,
-                    reason=reason,
-                )
-            _chaos.fire("step", step=step, epoch=0, rank=self.worker)
-            logs = self._run_opt_step(step, micro_batches_for(step))
-            if self.hosts_loss:
-                self.losses.append(float(logs.get("loss", float("nan"))))
-            if (restart_dir is not None
-                    and (step + 1) % max(ckpt_every, 1) == 0):
-                self.write_checkpoint(restart_dir, step + 1)
-            if on_step is not None:
-                on_step(step, logs)
+                _chaos.fire("step", step=step, epoch=0, rank=self.worker)
+                logs = self._run_opt_step(step, micro_batches_for(step))
+                if self.hosts_loss:
+                    self.losses.append(
+                        float(logs.get("loss", float("nan")))
+                    )
+                if (restart_dir is not None
+                        and (step + 1) % max(ckpt_every, 1) == 0):
+                    self.write_checkpoint(restart_dir, step + 1)
+                if on_step is not None:
+                    on_step(step, logs)
+        finally:
+            self.export_trace()
         return {
             "losses": self.losses,
             "step_summaries": self.step_summaries,
             "stats": self.fit_stats(),
         }
+
+    def export_trace(self) -> Optional[str]:
+        """Write this worker's span JSONL (a drain/crash exits through
+        here too — partial timelines still stitch)."""
+        if self._trace_dir is None or not self.tracer.events():
+            return None
+        path = os.path.join(
+            self._trace_dir, f"trace-mpmd-stage{self.worker}.jsonl"
+        )
+        try:
+            os.makedirs(self._trace_dir, exist_ok=True)
+            self.tracer.export_jsonl(path)
+        except OSError:
+            return None
+        return path
 
     def _run_opt_step(
         self, step: int, micro: Optional[List[Any]]
@@ -415,20 +455,31 @@ class StageRunner:
         stash_dx: Dict[Any, Any] = {}
         mb_losses: List[float] = []
         n_workers = self.n_workers
+        # The step's distributed-trace context: minted here on the
+        # embed worker, adopted from the first traced RECV elsewhere.
+        step_ctx = None
+        if self.tracer.enabled and self.hosts_embed:
+            from ray_lightning_tpu.telemetry.propagate import root_context
+
+            step_ctx = root_context(f"mpmd-{self._trace_run}-s{step}")
 
         for instr in self.stream:
             op, mb, c = instr.op, instr.mb, instr.chunk
             blocked = 0.0
             t0 = time.perf_counter()
             if op == sched.RECV_ACT:
-                tree, blocked = self.mailbox.recv(
+                tree, blocked, w_trace = self.mailbox.recv_traced(
                     ("act", step, mb, c), timeout=self.recv_timeout_s
                 )
+                if step_ctx is None:
+                    step_ctx = self._adopt_trace(w_trace)
                 stash_x[(c, mb)] = self._batch_placed(tree)
             elif op == sched.RECV_GRAD:
-                tree, blocked = self.mailbox.recv(
+                tree, blocked, w_trace = self.mailbox.recv_traced(
                     ("grad", step, mb, c), timeout=self.recv_timeout_s
                 )
+                if step_ctx is None:
+                    step_ctx = self._adopt_trace(w_trace)
                 stash_dy[(c, mb)] = self._batch_placed(tree)
             elif op == sched.FWD:
                 g = self.stages[c]
@@ -455,7 +506,7 @@ class StageRunner:
                 g = self.stages[c]
                 self.send_next.send(
                     "act", step, mb, jax.device_get(y),
-                    chunk=(g + 1) // n_workers,
+                    chunk=(g + 1) // n_workers, trace=step_ctx,
                 )
             elif op == sched.BWD:
                 g = self.stages[c]
@@ -485,7 +536,7 @@ class StageRunner:
                 g = self.stages[c]
                 self.send_prev.send(
                     "grad", step, mb, jax.device_get(dx),
-                    chunk=(g - 1) // n_workers,
+                    chunk=(g - 1) // n_workers, trace=step_ctx,
                 )
             elif op == sched.UPDATE:
                 self.state = self._apply(self.state, self._acc)
@@ -499,6 +550,22 @@ class StageRunner:
                 "op": op, "mb": mb, "t0": t0, "t1": t1,
                 "blocked_s": blocked,
             })
+            if step_ctx is not None:
+                # Per-instruction span under the worker's step span:
+                # the stitched view's compute-vs-blocked-recv lanes.
+                from ray_lightning_tpu.telemetry.propagate import (
+                    child_context, trace_args,
+                )
+
+                wall_t1 = time.time()
+                self.tracer.record(
+                    op.lower(), wall_t1 - (t1 - t0), t1 - t0,
+                    args=trace_args(
+                        child_context(step_ctx), step=step, mb=mb,
+                        stage=self.stages[c], worker=self.worker,
+                        blocked_s=round(blocked, 6),
+                    ),
+                )
             if self._steps_run > 0 and op in (
                     sched.FWD, sched.BWD, sched.SEND_ACT,
                     sched.SEND_GRAD):
@@ -508,10 +575,45 @@ class StageRunner:
         summary = sched.bubble_from_timeline(timeline)
         summary["step"] = step
         self.step_summaries.append(summary)
+        if step_ctx is not None and timeline:
+            from ray_lightning_tpu.telemetry.propagate import trace_args
+
+            wall_end = time.time()
+            dur = timeline[-1]["t1"] - timeline[0]["t0"]
+            self.tracer.record(
+                "mpmd_step" if self.hosts_embed else "mpmd_stage_step",
+                wall_end - dur, dur,
+                args=trace_args(
+                    step_ctx, step=step, worker=self.worker,
+                    busy_s=round(summary.get("busy_s", 0.0), 6),
+                    blocked_s=round(summary.get("blocked_s", 0.0), 6),
+                    bubble_fraction=round(
+                        summary.get("bubble_fraction", 0.0), 6),
+                ),
+            )
         logs: Dict[str, Any] = dict(summary)
         if self.hosts_loss and mb_losses:
             logs["loss"] = float(np.mean(mb_losses))
         return logs
+
+    def _adopt_trace(self, envelope) -> Optional[Any]:
+        """Adopt the step's trace identity from an upstream frame's
+        envelope: this worker's step span id is DERIVED
+        (``<trace_id>.w<worker>``, parent = the embed worker's root) so
+        the whole fleet agrees without a registry."""
+        if not self.tracer.enabled or not envelope:
+            return None
+        from ray_lightning_tpu.telemetry.propagate import (
+            TraceContext, extract,
+        )
+
+        ctx = extract({"trace": envelope})
+        if ctx is None:
+            return None
+        return TraceContext(
+            ctx.trace_id, f"{ctx.trace_id}.w{self.worker}",
+            ctx.root_span_id,
+        )
 
     def op_costs(self) -> Dict[str, float]:
         """Median steady-state per-op durations (seconds) — the inputs

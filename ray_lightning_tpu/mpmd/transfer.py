@@ -97,16 +97,21 @@ def resolve_payload(item: Dict[str, Any], unlink: bool = True) -> bytes:
 class Mailbox:
     """Keyed rendezvous: the pump thread ``deliver``s payloads as they
     arrive; ``recv`` blocks until its key shows up (and reports how long
-    it actually waited — the bubble signal)."""
+    it actually waited — the bubble signal).
+
+    ``deliver`` optionally files the frame's trace envelope alongside
+    the payload; :meth:`recv_traced` surfaces it so a stage can adopt
+    the step's distributed-trace identity from its upstream neighbor."""
 
     def __init__(self):
         self._items: Dict[Tuple, Any] = {}
         self._cond = threading.Condition()
         self._error: Optional[BaseException] = None
 
-    def deliver(self, key: Tuple, payload: Any) -> None:
+    def deliver(self, key: Tuple, payload: Any,
+                trace: Optional[Dict[str, Any]] = None) -> None:
         with self._cond:
-            self._items[key] = payload
+            self._items[key] = (payload, trace)
             self._cond.notify_all()
 
     def fail(self, exc: BaseException) -> None:
@@ -121,6 +126,15 @@ class Mailbox:
 
     def recv(self, key: Tuple, timeout: float = 120.0) -> Tuple[Any, float]:
         """Blocking receive → ``(payload, blocked_seconds)``."""
+        payload, blocked, _ = self.recv_traced(key, timeout)
+        return payload, blocked
+
+    def recv_traced(
+        self, key: Tuple, timeout: float = 120.0
+    ) -> Tuple[Any, float, Optional[Dict[str, Any]]]:
+        """Blocking receive → ``(payload, blocked_seconds, trace)``
+        where ``trace`` is the sender's trace envelope (None on
+        untraced frames)."""
         deadline = time.monotonic() + timeout
         t0 = time.perf_counter()
         with self._cond:
@@ -136,8 +150,8 @@ class Mailbox:
                         f"waiting for {key} (peer stage dead or wedged?)"
                     )
                 self._cond.wait(min(remaining, 1.0))
-            payload = self._items.pop(key)
-        return payload, time.perf_counter() - t0
+            payload, trace = self._items.pop(key)
+        return payload, time.perf_counter() - t0, trace
 
 
 class StageInbox:
@@ -185,7 +199,8 @@ class StageInbox:
             item["kind"], int(item["step"]), int(item["mb"]),
             int(item.get("chunk", 0)),
         )
-        self.mailbox.deliver(key, decode_tree(resolve_payload(item)))
+        self.mailbox.deliver(key, decode_tree(resolve_payload(item)),
+                             trace=item.get("trace"))
 
     def close(self) -> None:
         self._closed.set()
@@ -202,13 +217,20 @@ class LocalChannel:
         self.bytes_sent = 0
 
     def send(self, kind: str, step: int, mb: int, tree: Any,
-             chunk: int = 0) -> None:
+             chunk: int = 0, trace=None) -> None:
         # Round-trip through the real encoder: in-process parity runs
-        # must exercise the same host-ification the wire path does.
+        # must exercise the same host-ification the wire path does
+        # (the trace envelope rides the same inject the wire uses).
         payload = encode_tree(tree)
         self.bytes_sent += len(payload)
+        envelope: Dict[str, Any] = {}
+        if trace is not None:
+            from ray_lightning_tpu.telemetry.propagate import inject
+
+            inject(envelope, trace)
         self._mailbox.deliver(
-            (kind, step, mb, chunk), decode_tree(payload)
+            (kind, step, mb, chunk), decode_tree(payload),
+            trace=envelope.get("trace"),
         )
 
 
@@ -232,13 +254,17 @@ class QueueChannel:
         self.shm_sends = 0
 
     def send(self, kind: str, step: int, mb: int, tree: Any,
-             chunk: int = 0) -> None:
+             chunk: int = 0, trace=None) -> None:
         payload = encode_tree(tree)
         self.bytes_sent += len(payload)
         item: Dict[str, Any] = {
             "type": "mpmd_xfer", "kind": kind, "step": int(step),
             "mb": int(mb), "chunk": int(chunk),
         }
+        if trace is not None:
+            from ray_lightning_tpu.telemetry.propagate import inject
+
+            inject(item, trace)
         if self._store is not None and len(payload) >= self._shm_threshold:
             item["shm"] = self._store.put(payload)
             self.shm_sends += 1

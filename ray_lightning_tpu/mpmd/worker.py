@@ -159,6 +159,7 @@ def _stage_execute_remote(
         send_next=send_next,
         send_prev=send_prev,
         recv_timeout_s=task.get("recv_timeout_s", 120.0),
+        trace_dir=task.get("trace_dir"),
     )
 
     start_step = 0

@@ -1576,6 +1576,7 @@ class MpmdStrategy(TpuStrategy):
         recv_timeout_s: float = 120.0,
         ckpt_every_n_steps: int = 1,
         tx_factory: Optional[Callable[[], Any]] = None,
+        trace_dir: Optional[str] = None,
         **kwargs: Any,
     ):
         from ray_lightning_tpu.mpmd.schedule import SCHEDULES
@@ -1625,6 +1626,11 @@ class MpmdStrategy(TpuStrategy):
         self.recv_timeout_s = recv_timeout_s
         self.ckpt_every_n_steps = ckpt_every_n_steps
         self.tx_factory = tx_factory
+        # Distributed step tracing (docs/OBSERVABILITY.md): a SHARED
+        # path (same-host fleets or a shared mount) each stage actor
+        # exports trace-mpmd-stage<k>.jsonl into at fit end; None =
+        # tracing off, nothing installed.
+        self.trace_dir = trace_dir
         # Post-fit pipeline report (schedule, per-stage occupancy, the
         # measured-cost bubble decomposition) — the mpmd analogue of
         # trainer.telemetry_report.
@@ -1770,6 +1776,7 @@ class MpmdStrategy(TpuStrategy):
                 and config.max_steps > 0 else None
             ),
             "tx_factory": self.tx_factory,
+            "trace_dir": self.trace_dir,
         }
         task_ref = self._backend.put(task)
         queue = self._backend.create_queue()

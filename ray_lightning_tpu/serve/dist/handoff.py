@@ -29,12 +29,16 @@ Everything here is jax-free given payload bytes, so the schema gate
 
 from __future__ import annotations
 
+import logging
+import queue as _pyqueue
+import threading
 import time
-from typing import Any, Dict, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 __all__ = [
     "KV_SEGMENT_PREFIX",
     "CachedSender",
+    "MemberOutbox",
     "request_fields",
     "make_dispatch_item",
     "make_handoff_item",
@@ -43,6 +47,8 @@ __all__ = [
     "encode_kv_payload",
     "decode_kv_payload",
 ]
+
+log = logging.getLogger(__name__)
 
 
 class CachedSender:
@@ -74,6 +80,122 @@ class CachedSender:
             handle.close()
         self._handles.clear()
 
+
+class MemberOutbox:
+    """Per-destination send thread with a bounded queue — the router's
+    control plane must never block inside a TCP connect to a wedged
+    member (the PR-12 documented limit: a blackholed host held the
+    router lock for a full ~60s connect timeout, freezing every client
+    of the fleet).  Sends enqueue in O(1); the outbox thread pays the
+    network; a send failure (or a FULL queue — a member that stopped
+    draining for ``maxsize`` frames is wedged) reports through
+    ``on_error`` exactly once per incident, which the router routes
+    into its existing death/failover path.
+
+    ``put`` takes an optional ``on_sent(enqueue_ts)`` callback fired
+    after the wire write completes — the tracer's ``placement`` span is
+    recorded there, so it measures REAL dispatch latency (queue wait +
+    connect + serialize + send), not the lock convoy the synchronous
+    sender measured."""
+
+    def __init__(self, addr: Tuple[str, int],
+                 on_error: Optional[Callable[[BaseException], None]] = None,
+                 maxsize: int = 256):
+        self.addr = (addr[0], int(addr[1]))
+        self._on_error = on_error
+        self._q: _pyqueue.Queue = _pyqueue.Queue(maxsize=maxsize)
+        self._sender = CachedSender()
+        self._closed = threading.Event()
+        self._dead = False
+        self._sending = False
+        # Idle-reap bookkeeping (the router closes outboxes that have
+        # not sent for a while — clients come and go; their reply
+        # lanes must not accumulate threads forever).
+        self.last_used = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"rlt-outbox-{self.addr[0]}:{self.addr[1]}",
+        )
+        self._thread.start()
+
+    def put(self, item: Dict[str, Any],
+            on_sent: Optional[Callable[[float], None]] = None) -> None:
+        """Enqueue one frame.  Raises ``ConnectionError`` when the
+        outbox is already dead or its queue is full — the caller's
+        existing (OSError, ConnectionError) handling then runs the same
+        death path a synchronous send failure did."""
+        if self._dead or self._closed.is_set():
+            raise ConnectionError(f"outbox to {self.addr} is closed")
+        self.last_used = time.monotonic()
+        try:
+            self._q.put_nowait((item, on_sent, time.monotonic()))
+        except _pyqueue.Full:
+            raise ConnectionError(
+                f"outbox to {self.addr} is full ({self._q.maxsize} "
+                f"frames undrained — member wedged?)"
+            ) from None
+
+    def _run(self) -> None:
+        while not self._closed.is_set():
+            try:
+                item, on_sent, t_enq = self._q.get(timeout=0.2)
+            except _pyqueue.Empty:
+                continue
+            self._sending = True
+            try:
+                try:
+                    self._sender.put(self.addr, item)
+                except Exception as e:  # noqa: BLE001 - any send
+                    # failure marks the member; the router decides
+                    # what it means
+                    self._dead = True
+                    if self._on_error is not None:
+                        try:
+                            self._on_error(e)
+                        except Exception:  # noqa: BLE001 - observer bug
+                            log.warning("outbox on_error raised",
+                                        exc_info=True)
+                    return
+                if on_sent is not None:
+                    try:
+                        on_sent(t_enq)
+                    except Exception:  # noqa: BLE001 - tracing is
+                        # best-effort; a raising observer must not
+                        # kill the lane
+                        log.warning("outbox on_sent raised",
+                                    exc_info=True)
+            finally:
+                self._sending = False
+
+    @property
+    def depth(self) -> int:
+        return self._q.qsize()
+
+    @property
+    def pending(self) -> int:
+        """Frames enqueued or mid-send (the flush condition)."""
+        return self._q.qsize() + (1 if self._sending else 0)
+
+    def close(self, drain_s: float = 2.0) -> None:
+        """Stop the thread, best-effort draining queued frames first
+        (a planned teardown should not drop the last replies).  Safe to
+        call from the outbox thread itself (the error-callback path),
+        and NEVER joins a dead box's thread — that thread may be
+        blocked on the caller's own lock inside on_error, and it exits
+        on its own the moment the callback returns (joining it from
+        under the router lock would burn the full join timeout as a
+        control-plane stall)."""
+        deadline = time.monotonic() + drain_s
+        while (not self._dead and self._q.qsize()
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        self._closed.set()
+        if (not self._dead
+                and threading.current_thread() is not self._thread):
+            self._thread.join(timeout=5)
+        self._sender.close()
+
+
 # Serve-plane handoff segments get their own family so teardown sweeps
 # (engine close, router failover, actor kill) can collect dead prefill
 # handoffs without touching a co-resident MPMD fit's rlt-seg frames.
@@ -92,11 +214,13 @@ def request_fields(
     top_k: Optional[int] = None,
     spec: Optional[int] = None,
     deadline_s: Optional[float] = None,
+    trace=None,
 ) -> Dict[str, Any]:
     """The canonical request dict that rides inside dispatch/handoff
     frames (a ``serve_request`` body with the router's fleet-wide
-    ``sample_seed`` attached)."""
-    return {
+    ``sample_seed`` — and, on tracing routers, the request's
+    ``TraceContext`` — attached)."""
+    item = {
         "type": "serve_request",
         "rid": str(rid),
         "prompt": [int(t) for t in prompt],
@@ -109,6 +233,11 @@ def request_fields(
         "sample_seed": int(sample_seed),
         "reply": list(reply),
     }
+    if trace is not None:
+        from ray_lightning_tpu.telemetry.propagate import inject
+
+        inject(item, trace)
+    return item
 
 
 def make_dispatch_item(req: Dict[str, Any], kv_to: Tuple[str, int],
@@ -134,9 +263,13 @@ def make_handoff_item(
     *,
     data: Optional[bytes] = None,
     shm: Optional[str] = None,
+    trace=None,
 ) -> Dict[str, Any]:
     """Prefill worker → decode replica: the prefilled request.  Exactly
-    one of ``data``/``shm`` carries the ``encode_kv_payload`` blob."""
+    one of ``data``/``shm`` carries the ``encode_kv_payload`` blob.
+    ``trace`` (the worker's prefill-span context) stamps the envelope
+    with the wall-clock send time the replica books
+    ``handoff_transfer`` from."""
     if (data is None) == (shm is None):
         raise ValueError("exactly one of data/shm payload required")
     item: Dict[str, Any] = {
@@ -150,6 +283,10 @@ def make_handoff_item(
         item["data"] = data
     else:
         item["shm"] = shm
+    if trace is not None:
+        from ray_lightning_tpu.telemetry.propagate import inject
+
+        inject(item, trace)
     return item
 
 

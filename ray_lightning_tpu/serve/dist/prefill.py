@@ -26,7 +26,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from ray_lightning_tpu.serve.dist.handoff import (
     KV_SEGMENT_PREFIX, CachedSender, encode_kv_payload, make_beat_item,
@@ -56,7 +56,8 @@ class PrefillRunner:
     def __init__(self, worker_id: str, module, params, serve_cfg,
                  beat_handle, *, beat_s: float = 0.25,
                  shm_threshold: int = _SHM_THRESHOLD_BYTES,
-                 segment_ttl_s: float = _SEGMENT_TTL_S):
+                 segment_ttl_s: float = _SEGMENT_TTL_S,
+                 trace_dir: Optional[str] = None):
         import jax
         import jax.numpy as jnp
 
@@ -106,6 +107,16 @@ class PrefillRunner:
         self._failed: List[Tuple[str, str]] = []
         self._last_beat = 0.0
         self.prefills = 0
+        # Distributed tracing: worker-side spans continue the router-
+        # stamped request context (SpanTracer.start_remote), exported
+        # at close for trace_collect.py to stitch.
+        from ray_lightning_tpu.telemetry.spans import SpanTracer
+
+        self._trace_dir = trace_dir
+        self.tracer = SpanTracer(
+            enabled=trace_dir is not None, maxlen=16384, rank=0,
+            clock=time.time,
+        )
         # Hard-kill simulation (InprocPrefill.kill(hard=True)): a dead
         # process sends no final beat — suppress the closing flag so
         # the router takes the death path, not the planned-drain one.
@@ -205,27 +216,46 @@ class PrefillRunner:
         n_blocks = bucket // self.serve_cfg.block_size
         ids = self.cache.allocator.alloc(n_blocks)
         assert ids is not None, "worker pool sized for the largest bucket"
-        try:
-            padded = np.zeros((bucket,), np.int32)
-            padded[: len(prompt)] = prompt
-            logits, self._pool = self._prefill_fn(
-                self.params, self._pool, jnp.asarray(padded),
-                np.int32(len(prompt)), jnp.asarray(np.asarray(ids,
-                                                              np.int32)),
-            )
-            kv = self.cache.export_blocks(self._pool, ids)
-        finally:
-            self.cache.allocator.free(ids)
-        payload = encode_kv_payload(kv, np.asarray(logits))
-        shm_path = None
-        if item.get("same_host", False) \
-                and len(payload) >= self._shm_threshold:
-            shm_path = self._segment_store().put(payload)
-            with self._feed_lock:  # beat thread prunes concurrently
-                self._live_segments.append((shm_path, time.monotonic()))
-            out = make_handoff_item(req, bucket, shm=shm_path)
-        else:
-            out = make_handoff_item(req, bucket, data=payload)
+        req_ctx = None
+        if self.tracer.enabled:
+            from ray_lightning_tpu.telemetry.propagate import extract
+
+            req_ctx = extract(req)  # the router-stamped trace root
+        with self.tracer.start_remote(
+                req_ctx, "prefill_compute", rid=rid,
+                worker=self.worker_id, bucket=bucket) as pf_span:
+            try:
+                padded = np.zeros((bucket,), np.int32)
+                padded[: len(prompt)] = prompt
+                logits, self._pool = self._prefill_fn(
+                    self.params, self._pool, jnp.asarray(padded),
+                    np.int32(len(prompt)), jnp.asarray(np.asarray(ids,
+                                                                  np.int32)),
+                )
+                # export_blocks device_gets the blocks, so the span
+                # closes on a SYNCED device — real prefill compute.
+                kv = self.cache.export_blocks(self._pool, ids)
+            finally:
+                self.cache.allocator.free(ids)
+        with self.tracer.start_remote(
+                pf_span.ctx, "handoff_send", rid=rid) as send_span:
+            payload = encode_kv_payload(kv, np.asarray(logits))
+            # The envelope carries the WORKER's span + send timestamp:
+            # the consuming replica books handoff_transfer from it and
+            # its admission spans parent under this worker's spans.
+            handoff_trace = send_span.ctx or pf_span.ctx
+            shm_path = None
+            if item.get("same_host", False) \
+                    and len(payload) >= self._shm_threshold:
+                shm_path = self._segment_store().put(payload)
+                with self._feed_lock:  # beat thread prunes concurrently
+                    self._live_segments.append((shm_path,
+                                                time.monotonic()))
+                out = make_handoff_item(req, bucket, shm=shm_path,
+                                        trace=handoff_trace)
+            else:
+                out = make_handoff_item(req, bucket, data=payload,
+                                        trace=handoff_trace)
         try:
             self._put(tuple(item["kv_to"]), out)
         except (OSError, ConnectionError) as e:
@@ -299,6 +329,17 @@ class PrefillRunner:
     def close(self, consume_grace_s: float = 5.0) -> None:
         self._inbox.shutdown()
         self._out.close()
+        if self._trace_dir is not None and self.tracer.events():
+            import os
+
+            try:
+                os.makedirs(self._trace_dir, exist_ok=True)
+                self.tracer.export_jsonl(
+                    f"{self._trace_dir}/trace-prefill-"
+                    f"{self.worker_id}.jsonl"
+                )
+            except OSError:
+                pass  # a full disk must not fail the teardown
         if self._store is None:
             return
         # A handoff already DELIVERED to a busy replica's inbox may not

@@ -128,7 +128,8 @@ def run_decode_replica(replica_id: str, module, params,
                        cfg_kwargs: Dict[str, Any],
                        beat_addr: Tuple[str, int],
                        beat_s: float = 0.25,
-                       draft_module=None, draft_params=None) -> dict:
+                       draft_module=None, draft_params=None,
+                       trace_dir: Optional[str] = None) -> dict:
     """Actor main for one decode replica: serve until the driver sends
     a drain over the control lane (``ProcessActor.request_drain``) or
     kills the process.  Returns the final SLO snapshot."""
@@ -139,6 +140,7 @@ def run_decode_replica(replica_id: str, module, params,
     engine = ServeEngine(
         module, params, ServeConfig(**cfg_kwargs),
         draft_module=draft_module, draft_params=draft_params,
+        trace_dir=trace_dir, trace_name=replica_id,
     )
     runner = DecodeReplicaRunner(
         replica_id, engine, QueueHandle(*beat_addr), beat_s=beat_s
@@ -149,7 +151,8 @@ def run_decode_replica(replica_id: str, module, params,
 
 def run_prefill_worker(worker_id: str, module, params, serve_cfg,
                        beat_addr: Tuple[str, int],
-                       beat_s: float = 0.25) -> int:
+                       beat_s: float = 0.25,
+                       trace_dir: Optional[str] = None) -> int:
     """Actor main for one prefill worker.  Returns prompts prefilled."""
     from ray_lightning_tpu.cluster.queue import QueueHandle
     from ray_lightning_tpu.fault import drain
@@ -157,7 +160,7 @@ def run_prefill_worker(worker_id: str, module, params, serve_cfg,
 
     runner = PrefillRunner(
         worker_id, module, params, serve_cfg,
-        QueueHandle(*beat_addr), beat_s=beat_s,
+        QueueHandle(*beat_addr), beat_s=beat_s, trace_dir=trace_dir,
     )
     runner.run(stop=drain.drain_requested)
     return runner.prefills
@@ -216,13 +219,14 @@ class InprocPrefill:
     role = "prefill"
 
     def __init__(self, worker_id: str, module, params, serve_cfg,
-                 beat_handle, beat_s: float = 0.2):
+                 beat_handle, beat_s: float = 0.2,
+                 trace_dir: Optional[str] = None):
         from ray_lightning_tpu.serve.dist.prefill import PrefillRunner
 
         self.id = worker_id
         self.runner = PrefillRunner(
             worker_id, module, params, serve_cfg, beat_handle,
-            beat_s=beat_s,
+            beat_s=beat_s, trace_dir=trace_dir,
         )
         self._stop = threading.Event()
         self._dead = False
@@ -284,11 +288,11 @@ class ActorReplica(_ActorMember):
     def __init__(self, replica_id: str, module, params,
                  cfg_kwargs: Dict[str, Any], beat_addr: Tuple[str, int],
                  beat_s: float = 0.25, draft_module=None,
-                 draft_params=None):
+                 draft_params=None, trace_dir: Optional[str] = None):
         super().__init__(replica_id, "rlt-serve-replica")
         self._fut = self.actor.submit(
             run_decode_replica, replica_id, module, params, cfg_kwargs,
-            beat_addr, beat_s, draft_module, draft_params,
+            beat_addr, beat_s, draft_module, draft_params, trace_dir,
         )
 
 
@@ -296,11 +300,12 @@ class ActorPrefill(_ActorMember):
     role = "prefill"
 
     def __init__(self, worker_id: str, module, params, serve_cfg,
-                 beat_addr: Tuple[str, int], beat_s: float = 0.25):
+                 beat_addr: Tuple[str, int], beat_s: float = 0.25,
+                 trace_dir: Optional[str] = None):
         super().__init__(worker_id, "rlt-serve-prefill")
         self._fut = self.actor.submit(
             run_prefill_worker, worker_id, module, params, serve_cfg,
-            beat_addr, beat_s,
+            beat_addr, beat_s, trace_dir,
         )
 
 
@@ -353,34 +358,41 @@ def launch_inproc_fleet(module, params, serve_cfg, *, n_replicas: int = 2,
                         n_prefill: int = 0, draft_module=None,
                         draft_params=None, beat_s: float = 0.1,
                         lost_after_s: float = 1.0,
+                        trace_dir: Optional[str] = None,
                         **router_kwargs) -> ServeFleet:
     """N engines + M prefill workers on driver threads behind a started
     router — the cheap fleet for tests/examples (real TCP beat/handoff
-    wire, no subprocesses)."""
+    wire, no subprocesses).  ``trace_dir`` turns on request-scoped
+    distributed tracing fleet-wide (router + every member exports
+    per-component span JSONL there; stitch with
+    ``tools/trace_stitch.py``)."""
     from ray_lightning_tpu.serve.engine import ServeConfig, ServeEngine
 
-    router = Router(lost_after_s=lost_after_s, **router_kwargs)
+    router = Router(lost_after_s=lost_after_s, trace_dir=trace_dir,
+                    **router_kwargs)
 
-    def make_engine():
+    def make_engine(name):
         return ServeEngine(
             module, params, ServeConfig(**_cfg_kwargs(serve_cfg)),
             draft_module=draft_module, draft_params=draft_params,
+            trace_dir=trace_dir, trace_name=name,
         )
 
     replicas = [
-        InprocReplica(f"r{i}", make_engine(), router.beat_handle,
+        InprocReplica(f"r{i}", make_engine(f"r{i}"), router.beat_handle,
                       beat_s=beat_s)
         for i in range(n_replicas)
     ]
     workers = [
         InprocPrefill(f"p{i}", module, params, serve_cfg,
-                      router.beat_handle, beat_s=beat_s)
+                      router.beat_handle, beat_s=beat_s,
+                      trace_dir=trace_dir)
         for i in range(n_prefill)
     ]
     if n_prefill:
         router._prefill_factory = lambda: InprocPrefill(
             f"p{uuid.uuid4().hex[:6]}", module, params, serve_cfg,
-            router.beat_handle, beat_s=beat_s,
+            router.beat_handle, beat_s=beat_s, trace_dir=trace_dir,
         )
     for r in replicas:
         router.add_replica(r)
@@ -397,12 +409,16 @@ def launch_actor_fleet(module, params, serve_cfg, *, n_replicas: int = 2,
                        lost_after_s: float = 2.0,
                        governor: Optional[RestartGovernor] = None,
                        startup_timeout_s: float = 180.0,
+                       trace_dir: Optional[str] = None,
                        **router_kwargs) -> ServeFleet:
     """The real fleet: one ProcessActor per member, each owning its own
     devices (1 CPU device per actor on this container; a TPU host's
-    chips in production), beats and handoffs over the queue plane."""
+    chips in production), beats and handoffs over the queue plane.
+    ``trace_dir`` (a SHARED path — same-host fleets, or a shared mount)
+    turns on fleet-wide request tracing; members export their span
+    JSONL on graceful teardown."""
     router = Router(lost_after_s=lost_after_s, governor=governor,
-                    **router_kwargs)
+                    trace_dir=trace_dir, **router_kwargs)
     beat_addr = (router.beat_handle.host, router.beat_handle.port)
     params = _host_params(params)
     draft_params = (_host_params(draft_params)
@@ -411,18 +427,18 @@ def launch_actor_fleet(module, params, serve_cfg, *, n_replicas: int = 2,
     replicas = [
         ActorReplica(f"r{i}", module, params, cfg_kwargs, beat_addr,
                      beat_s=beat_s, draft_module=draft_module,
-                     draft_params=draft_params)
+                     draft_params=draft_params, trace_dir=trace_dir)
         for i in range(n_replicas)
     ]
     workers = [
         ActorPrefill(f"p{i}", module, params, serve_cfg, beat_addr,
-                     beat_s=beat_s)
+                     beat_s=beat_s, trace_dir=trace_dir)
         for i in range(n_prefill)
     ]
     if n_prefill:
         router._prefill_factory = lambda: ActorPrefill(
             f"p{uuid.uuid4().hex[:6]}", module, params, serve_cfg,
-            beat_addr, beat_s=beat_s,
+            beat_addr, beat_s=beat_s, trace_dir=trace_dir,
         )
     for r in replicas:
         router.add_replica(r)

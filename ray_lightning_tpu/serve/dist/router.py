@@ -46,13 +46,24 @@ Telemetry: :meth:`snapshot` is schema-pinned
 OpenMetrics family (``telemetry/export_prom.py``), and rendered by the
 ``rlt_top`` router pane.
 
-Known limit (cross-host hardening follow-up): routing sends are
-synchronous under the router lock, so a member host that BLACKHOLES
-TCP (SYN dropped, no RST — rare next to process death, which fails
-fast) can wedge the control plane for up to one connect timeout
-(~60s) before the death path runs.  The fix shape is a per-member
-outbox thread (the MPMD stage-inbox pattern); on the single-host
-fleets this round proves, ``is_alive()`` catches every death first.
+Sends are ASYNCHRONOUS: every destination (member inbox or client
+reply queue) gets a :class:`~.handoff.MemberOutbox` — a per-address
+send thread with a bounded queue — so the control plane never blocks
+inside a TCP connect to a wedged host (the PR-12 documented limit: a
+blackholed member could hold the router lock for a full ~60s connect
+timeout).  A failed or backed-up outbox reports once, and the router
+routes the incident through the SAME death/failover path a
+synchronous send failure used to take.
+
+Distributed tracing (``telemetry_dir`` set): the router is where a
+request's trace is BORN — ``trace_id`` is the rid, the root span id is
+derived (``<rid>.root``), so failover re-submissions and recompute
+replays land in the same trace with no registry.  The router records
+the ``placement`` span (submit → dispatch frame on the wire, measured
+in the outbox thread — real dispatch latency, not lock convoy), a
+``failover`` span per re-routed request linked under the request root,
+and the root ``request`` span at completion; per-rank exports stitch
+via ``telemetry/trace_collect.py``.
 """
 
 from __future__ import annotations
@@ -64,7 +75,10 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from ray_lightning_tpu.serve.dist.handoff import (
-    CachedSender, make_dispatch_item, request_fields,
+    MemberOutbox, make_dispatch_item, request_fields,
+)
+from ray_lightning_tpu.telemetry.propagate import (
+    child_context, root_context, trace_args,
 )
 
 __all__ = ["Router", "RestartGovernor"]
@@ -120,7 +134,8 @@ class _Member:
 class _Track:
     """One routed request until a terminal status comes back."""
 
-    __slots__ = ("req", "replica", "worker", "resubmits", "t0")
+    __slots__ = ("req", "replica", "worker", "resubmits", "t0",
+                 "t_wall", "trace")
 
     def __init__(self, req: Dict[str, Any], t0: float):
         self.req = req
@@ -128,6 +143,8 @@ class _Track:
         self.worker: Optional[str] = None
         self.resubmits = 0
         self.t0 = t0
+        self.t_wall = time.time()
+        self.trace = None  # the request's root TraceContext (tracing on)
 
 
 class Router:
@@ -141,6 +158,7 @@ class Router:
         governor: Optional[RestartGovernor] = None,
         prefill_factory: Optional[Callable[[], Any]] = None,
         telemetry_dir: Optional[str] = None,
+        trace_dir: Optional[str] = None,
         prom_file: Optional[str] = None,
         prom_port: Optional[int] = None,
         export_every_s: float = 1.0,
@@ -175,7 +193,14 @@ class Router:
         # the failover-latency component the router can observe.
         self.last_failover_detect_s: Optional[float] = None
         self._seed_counter = 0
-        self._out = CachedSender()
+        # One MemberOutbox per destination address (member inboxes AND
+        # client reply queues): all wire writes leave the lock.  Idle
+        # lanes are reaped (clients come and go; re-creation on the
+        # next send is one TCP connect) and _closing gates creation
+        # during stop().
+        self._outboxes: Dict[Tuple[str, int], MemberOutbox] = {}
+        self._outbox_idle_s = 120.0
+        self._closing = False
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -183,12 +208,28 @@ class Router:
         self._export_every_s = export_every_s
         self._last_export = 0.0
         self._live_path = None
+        self._trace_path = None
         self._exporter = None
         if telemetry_dir:
             import os
 
             os.makedirs(telemetry_dir, exist_ok=True)
             self._live_path = f"{telemetry_dir}/router-live.json"
+        if trace_dir:
+            import os
+
+            os.makedirs(trace_dir, exist_ok=True)
+            self._trace_path = f"{trace_dir}/trace-router.jsonl"
+        from ray_lightning_tpu.telemetry.spans import SpanTracer
+
+        # Wall-clock tracer: router spans stitch against worker/replica
+        # exports by shared epoch (trace_collect.py).  Gated on
+        # trace_dir like every other component — a telemetry-only
+        # fleet's wire frames stay byte-identical to pre-trace rounds.
+        self.tracer = SpanTracer(
+            enabled=self._trace_path is not None, maxlen=16384,
+            rank=0, clock=time.time,
+        )
         if prom_file or prom_port is not None:
             from ray_lightning_tpu.telemetry.export_prom import PromExporter
 
@@ -243,6 +284,7 @@ class Router:
             self._check_liveness(now)
             self._drain_retry(now)
             self._maybe_export()
+        self._reap_idle_outboxes(now)
 
     def start(self) -> "Router":
         if self._thread is not None:
@@ -271,9 +313,22 @@ class Router:
             self._thread = None
         self._beats.shutdown()
         self._requests.shutdown()
-        self._out.close()
+        # Flag-then-snapshot under the lock: a concurrent outbox-error
+        # death path re-routing through _put must not register a fresh
+        # outbox AFTER the clear (its thread would leak).
+        with self._lock:
+            self._closing = True
+            boxes = list(self._outboxes.values())
+            self._outboxes.clear()
+        for box in boxes:
+            box.close()
         if self._exporter is not None:
             self._exporter.close()
+        if self._trace_path is not None and self.tracer.events():
+            try:
+                self.tracer.export_jsonl(self._trace_path)
+            except OSError:
+                pass  # a full disk must not fail the teardown
         self._sweep_segments()
 
     # -- beats ---------------------------------------------------------------
@@ -336,6 +391,15 @@ class Router:
         key = status if status in ("rejected", "expired", "invalid") \
             else "completed"
         self.counters[key] += 1
+        if track.trace is not None:
+            # The root span anchors the whole trace: every downstream
+            # span's parent chain terminates at <rid>.root.
+            self.tracer.record(
+                "request", track.t_wall,
+                max(0.0, time.time() - track.t_wall),
+                args=trace_args(track.trace, rid=rid, status=status,
+                                resubmits=track.resubmits),
+            )
 
     def _on_member_closing(self, m: _Member, now: float) -> None:
         """Planned member drain (the ``closing`` flag on a final beat —
@@ -425,6 +489,10 @@ class Router:
                 # replica replays the identical token stream.
                 seed = self._seed_counter
                 self._seed_counter += 1
+            # Trace identity: the rid IS the trace_id, stamped once
+            # here — every hop (prefill, handoff, decode, failover
+            # re-submission, preemption replay) shares it.
+            ctx = root_context(rid) if self.tracer.enabled else None
             req = request_fields(
                 rid, item["prompt"], int(item["max_new_tokens"]),
                 reply=reply, sample_seed=seed,
@@ -433,6 +501,7 @@ class Router:
                 top_k=item.get("top_k"),
                 spec=item.get("spec"),
                 deadline_s=item.get("deadline_s"),
+                trace=ctx,
             )
             problem = self._validate(req)
             if problem is not None:
@@ -443,6 +512,7 @@ class Router:
                 })
                 return rid
             track = _Track(req, now)
+            track.trace = ctx
             self._inflight[rid] = track
             self.counters["routed"] += 1
             self._route(rid, track, now)
@@ -573,7 +643,9 @@ class Router:
                 # inline bytes over the (chunk-sending) queue.
                 self._put(worker.inbox, make_dispatch_item(
                     req, target.inbox,
-                    same_host=worker.inbox[0] == target.inbox[0]))
+                    same_host=worker.inbox[0] == target.inbox[0]),
+                    on_sent=self._placement_cb(track, rid, worker.id,
+                                               target.id))
                 track.worker = worker.id
                 self.counters["prefill_dispatches"] += 1
                 return
@@ -581,10 +653,35 @@ class Router:
                 self._on_worker_death(worker, now)
                 # fall through to direct submission this once
         try:
-            self._put(target.inbox, req)
+            self._put(target.inbox, req,
+                      on_sent=self._placement_cb(track, rid, None,
+                                                 target.id))
             self.counters["direct_submits"] += 1
         except (OSError, ConnectionError):
             self._on_replica_death(target, now)
+
+    def _placement_cb(self, track: _Track, rid: str,
+                      worker_id: Optional[str], replica_id: str):
+        """The ``placement`` span recorder, fired by the outbox thread
+        AFTER the dispatch frame hit the wire — so the span measures
+        route decision + outbox queue + connect + send, the real
+        dispatch latency a client's TTFT pays."""
+        if not self.tracer.enabled or track.trace is None:
+            return None
+        t0 = time.time()
+        ctx = child_context(track.trace)
+        resubmit = track.resubmits
+
+        def on_sent(_enqueue_ts: float) -> None:
+            args = trace_args(ctx, rid=rid, replica=replica_id,
+                              resubmit=resubmit)
+            if worker_id is not None:
+                args["worker"] = worker_id
+            self.tracer.record(
+                "placement", t0, max(0.0, time.time() - t0), args=args
+            )
+
+        return on_sent
 
     def _park(self, rid: str) -> None:
         if rid not in self._retry:
@@ -660,6 +757,18 @@ class Router:
             track.replica = None
             track.worker = None
             track.resubmits += 1
+            if track.trace is not None:
+                # The failover hop is a first-class span LINKED under
+                # the request root: anyone reading the stitched trace
+                # sees that this request moved replicas, and why.
+                self.tracer.record(
+                    "failover", time.time(), 0.0,
+                    args=trace_args(
+                        child_context(track.trace), rid=rid,
+                        from_replica=m.id, reason="replica_lost",
+                        resubmit=track.resubmits,
+                    ),
+                )
             self._route(rid, track, now, exclude={m.id}, must_place=True)
         self._reap(m)
 
@@ -724,8 +833,91 @@ class Router:
             pass
 
     # -- wire helpers --------------------------------------------------------
-    def _put(self, addr: Tuple[str, int], item: Dict[str, Any]) -> None:
-        self._out.put(addr, item)
+    def _outbox(self, addr: Tuple[str, int]) -> MemberOutbox:
+        if self._closing:
+            raise ConnectionError("router is stopping")
+        addr = (addr[0], int(addr[1]))
+        box = self._outboxes.get(addr)
+        if box is None or box._dead:
+            if box is not None:
+                box.close(drain_s=0.0)
+            # The error callback is bound to the BOX identity (late,
+            # below) — a stale failure report must never tear down a
+            # healthy replacement lane at the same address.
+            box = MemberOutbox(addr)
+            box._on_error = (
+                lambda e, b=box: self._on_outbox_error(b, e)
+            )
+            self._outboxes[addr] = box
+        return box
+
+    def _reap_idle_outboxes(self, now: float) -> None:
+        """Close send lanes that have been idle past the threshold —
+        one thread + socket per DISTINCT client reply address must not
+        accumulate over a long-lived router's lifetime.  Victims are
+        collected under the lock but closed outside it (close joins
+        the lane thread)."""
+        with self._lock:
+            victims = [
+                addr for addr, box in self._outboxes.items()
+                if not box.pending
+                and now - box.last_used > self._outbox_idle_s
+            ]
+            boxes = [self._outboxes.pop(a) for a in victims]
+        for box in boxes:
+            box.close(drain_s=0.0)
+
+    def _put(self, addr: Tuple[str, int], item: Dict[str, Any],
+             on_sent=None) -> None:
+        self._outbox(addr).put(item, on_sent=on_sent)
+
+    def flush_outboxes(self, timeout: float = 5.0) -> bool:
+        """Wait until every live outbox has drained to the wire (tests
+        and planned teardowns want the async sends LANDED, not merely
+        enqueued).  Returns False on timeout."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                busy = any(box.pending and not box._dead
+                           for box in self._outboxes.values())
+            if not busy:
+                return True
+            time.sleep(0.005)
+        return False
+
+    def _on_outbox_error(self, failed_box: MemberOutbox,
+                         exc: BaseException) -> None:
+        """An async send failed (reported by the outbox thread).  Map
+        the address back to whichever member currently advertises it
+        and run the SAME death path a synchronous send failure used to
+        take; a client reply address just drops its outbox (the client
+        went away).  Only the FAILED box is unregistered — a healthy
+        replacement lane already installed at the same address (a _put
+        raced this callback) keeps its queued frames."""
+        now = time.monotonic()
+        addr = failed_box.addr
+        victim = None
+        with self._lock:
+            if self._outboxes.get(addr) is failed_box:
+                self._outboxes.pop(addr, None)
+            for m in list(self._replicas.values()):
+                if m.alive and m.inbox == addr:
+                    victim = m
+                    break
+            else:
+                for w in list(self._workers.values()):
+                    if w.alive and w.inbox == addr:
+                        victim = w
+                        break
+        failed_box.close(drain_s=0.0)  # self-join-safe (dead: no join)
+        if victim is not None:
+            log.warning("outbox to %s %s failed: %r", victim.role,
+                        victim.id, exc)
+            with self._lock:
+                if victim.role == "decode":
+                    self._on_replica_death(victim, now)
+                else:
+                    self._on_worker_death(victim, now)
 
     def _reply(self, addr: Tuple[str, int], item: Dict[str, Any]) -> None:
         try:

@@ -44,6 +44,10 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ray_lightning_tpu.telemetry.propagate import (
+    child_context, trace_args,
+)
+
 __all__ = ["ServeConfig", "ServeEngine", "ServeHandle", "ServeRejected"]
 
 
@@ -136,7 +140,9 @@ class ServeEngine:
                  telemetry_dir: Optional[str] = None,
                  prom_file: Optional[str] = None,
                  prom_port: Optional[int] = None,
-                 draft_module=None, draft_params=None):
+                 draft_module=None, draft_params=None,
+                 trace_dir: Optional[str] = None,
+                 trace_name: Optional[str] = None):
         import jax
         import jax.numpy as jnp
 
@@ -248,6 +254,19 @@ class ServeEngine:
             self._draft_pool = self._draft_cache.init_pool()
         self._cur_tokens = np.zeros((cfg.num_slots,), np.int32)
         self._started_t = time.monotonic()
+        # Request-scoped distributed tracing (docs/OBSERVABILITY.md
+        # "Distributed tracing"): wall-clock spans per critical-path
+        # phase, exported as trace-serve-<name>.jsonl at stop() for
+        # telemetry/trace_collect.py to stitch.  OFF unless trace_dir
+        # is set — the disabled tracer costs one attribute check.
+        from ray_lightning_tpu.telemetry.spans import SpanTracer
+
+        self._trace_dir = trace_dir
+        self._trace_name = trace_name or uuid.uuid4().hex[:6]
+        self.tracer = SpanTracer(
+            enabled=trace_dir is not None, maxlen=16384, rank=0,
+            clock=time.time,
+        )
         self._build_programs()
 
         self._handles: Dict[str, ServeHandle] = {}
@@ -401,7 +420,8 @@ class ServeEngine:
                deadline_s: Optional[float] = None,
                sample_seed: Optional[int] = None,
                on_token=None, rid: Optional[str] = None,
-               _handoff: Optional[dict] = None) -> ServeHandle:
+               _handoff: Optional[dict] = None,
+               _trace_ctx=None) -> ServeHandle:
         """Enqueue one request (thread-safe).  Returns a handle; a
         backpressure rejection is visible immediately as
         ``handle.status == "rejected"`` (and ``result()`` raises).
@@ -472,13 +492,21 @@ class ServeEngine:
                 "error) — build a fresh ServeEngine"
             ) from self._error
         rid = rid or uuid.uuid4().hex[:12]
+        trace_ctx, trace_local = _trace_ctx, False
+        if trace_ctx is None and self.tracer.enabled:
+            # No upstream context (in-process submission on a tracing
+            # engine): this engine owns the trace root.
+            from ray_lightning_tpu.telemetry.propagate import root_context
+
+            trace_ctx, trace_local = root_context(rid), True
         req = Request(
             rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
             temperature=float(temperature), eos_token_id=eos_token_id,
             top_k=top_k, spec=spec,
             deadline_s=deadline_s, sample_seed=sample_seed,
-            on_token=on_token,
+            on_token=on_token, trace=trace_ctx,
         )
+        req._trace_local = trace_local
         if _handoff is not None:
             req._handoff = _handoff
         handle = ServeHandle(rid, req)
@@ -519,8 +547,18 @@ class ServeEngine:
             self.stats.bump("expired")
             self._finish_handle(req)
         now = time.monotonic()
+        tr = self.tracer
         for slot, req, bucket in admissions:
-            self.stats.note_admitted(now - req.arrival_t)
+            wait = now - req.arrival_t
+            self.stats.note_admitted(wait)
+            ctx = req.trace if tr.enabled else None
+            if ctx is not None:
+                tr.record(
+                    "queue_wait", time.time() - wait, wait,
+                    args=trace_args(child_context(ctx), rid=req.rid,
+                                    preemptions=req.preemptions),
+                )
+                self.stats.note_phase("queue_wait", wait)
             ids = np.asarray(
                 self.scheduler._blocks[slot][: bucket
                                              // self.config.block_size],
@@ -537,6 +575,7 @@ class ServeEngine:
                 padded_np = np.zeros((bucket,), np.int32)
                 padded_np[: req.prompt_len] = req.prompt
                 padded = jnp.asarray(padded_np)
+            t_ph = time.time() if ctx is not None else 0.0
             if handoff is not None:
                 # A prefill worker already ran this prompt: scatter its
                 # exported blocks into OUR allocator's blocks and
@@ -575,8 +614,24 @@ class ServeEngine:
                 )
             first = int(first)
             t_first = time.monotonic()
+            if ctx is not None:
+                # The int() above synced the device, so this interval
+                # covers dispatch + device compute of the admission.
+                t_sync = time.time()
+                phase = ("decode_admission" if handoff is not None
+                         else "prefill_compute")
+                tr.record(phase, t_ph, max(0.0, t_sync - t_ph),
+                          args=trace_args(child_context(ctx),
+                                          rid=req.rid, bucket=bucket))
+                self.stats.note_phase(phase, t_sync - t_ph)
             self.stats.note_first_token(t_first - req.arrival_t)
             done = self.scheduler.append_token(slot, first, now=t_first)
+            if ctx is not None:
+                ft_dur = max(0.0, time.time() - t_sync)
+                tr.record("first_token", t_sync, ft_dur,
+                          args=trace_args(child_context(ctx),
+                                          rid=req.rid, token_index=0))
+                self.stats.note_phase("first_token", ft_dur)
             self.stats.bump("tokens_out")
             self._cur_tokens[slot] = first
             if done:
@@ -828,7 +883,17 @@ class ServeEngine:
 
     def _complete(self, slot: int) -> None:
         req = self.scheduler.finish(slot)
-        self.stats.note_completed(req.finished_t - req.arrival_t)
+        e2e = req.finished_t - req.arrival_t
+        self.stats.note_completed(e2e)
+        if (self.tracer.enabled and req.trace is not None
+                and getattr(req, "_trace_local", False)):
+            # Engine-owned traces (no router upstream) anchor their own
+            # root span; routed requests' roots live router-side.
+            self.tracer.record(
+                "request", time.time() - e2e, e2e,
+                args=trace_args(req.trace, rid=req.rid,
+                                status=req.state.value),
+            )
         self._finish_handle(req)
 
     def _finish_handle(self, req) -> None:
@@ -913,6 +978,17 @@ class ServeEngine:
         self._reply_handles.clear()
         if self._exporter is not None:
             self._exporter.close()
+        if self._trace_dir is not None and self.tracer.events():
+            import os
+
+            try:
+                os.makedirs(self._trace_dir, exist_ok=True)
+                self.tracer.export_jsonl(
+                    f"{self._trace_dir}/trace-serve-"
+                    f"{self._trace_name}.jsonl"
+                )
+            except OSError:
+                pass  # a full disk must not fail the teardown
         # Serve-replica teardown reclaims dead prefill handoffs: a
         # prefill worker killed -9 mid-handoff leaves rlt-kv segments
         # whose owner pid is gone and which no consumer will ever read
@@ -977,6 +1053,34 @@ class ServeEngine:
         try:
             handoff = (self._decode_handoff(item)
                        if kind == "serve_kv_handoff" else None)
+            trace_ctx = None
+            if self.tracer.enabled:
+                from ray_lightning_tpu.telemetry.propagate import (
+                    extract, sent_ts,
+                )
+
+                # The request body carries the ROUTER-stamped context
+                # (the trace root); a handoff envelope additionally
+                # carries the prefill worker's span + send time.  The
+                # transfer interval is booked HERE — at read — so it
+                # ends where queue_wait begins (booking it at admission
+                # would fold the slot backlog into "transfer" and
+                # double-count it against queue_wait).
+                trace_ctx = extract(fields)
+                if handoff is not None:
+                    h_sent = sent_ts(item)
+                    if h_sent is not None and trace_ctx is not None:
+                        h_dur = max(0.0, time.time() - h_sent)
+                        self.tracer.record(
+                            "handoff_transfer", h_sent, h_dur,
+                            args=trace_args(
+                                child_context(extract(item)
+                                              or trace_ctx),
+                                rid=rid,
+                            ),
+                        )
+                        self.stats.note_phase("handoff_transfer",
+                                              h_dur)
             handle = self.submit(
                 fields["prompt"], int(fields["max_new_tokens"]),
                 temperature=float(fields.get("temperature", 0.0)),
@@ -986,6 +1090,7 @@ class ServeEngine:
                 deadline_s=fields.get("deadline_s"),
                 sample_seed=fields.get("sample_seed"),
                 on_token=on_token, rid=rid, _handoff=handoff,
+                _trace_ctx=trace_ctx,
             )
         except (ValueError, TypeError, KeyError, OSError) as e:
             # TypeError covers malformed field coercion (int(None), ...);

@@ -65,6 +65,18 @@ class _Reservoir:
             "max_ms": round(max(self._vals) * 1e3, 3),
         }
 
+    def phase_summary_ms(self) -> Optional[Dict[str, float]]:
+        """The per-phase decomposition spelling (p50/p95 — critical-path
+        phases are budget lines, and a p99 over a 4096 window is mostly
+        noise for the short ones)."""
+        if not self._vals:
+            return None
+        return {
+            "n": self._n,
+            "p50_ms": round(percentile(self._vals, 50) * 1e3, 3),
+            "p95_ms": round(percentile(self._vals, 95) * 1e3, 3),
+        }
+
 
 class ServeStats:
     """Thread-safe counters + latency reservoirs + gauges.
@@ -80,6 +92,11 @@ class ServeStats:
         self._token = _Reservoir()       # inter-token latency, steady decode
         self._queue_wait = _Reservoir()  # arrival → admission
         self._e2e = _Reservoir()         # arrival → finished
+        # Critical-path phase reservoirs (queue_wait, prefill_compute,
+        # handoff_transfer, decode_admission, first_token, ...) —
+        # lazily created by note_phase so engines that never trace keep
+        # snapshots byte-identical to pre-tracing rounds.
+        self._phases: Dict[str, _Reservoir] = {}
         self.gauges: Dict[str, float] = {}
 
     def bump(self, name: str, n: int = 1) -> None:
@@ -125,6 +142,16 @@ class ServeStats:
                            ("spec_emitted", emitted)):
                 self.counters[key] = self.counters.get(key, 0) + n
 
+    def note_phase(self, phase: str, dur_s: float) -> None:
+        """One critical-path phase interval for one request (the
+        tracing plane feeds these; see docs/OBSERVABILITY.md
+        "Distributed tracing" for the phase definitions)."""
+        with self._lock:
+            res = self._phases.get(phase)
+            if res is None:
+                res = self._phases[phase] = _Reservoir()
+            res.add(dur_s)
+
     def set_gauges(self, **gauges: float) -> None:
         with self._lock:
             self.gauges.update(gauges)
@@ -146,4 +173,11 @@ class ServeStats:
                 if s is not None:
                     latency[name] = s
             out["latency"] = latency
+            if self._phases:  # tracing engines only — see __init__
+                phases = {}
+                for name, res in self._phases.items():
+                    s = res.phase_summary_ms()
+                    if s is not None:
+                        phases[name] = s
+                out["phases"] = phases
             return out

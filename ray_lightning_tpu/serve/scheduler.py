@@ -91,6 +91,10 @@ class Request:
     # fleet-wide seeds so a failover re-submission to a DIFFERENT
     # replica regenerates the identical stream.
     sample_seed: Optional[int] = None
+    # Distributed-tracing context (telemetry/propagate.TraceContext).
+    # Set once at submit and NEVER cleared on preemption requeue, so a
+    # recompute replay's spans land in the original trace.
+    trace: Optional[object] = None
 
     @property
     def prompt_len(self) -> int:

@@ -37,6 +37,13 @@ from ray_lightning_tpu.telemetry.runtime import (
     Telemetry,
     TelemetryConfig,
 )
+from ray_lightning_tpu.telemetry.propagate import (
+    TraceContext,
+    child_context,
+    extract,
+    inject,
+    root_context,
+)
 from ray_lightning_tpu.telemetry.spans import PHASES, Span, SpanTracer
 from ray_lightning_tpu.telemetry.step_stats import (
     StepStats,
@@ -54,6 +61,11 @@ __all__ = [
     "SpanTracer",
     "Span",
     "PHASES",
+    "TraceContext",
+    "root_context",
+    "child_context",
+    "inject",
+    "extract",
     "StepStats",
     "HeartbeatPublisher",
     "RunMonitor",

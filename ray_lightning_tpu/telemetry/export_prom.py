@@ -202,6 +202,15 @@ def _render_mpmd(mpmd: Dict[str, Any]) -> list:
          "stage_occupancy"),
         ("mpmd_stage_loss", "last micro-batch-mean loss (loss stage)",
          "loss"),
+        # The trace decomposition pair: how the stage's step wall split
+        # into compute vs blocked-recv (the stitched-timeline numbers,
+        # live).
+        ("mpmd_trace_busy_seconds",
+         "per-step stage compute seconds (trace decomposition)",
+         "busy_s"),
+        ("mpmd_trace_blocked_seconds",
+         "per-step stage blocked-recv seconds (trace decomposition)",
+         "blocked_s"),
     ):
         samples = [
             (item.get("stage"), item[key])
@@ -293,6 +302,24 @@ def _render_serve(serve: Dict[str, Any]) -> list:
                     f'{_PREFIX}_{metric}{{quantile="{q[:-3]}"}} '
                     f"{summary[q]}"
                 )
+    # Distributed-tracing critical-path phases (tracing engines only):
+    # the per-phase percentile family the TTFT decomposition reads.
+    phases = serve.get("phases", {})
+    if phases:
+        metric = "serve_phase_latency_ms"
+        lines.append(f"# TYPE {_PREFIX}_{metric} gauge")
+        lines.append(
+            f"# HELP {_PREFIX}_{metric} critical-path phase latency "
+            f"percentiles (queue_wait/placement/prefill_compute/"
+            f"handoff_transfer/decode_admission/first_token)"
+        )
+        for phase, summary in sorted(phases.items()):
+            for q in ("p50_ms", "p95_ms"):
+                if q in summary:
+                    lines.append(
+                        f'{_PREFIX}_{metric}{{phase="{_esc(phase)}",'
+                        f'quantile="{q[:-3]}"}} {summary[q]}'
+                    )
     return lines
 
 

@@ -1,0 +1,135 @@
+"""Trace-context propagation: request identity across process hops.
+
+The per-process :class:`~.spans.SpanTracer` answers "where did THIS
+process spend its time"; this module makes the spans of DIFFERENT
+processes stitchable into one request timeline.  A
+:class:`TraceContext` is three strings —
+
+* ``trace_id`` — the request/step identity, constant across every hop
+  (for serve requests it IS the rid, so a failover re-submission or a
+  recompute-preemption replay lands in the same trace by construction);
+* ``span_id`` — the id of the span that caused this hop (the sender's
+  span);
+* ``parent_span_id`` — that span's own parent, carried for flow-arrow
+  rendering.
+
+The context rides wire frames as an optional ``"trace"`` dict
+(:func:`inject` / :func:`extract` — schema-pinned as
+``telemetry/schema.py::validate_trace_context``, OPTIONAL on every
+frame family so old producers stay wire-compatible), and the receiving
+process continues it with :meth:`SpanTracer.start_remote` — its spans
+record ``trace_id``/``span_id``/``parent_span_id`` in their args, which
+is all ``telemetry/trace_collect.py`` needs to stitch per-process JSONL
+exports into one Perfetto trace with cross-process arrows.
+
+Root span ids are DERIVED (``<trace_id>.root``), not random: any
+process that knows the trace id can parent a span to the root without
+a registry — the router's failover hop links to the request root even
+though the root span was opened before the failover was conceivable.
+
+jax-free; the schema gate imports it.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, NamedTuple, Optional
+
+__all__ = [
+    "TraceContext",
+    "new_span_id",
+    "root_context",
+    "child_context",
+    "inject",
+    "extract",
+    "trace_args",
+]
+
+
+class TraceContext(NamedTuple):
+    trace_id: str
+    span_id: str
+    parent_span_id: Optional[str] = None
+
+    @property
+    def root_span_id(self) -> str:
+        return self.trace_id + ".root"
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def root_context(trace_id: str) -> TraceContext:
+    """The root of a trace.  The span id is derived from the trace id,
+    so every process agrees on it without coordination."""
+    trace_id = str(trace_id)
+    return TraceContext(trace_id, trace_id + ".root", None)
+
+
+def child_context(ctx: TraceContext,
+                  span_id: Optional[str] = None) -> TraceContext:
+    """A fresh span under ``ctx`` (the caller's span becomes the
+    parent)."""
+    return TraceContext(ctx.trace_id, span_id or new_span_id(),
+                        ctx.span_id)
+
+
+def inject(item: Dict[str, Any], ctx: Optional[TraceContext],
+           ts: Optional[float] = None) -> Dict[str, Any]:
+    """Stamp ``ctx`` into a wire frame (no-op when ``ctx`` is None).
+    ``ts`` (wall-clock seconds, default now) records the SEND time so
+    the consumer can book the transfer interval as a span without a
+    second round trip."""
+    if ctx is None:
+        return item
+    trace: Dict[str, Any] = {
+        "trace_id": ctx.trace_id,
+        "span_id": ctx.span_id,
+        "ts": time.time() if ts is None else ts,
+    }
+    if ctx.parent_span_id is not None:
+        trace["parent_span_id"] = ctx.parent_span_id
+    item["trace"] = trace
+    return item
+
+
+def extract(item: Any) -> Optional[TraceContext]:
+    """Recover the context a frame carries (None when absent or
+    malformed — an old producer's frame must never fail the consumer)."""
+    if not isinstance(item, dict):
+        return None
+    trace = item.get("trace")
+    if not isinstance(trace, dict):
+        return None
+    trace_id, span_id = trace.get("trace_id"), trace.get("span_id")
+    if not isinstance(trace_id, str) or not isinstance(span_id, str):
+        return None
+    parent = trace.get("parent_span_id")
+    return TraceContext(trace_id, span_id,
+                        parent if isinstance(parent, str) else None)
+
+
+def sent_ts(item: Any) -> Optional[float]:
+    """The producer-stamped wall-clock send time of a traced frame."""
+    if not isinstance(item, dict):
+        return None
+    trace = item.get("trace")
+    if not isinstance(trace, dict):
+        return None
+    ts = trace.get("ts")
+    return float(ts) if isinstance(ts, (int, float)) else None
+
+
+def trace_args(ctx: TraceContext, **extra: Any) -> Dict[str, Any]:
+    """Span-args dict carrying the trace linkage (what
+    ``trace_collect`` stitches on)."""
+    args: Dict[str, Any] = {
+        "trace_id": ctx.trace_id,
+        "span_id": ctx.span_id,
+    }
+    if ctx.parent_span_id is not None:
+        args["parent_span_id"] = ctx.parent_span_id
+    args.update(extra)
+    return args

@@ -23,6 +23,8 @@ __all__ = [
     "validate_span",
     "validate_span_jsonl",
     "validate_chrome_trace",
+    "validate_trace_context",
+    "validate_bench_trace",
     "validate_bench_telemetry",
     "validate_bench_fault",
     "validate_bench_host_overhead",
@@ -102,6 +104,45 @@ def validate_span(span: Dict[str, Any], where: str = "span") -> List[str]:
     return problems
 
 
+# ---------------------------------------------------------------------------
+# Distributed tracing: the trace-context envelope wire frames carry
+# ---------------------------------------------------------------------------
+
+# The "trace" dict riding (OPTIONALLY — old producers stay wire-
+# compatible) every queue-plane frame family: serve_request,
+# serve_kv_handoff, replica/prefill beats, mpmd_xfer, mpmd_stage,
+# heartbeat and event items.  ``ts`` is the producer's wall-clock SEND
+# time (epoch seconds) so the consumer can book the transfer interval.
+_TRACE_CTX_REQUIRED = {
+    "trace_id": str,
+    "span_id": str,
+}
+_TRACE_CTX_OPTIONAL = {
+    "parent_span_id": str,
+    "ts": (int, float),
+}
+
+
+def validate_trace_context(trace: Any,
+                           where: str = "trace") -> List[str]:
+    problems = _check_fields(
+        trace, _TRACE_CTX_REQUIRED, _TRACE_CTX_OPTIONAL, where
+    )
+    if not problems:
+        if not trace["trace_id"]:
+            problems.append(f"{where}: empty trace_id")
+        if not trace["span_id"]:
+            problems.append(f"{where}: empty span_id")
+    return problems
+
+
+def _check_optional_trace(item: Dict[str, Any], where: str) -> List[str]:
+    """Validate the optional trace envelope when a frame carries one."""
+    if isinstance(item, dict) and "trace" in item:
+        return validate_trace_context(item["trace"], f"{where}.trace")
+    return []
+
+
 def validate_span_jsonl(lines: List[str], where: str = "jsonl") -> List[str]:
     """Validate a span JSONL dump given as decoded lines."""
     import json
@@ -175,6 +216,7 @@ _HEARTBEAT_OPTIONAL = {
     "device_memory": dict,       # jax memory_stats subset, best-effort
     "host_load": (int, float),   # 1-minute load average
     "done": bool,                # final beat before the publisher stops
+    "trace": dict,               # optional trace-context envelope
 }
 
 # Event: structured monitor/worker occurrences (stall, stack_dump,
@@ -203,6 +245,7 @@ _EVENT_OPTIONAL = {
     "recover_s": (int, float),  # elastic_restart/resize: respawn time
     "old_world": int,           # resize/resize_rejected: world before
     "new_world": int,           # resize/resize_rejected: world after
+    "trace": dict,              # optional trace-context envelope
 }
 
 # Log: a rank-tagged forwarded logging record (warning+ severity).
@@ -261,6 +304,7 @@ def validate_heartbeat(item: Any, where: str = "heartbeat") -> List[str]:
         for key in ("seq", "global_step", "micro_step", "progress"):
             if item[key] < 0:
                 problems.append(f"{where}: negative {key} {item[key]}")
+        problems += _check_optional_trace(item, where)
     return problems
 
 
@@ -268,8 +312,10 @@ def validate_event(item: Any, where: str = "event") -> List[str]:
     problems = _validate_typed(
         item, "event", _EVENT_REQUIRED, _EVENT_OPTIONAL, where
     )
-    if not problems and item["rank"] < -1:
-        problems.append(f"{where}: invalid rank {item['rank']}")
+    if not problems:
+        if item["rank"] < -1:
+            problems.append(f"{where}: invalid rank {item['rank']}")
+        problems += _check_optional_trace(item, where)
     return problems
 
 
@@ -334,6 +380,9 @@ _SERVE_REQUEST_OPTIONAL = {
     # Disaggregated serving: the router's fleet-wide sampling-stream
     # identity (absent/None = the engine assigns its own ordinal).
     "sample_seed": (int, type(None)),
+    # Distributed tracing: the request's trace-context envelope
+    # (validate_trace_context; absent on untraced producers).
+    "trace": dict,
 }
 
 # Engine → client replies: the per-token stream and the completion.
@@ -368,6 +417,7 @@ def validate_serve_request(item: Any,
             problems.append(f"{where}: empty prompt")
         if len(item["reply"]) != 2:
             problems.append(f"{where}: reply is not [host, port]")
+        problems += _check_optional_trace(item, where)
     return problems
 
 
@@ -399,6 +449,11 @@ _SERVE_SNAPSHOT_REQUIRED = {
     "gauges": dict,
     "latency": dict,
 }
+# "phases" appears only on TRACING engines (ServeStats.note_phase is
+# lazily fed by the request tracer) — per critical-path phase p50/p95.
+_SERVE_SNAPSHOT_OPTIONAL = {
+    "phases": dict,
+}
 _SERVE_LATENCY_KEYS = ("ttft", "token", "queue_wait", "e2e")
 _SERVE_LATENCY_FIELDS = {
     "n": int,
@@ -406,13 +461,25 @@ _SERVE_LATENCY_FIELDS = {
     "p99_ms": (int, float),
     "max_ms": (int, float),
 }
+_SERVE_PHASE_FIELDS = {
+    "n": int,
+    "p50_ms": (int, float),
+    "p95_ms": (int, float),
+}
 
 
 def validate_serve_snapshot(doc: Any,
                             where: str = "serve_snapshot") -> List[str]:
-    problems = _check_fields(doc, _SERVE_SNAPSHOT_REQUIRED, {}, where)
+    problems = _check_fields(
+        doc, _SERVE_SNAPSHOT_REQUIRED, _SERVE_SNAPSHOT_OPTIONAL, where
+    )
     if problems:
         return problems
+    for phase, summary in doc.get("phases", {}).items():
+        problems += _check_fields(
+            summary, _SERVE_PHASE_FIELDS, {},
+            f"{where}.phases.{phase}",
+        )
     for key, value in doc["counters"].items():
         if not isinstance(value, int) or isinstance(value, bool):
             problems.append(f"{where}: counter {key!r} is not an int")
@@ -465,6 +532,9 @@ _SERVE_HANDOFF_REQUIRED = {
 _SERVE_HANDOFF_OPTIONAL = {
     "data": bytes,
     "shm": str,
+    # The prefill worker's trace envelope (span_id = its prefill span;
+    # ts = send time, the replica books handoff_transfer from it).
+    "trace": dict,
 }
 
 
@@ -493,6 +563,7 @@ def validate_serve_kv_handoff(item: Any,
         if isinstance(item["req"], dict) else None
     if not isinstance(seed, int) or isinstance(seed, bool):
         problems.append(f"{where}.req: missing/invalid sample_seed")
+    problems += _check_optional_trace(item, where)
     return problems
 
 
@@ -773,6 +844,50 @@ def validate_bench_serve_disagg(block: Any,
     return problems
 
 
+# The bench_serve.py distributed-tracing block: the stitch-coverage /
+# per-phase-percentile / overhead acceptance surface.  ``coverage`` is
+# the fraction of COMPLETED requests whose stitched trace carries a
+# complete queue_wait→…→first_token phase chain (the >=0.95 bar);
+# ``overhead_pct`` is the measured closed-loop headline cost of
+# cheap-tier tracing (the <2% bar); ``phases`` maps each critical-path
+# phase to its p50/p95 over the traced run.
+_BENCH_TRACE_REQUIRED = {
+    "coverage": (int, float),
+    "requests": int,
+    "phases": dict,
+    "overhead_pct": (int, float, type(None)),
+}
+_BENCH_TRACE_OPTIONAL = {
+    "complete_chains": int,
+    "spans": int,
+    "traced_requests_per_sec": (int, float, type(None)),
+    "baseline_requests_per_sec": (int, float, type(None)),
+    "replicas": int,
+    "prefill_workers": int,
+}
+
+
+def validate_bench_trace(block: Any, where: str = "trace") -> List[str]:
+    """Validate the ``trace`` block of a bench artifact (absent on
+    pre-tracing rounds)."""
+    problems = _check_fields(
+        block, _BENCH_TRACE_REQUIRED, _BENCH_TRACE_OPTIONAL, where
+    )
+    if problems:
+        return problems
+    if not 0.0 <= block["coverage"] <= 1.0:
+        problems.append(
+            f"{where}: coverage {block['coverage']} outside [0, 1]"
+        )
+    if block["requests"] < 0:
+        problems.append(f"{where}: negative requests")
+    for phase, summary in block["phases"].items():
+        problems += _check_fields(
+            summary, _SERVE_PHASE_FIELDS, {}, f"{where}.phases.{phase}"
+        )
+    return problems
+
+
 # ---------------------------------------------------------------------------
 # MPMD pipeline plane (mpmd/): stream items, transfer frames, live
 # snapshot, bench block
@@ -791,6 +906,7 @@ _MPMD_STAGE_OPTIONAL = {
     "loss": (int, float),         # loss-hosting worker only
     "busy_s": (int, float),
     "blocked_s": (int, float),
+    "trace": dict,                # the step's trace-context envelope
 }
 
 # The inter-stage transfer frame (mpmd/transfer.py wire contract):
@@ -805,6 +921,7 @@ _MPMD_XFER_REQUIRED = {
 _MPMD_XFER_OPTIONAL = {
     "data": bytes,
     "shm": str,
+    "trace": dict,        # sender's trace envelope (cross-stage stitch)
 }
 
 # mpmd-live.json (MpmdStrategy's live export, the rlt_top mpmd pane).
@@ -831,6 +948,7 @@ def validate_mpmd_stage_item(item: Any,
                 f"{where}: bubble_fraction {item['bubble_fraction']} "
                 "outside [0, 1]"
             )
+        problems += _check_optional_trace(item, where)
     return problems
 
 
@@ -849,6 +967,7 @@ def validate_mpmd_xfer(item: Any, where: str = "mpmd_xfer") -> List[str]:
     for key in ("step", "mb", "chunk"):
         if item[key] < 0:
             problems.append(f"{where}: negative {key}")
+    problems += _check_optional_trace(item, where)
     return problems
 
 

@@ -55,12 +55,16 @@ class Span(NamedTuple):
 
 
 class _NullCtx:
-    """Shared no-op context manager for the disabled tracer."""
+    """Shared no-op context manager for the disabled tracer.  ``ctx``
+    mirrors :class:`_SpanCtx` so ``start_remote`` call sites read the
+    trace context unconditionally."""
 
     __slots__ = ()
 
+    ctx = None
+
     def __enter__(self):
-        return None
+        return self
 
     def __exit__(self, *exc):
         return False
@@ -70,25 +74,31 @@ _NULL_CTX = _NullCtx()
 
 
 class _SpanCtx:
-    """One live span: records on exit, tracks per-thread nesting depth."""
+    """One live span: records on exit, tracks per-thread nesting depth.
 
-    __slots__ = ("_tracer", "_name", "_args", "_t0", "_depth")
+    ``ctx`` (a :class:`~.propagate.TraceContext` on spans opened via
+    :meth:`SpanTracer.start_remote`) is exposed so the body can inject
+    the span's OWN identity into outgoing frames — the receiving
+    process then parents its spans here."""
 
-    def __init__(self, tracer: "SpanTracer", name: str, args):
+    __slots__ = ("_tracer", "_name", "_args", "_t0", "_depth", "ctx")
+
+    def __init__(self, tracer: "SpanTracer", name: str, args, ctx=None):
         self._tracer = tracer
         self._name = name
         self._args = args
+        self.ctx = ctx
 
     def __enter__(self):
         stack = self._tracer._stack()
         self._depth = len(stack)
         stack.append(self._name)
         self._tracer.open_span = self._name
-        self._t0 = time.perf_counter()
+        self._t0 = self._tracer._clock()
         return self
 
     def __exit__(self, *exc):
-        t1 = time.perf_counter()
+        t1 = self._tracer._clock()
         stack = self._tracer._stack()
         stack.pop()
         self._tracer.open_span = stack[-1] if stack else None
@@ -108,12 +118,17 @@ class SpanTracer:
     """
 
     def __init__(self, enabled: bool = False, maxlen: int = 4096,
-                 rank: int = 0):
+                 rank: int = 0, clock=None):
         if maxlen < 1:
             raise ValueError("maxlen must be >= 1")
         self.enabled = enabled
         self.rank = rank
         self.maxlen = maxlen
+        # Default: monotonic perf_counter (per-process phase timing).
+        # The DISTRIBUTED tracers pass time.time — cross-process stitch
+        # needs one shared epoch, and a perf_counter origin is
+        # process-private.
+        self._clock = clock or time.perf_counter
         self._buf: collections.deque = collections.deque(maxlen=maxlen)
         self._recorded = 0
         self._local = threading.local()
@@ -148,9 +163,25 @@ class SpanTracer:
         )
         self._recorded += 1
 
+    def start_remote(self, ctx, name: str, **args):
+        """Context manager for a span CONTINUING a remote trace: the
+        span parents to ``ctx`` (a :class:`~.propagate.TraceContext`
+        from another process's wire frame) and carries its own fresh
+        span id, exposed as ``.ctx`` on the returned manager so the
+        body can propagate further downstream.  No-op (and ``.ctx`` is
+        None) when the tracer is disabled or ``ctx`` is None."""
+        if not self.enabled or ctx is None:
+            return _NULL_CTX
+        from ray_lightning_tpu.telemetry.propagate import (
+            child_context, trace_args,
+        )
+
+        child = child_context(ctx)
+        return _SpanCtx(self, name, trace_args(child, **args), ctx=child)
+
     def instant(self, name: str, **args) -> None:
         """Zero-duration metadata marker (e.g. the grad-sync plan)."""
-        self.record(name, time.perf_counter(), 0.0, args=args or None)
+        self.record(name, self._clock(), 0.0, args=args or None)
 
     # -- introspection ------------------------------------------------------
     def events(self) -> List[Span]:

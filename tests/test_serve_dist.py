@@ -174,11 +174,15 @@ class _RouterRig:
             **kw,
         }
         self.router.submit_request(item)
+        # Dispatch sends are asynchronous (per-member outbox threads);
+        # the rig's assertions want them LANDED.
+        self.router.flush_outboxes()
 
     def beat_done(self, member_id, pairs, role="decode"):
         self.router.beat_handle.put(make_beat_item(
             role, member_id, done=pairs))
         self.router.poll()
+        self.router.flush_outboxes()
 
     def close(self):
         self.router.stop()

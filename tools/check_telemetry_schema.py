@@ -38,6 +38,7 @@ from ray_lightning_tpu.telemetry.schema import (  # noqa: E402
     validate_bench_serve_disagg,
     validate_bench_spec_decode,
     validate_bench_telemetry,
+    validate_bench_trace,
     validate_chrome_trace,
     validate_flight_bundle,
     validate_mpmd_snapshot,
@@ -49,6 +50,7 @@ from ray_lightning_tpu.telemetry.schema import (  # noqa: E402
     validate_serve_snapshot,
     validate_span_jsonl,
     validate_stream_item,
+    validate_trace_context,
 )
 from ray_lightning_tpu.telemetry.spans import SpanTracer  # noqa: E402
 
@@ -177,6 +179,130 @@ def _self_test_live_plane(tmp: str) -> list:
     problems += _self_test_opt_state()
     problems += _self_test_serve()
     problems += _self_test_mpmd()
+    problems += _self_test_trace()
+    return problems
+
+
+def _self_test_trace() -> list:
+    """Distributed-tracing producers vs their schema: the propagate
+    inject/extract envelope on REAL wire frames (request_fields, a
+    handoff item, a QueueChannel mpmd_xfer), a wall-clock tracer's
+    ``start_remote`` export, the trace_collect stitcher's Chrome
+    output, and the bench trace block — plus negatives (empty ids,
+    coverage outside [0, 1], a phase summary missing its percentiles,
+    both payload forms)."""
+    import time
+
+    from ray_lightning_tpu.mpmd.transfer import QueueChannel
+    from ray_lightning_tpu.serve.dist.handoff import (
+        make_handoff_item, request_fields,
+    )
+    from ray_lightning_tpu.telemetry import trace_collect
+    from ray_lightning_tpu.telemetry.propagate import (
+        child_context, extract, root_context,
+    )
+    from ray_lightning_tpu.telemetry.spans import SpanTracer
+
+    problems = []
+    root = root_context("rid42")
+    if root.span_id != "rid42.root":
+        problems.append("self-test trace: root span id not derived")
+    req = request_fields(
+        "rid42", [1, 2, 3], 8, reply=("127.0.0.1", 9), sample_seed=1,
+        trace=root,
+    )
+    problems += validate_serve_request(req, "self-test traced request")
+    problems += validate_trace_context(
+        req.get("trace"), "self-test trace envelope"
+    )
+    if extract(req) != root:
+        problems.append("self-test trace: inject/extract not a roundtrip")
+    child = child_context(root)
+    handoff = make_handoff_item(req, bucket=16, data=b"\x00",
+                                trace=child)
+    problems += validate_serve_kv_handoff(
+        handoff, "self-test traced handoff"
+    )
+    if not validate_trace_context({"trace_id": "", "span_id": "x"}):
+        problems.append(
+            "self-test trace: validator accepted an empty trace_id"
+        )
+    if not validate_serve_request(
+        {**req, "trace": {"span_id": "x"}}
+    ):
+        problems.append(
+            "self-test trace: request validator accepted a trace "
+            "without trace_id"
+        )
+
+    # A traced mpmd_xfer through the REAL channel encoder.
+    sent = []
+
+    class _StubHandle:
+        def put(self, item):
+            sent.append(item)
+
+        def close(self):
+            pass
+
+    chan = QueueChannel.__new__(QueueChannel)
+    chan._handle = _StubHandle()
+    chan._store = None
+    chan._shm_threshold = 1 << 30
+    chan.bytes_sent = 0
+    chan.shm_sends = 0
+    chan.send("act", 0, 1, {"x": [1.0]}, chunk=0, trace=root)
+    problems += validate_mpmd_xfer(sent[0], "self-test traced xfer")
+    if "trace" not in sent[0]:
+        problems.append("self-test trace: channel dropped the envelope")
+
+    # Remote-parented spans through the REAL tracer + stitcher.
+    tracer = SpanTracer(enabled=True, rank=0, clock=time.time)
+    with tracer.start_remote(root, "prefill_compute", rid="rid42") as sp:
+        if sp.ctx is None or sp.ctx.parent_span_id != root.span_id:
+            problems.append(
+                "self-test trace: start_remote did not parent to the "
+                "remote context"
+            )
+    with tempfile.TemporaryDirectory(prefix="rlt_trace_") as tmp:
+        tracer.export_jsonl(os.path.join(tmp, "trace-worker.jsonl"))
+        with open(os.path.join(tmp, "trace-worker.jsonl")) as f:
+            problems += validate_span_jsonl(
+                f.readlines(), "self-test trace jsonl"
+            )
+        spans = trace_collect.load_trace_dir(tmp)
+        problems += validate_chrome_trace(
+            trace_collect.stitch_chrome(spans), "self-test stitched"
+        )
+
+    block = {
+        "coverage": 1.0, "requests": 24, "overhead_pct": 0.4,
+        "complete_chains": 24, "spans": 480,
+        "traced_requests_per_sec": 8.1,
+        "baseline_requests_per_sec": 8.2,
+        "replicas": 2, "prefill_workers": 1,
+        "phases": {
+            "queue_wait": {"n": 24, "p50_ms": 0.2, "p95_ms": 1.1},
+            "prefill_compute": {"n": 24, "p50_ms": 9.0, "p95_ms": 14.0},
+        },
+    }
+    problems += validate_bench_trace(block, "self-test bench trace")
+    if not validate_bench_trace({**block, "coverage": 1.2}):
+        problems.append(
+            "self-test bench trace: validator accepted coverage > 1"
+        )
+    if not validate_bench_trace({"coverage": 1.0}):
+        problems.append(
+            "self-test bench trace: validator accepted a block missing "
+            "the phase map"
+        )
+    if not validate_bench_trace(
+        {**block, "phases": {"queue_wait": {"n": 1, "p50_ms": 0.1}}}
+    ):
+        problems.append(
+            "self-test bench trace: validator accepted a phase summary "
+            "missing p95"
+        )
     return problems
 
 
@@ -669,6 +795,9 @@ def scan_bench_files() -> list:
             problems += validate_bench_serve_disagg(
                 disagg, f"{name}:serve_disagg"
             )
+        trace = doc.get("trace") or (serve or {}).get("trace")
+        if trace is not None:  # pre-tracing rounds lack it
+            problems += validate_bench_trace(trace, f"{name}:trace")
         mpmd = doc.get("mpmd")
         if mpmd is not None:  # pre-MPMD rounds lack it
             problems += validate_bench_mpmd(mpmd, f"{name}:mpmd")

@@ -71,6 +71,14 @@ for k in 2 4 8; do
     2>&1 | tee "tools/hw_logs/${stamp}_bench_serve_spec_k${k}.log"
 done
 
+log "serve A/B: request-tracing overhead, cheap tier on/off (trace block)"
+# bench_serve phase 6 runs the traced-vs-untraced closed-loop A/B and
+# the inproc-fleet stitch-coverage probe internally; on real chips the
+# overhead number is the one that matters (spans are host-side dict
+# records racing ~ms device steps instead of ~100ms CPU steps).
+RLT_DISAGG_REPLICAS=0 timeout 1800 python bench_serve.py \
+  2>&1 | tee "tools/hw_logs/${stamp}_bench_serve_trace.log"
+
 log "serve A/B: disaggregated fleet vs monolith (serve_disagg block)"
 # Replica-count sweep on real chips: each decode replica + prefill
 # worker owns its own device set, so (unlike the contended CPU arm)

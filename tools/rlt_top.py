@@ -135,6 +135,35 @@ def _render_serve(serve: Dict[str, Any]) -> list:
                 for family, s in sorted(latency.items())
             )
         )
+    lines += _render_phases(serve)
+    return lines
+
+
+_PHASE_ORDER = ("queue_wait", "placement", "prefill_compute",
+                "handoff_transfer", "decode_admission", "first_token")
+
+
+def _render_phases(serve: Dict[str, Any]) -> list:
+    """The critical-path phase pane (tracing engines export a
+    ``phases`` block in their snapshot): where each request's TTFT
+    went, as live p50/p95 per phase."""
+    phases = serve.get("phases")
+    if not phases:
+        return []
+    lines = ["phases:  " + "  ".join(
+        f"{name} p50/p95 "
+        f"{phases[name].get('p50_ms', 0):.1f}/"
+        f"{phases[name].get('p95_ms', 0):.1f}ms"
+        for name in _PHASE_ORDER if name in phases
+    )]
+    extra = sorted(set(phases) - set(_PHASE_ORDER))
+    if extra:
+        lines.append("         " + "  ".join(
+            f"{name} p50/p95 "
+            f"{phases[name].get('p50_ms', 0):.1f}/"
+            f"{phases[name].get('p95_ms', 0):.1f}ms"
+            for name in extra
+        ))
     return lines
 
 

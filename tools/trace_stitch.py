@@ -1,0 +1,92 @@
+"""trace_stitch — merge a fleet's span exports into one Perfetto trace.
+
+Point it at a telemetry directory (or at a ``router-live.json`` — its
+parent directory is used, so tab-completing the live artifact an
+operator is already watching Just Works).  It merges every
+``trace-*.jsonl`` component export (serve engines, router, prefill
+workers, MPMD stage runners) into ONE Chrome ``trace_event`` document
+with cross-process flow arrows, and prints the critical-path report:
+stitch coverage, per-phase p50/p95, and the slowest-K requests'
+``queue_wait → … → first_token`` decomposition (plus the per-step
+compute-vs-blocked MPMD timeline when stage traces are present).
+
+Usage:
+    python tools/trace_stitch.py rlt_logs/serve/telemetry
+    python tools/trace_stitch.py rlt_logs/serve/telemetry/router-live.json
+    python tools/trace_stitch.py <dir> --out merged-trace.json --slowest 10
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_lightning_tpu.telemetry import trace_collect  # noqa: E402
+
+
+def resolve_dir(path: str) -> str:
+    """A telemetry dir, or any file inside one (router-live.json /
+    serve-live.json discovery)."""
+    if os.path.isdir(path):
+        return path
+    if os.path.isfile(path):
+        return os.path.dirname(os.path.abspath(path)) or "."
+    raise FileNotFoundError(f"no such file or directory: {path}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Stitch per-process span exports into one "
+        "Perfetto trace + a critical-path report."
+    )
+    ap.add_argument(
+        "path",
+        help="telemetry dir holding trace-*.jsonl exports (or a "
+        "router-live.json/serve-live.json inside one)",
+    )
+    ap.add_argument(
+        "--out", default=None,
+        help="merged Chrome-trace output path (default: "
+        "<dir>/trace-merged.json)",
+    )
+    ap.add_argument("--slowest", type=int, default=5, metavar="K",
+                    help="requests in the critical-path report")
+    ap.add_argument("--no-report", action="store_true",
+                    help="write the merged trace only")
+    args = ap.parse_args(argv)
+
+    try:
+        trace_dir = resolve_dir(args.path)
+    except FileNotFoundError as e:
+        print(f"trace_stitch: {e}", file=sys.stderr)
+        return 2
+    spans = trace_collect.load_trace_dir(trace_dir)
+    if not spans:
+        print(
+            f"trace_stitch: no trace-*.jsonl under {trace_dir} "
+            "(tracing off? fleet not torn down yet? exports land at "
+            "member close)",
+            file=sys.stderr,
+        )
+        return 1
+    out = args.out or os.path.join(trace_dir, "trace-merged.json")
+    doc = trace_collect.stitch_chrome(spans)
+    with open(out, "w") as f:
+        json.dump(doc, f)
+    n_x = sum(1 for e in doc["traceEvents"] if e.get("ph") == "X")
+    n_flow = sum(1 for e in doc["traceEvents"] if e.get("ph") == "s")
+    print(f"trace_stitch: {len(spans)} span(s) from "
+          f"{len(doc['otherData']['sources'])} component(s) -> {out} "
+          f"({n_x} slices, {n_flow} cross-process arrows) — open in "
+          f"https://ui.perfetto.dev")
+    if not args.no_report:
+        print(trace_collect.format_report(spans, slowest_k=args.slowest))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
