@@ -21,7 +21,13 @@
 #      subprocesses/fits) — the RLT_FAULT grammar, deterministic
 #      matching, exactly-once markers and the file corruptors vs the
 #      checkpoint verifier.  The full fault matrix lives in
-#      "python tools/chaos_sweep.py" / "pytest -m chaos".
+#      "python tools/chaos_sweep.py" / "pytest -m chaos";
+#   6. rlt-lint (tools/rlt_lint, stdlib-ast only) — the repo's own
+#      invariants as machine checks: hot-path jit/host-sync bans,
+#      guarded-by lock discipline, clock discipline, the RLT_* env-bus
+#      registry, telemetry schema-key drift, thread hygiene.  Fixture
+#      self-test first, then changed-scope lint (--all honored) against
+#      the committed baseline.  Catalog: docs/STATIC_ANALYSIS.md.
 # Missing optional tools are reported and skipped; the builtin layer
 # still gates, so "./format.sh --all" is meaningful everywhere.
 set -euo pipefail
@@ -43,11 +49,19 @@ for arg in "$@"; do
   esac
 done
 
+# Untracked files are invisible to both ls-files (default) and diff —
+# without the union a brand-new file ships past layers 1-3 unchecked
+# until after commit.  ACMR keeps renamed-and-edited files (status R)
+# in the changed scope; plain ACM drops them.
 if [ "$SCOPE" = all ]; then
-  mapfile -t FILES < <(git ls-files '*.py')
+  mapfile -t FILES < <(
+    { git ls-files '*.py'
+      git ls-files --others --exclude-standard '*.py'; } | sort -u)
 else
   base=$(git merge-base HEAD origin/main 2>/dev/null || echo HEAD)
-  mapfile -t FILES < <(git diff --name-only --diff-filter=ACM "$base" -- '*.py')
+  mapfile -t FILES < <(
+    { git diff --name-only --diff-filter=ACMR "$base" -- '*.py'
+      git ls-files --others --exclude-standard '*.py'; } | sort -u)
 fi
 [ ${#FILES[@]} -eq 0 ] && { echo "format.sh: no python files in scope"; exit 0; }
 
@@ -119,6 +133,20 @@ python tools/check_telemetry_schema.py || fail=1
 # corruptor/verifier pair, so a drifted RLT_FAULT parser can't silently
 # turn the recovery acceptance suite into a no-op.
 python tools/chaos_sweep.py --selftest || fail=1
+
+# -- layer 6: rlt-lint invariant checks (stdlib-ast, zero extra deps) --------
+# The fixture matrix self-tests every rule (a rule edit that stops
+# flagging its own positive fixtures fails here), then the lint runs at
+# the same scope as the rest of this script: changed files by default,
+# the whole tree under --all, gating either way.  Suppressions need a
+# reason; grandfathered sites live in tools/rlt_lint/baseline.json and
+# are enumerated in docs/STATIC_ANALYSIS.md.
+python -m tools.rlt_lint --selftest || fail=1
+if [ "$SCOPE" = all ]; then
+  python -m tools.rlt_lint --all || fail=1
+else
+  python -m tools.rlt_lint --changed || fail=1
+fi
 
 if [ $fail -ne 0 ]; then
   echo "format.sh: FAILED (run ./format.sh --fix after installing tools)"

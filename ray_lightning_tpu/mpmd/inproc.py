@@ -124,8 +124,11 @@ def run_inproc_pipeline_fit(
                 box.fail(e)
 
     threads = [
+        # daemon: a wedged stage must not pin the interpreter open after
+        # the harness gives up joining (errors surface via `errors`).
         threading.Thread(
-            target=drive, args=(r,), name=f"rlt-mpmd-w{r.worker}"
+            target=drive, args=(r,), name=f"rlt-mpmd-w{r.worker}",
+            daemon=True,
         )
         for r in runners
     ]

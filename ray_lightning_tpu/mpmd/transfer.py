@@ -104,9 +104,9 @@ class Mailbox:
     the step's distributed-trace identity from its upstream neighbor."""
 
     def __init__(self):
-        self._items: Dict[Tuple, Any] = {}
         self._cond = threading.Condition()
-        self._error: Optional[BaseException] = None
+        self._items: Dict[Tuple, Any] = {}      # guarded by self._cond
+        self._error: Optional[BaseException] = None  # guarded by self._cond
 
     def deliver(self, key: Tuple, payload: Any,
                 trace: Optional[Dict[str, Any]] = None) -> None:

@@ -52,6 +52,7 @@ from ray_lightning_tpu.core.loop import (
     run_predict,
 )
 from ray_lightning_tpu.fault import drain as drain_mod
+from ray_lightning_tpu.parallel import env_bus
 from ray_lightning_tpu.fault.drain import PreemptedError
 from ray_lightning_tpu.util import process_results
 
@@ -351,36 +352,15 @@ class TpuStrategy:
             self.env_per_worker.setdefault(
                 "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0"
             )
-        # Gradient-comm env bus: forwarded the same way RLT_COMPILE_CACHE
-        # is — remote workers (node agents, Ray runtime_env) inherit the
-        # AGENT's env, not the driver's, so without this bridge a
-        # driver-side RLT_GRAD_COMM would silently resolve to full-width
-        # on exactly the multi-host topology compression targets.
-        for var in ("RLT_GRAD_COMM", "RLT_GRAD_BUCKET_MB",
-                    "RLT_GRAD_BLOCK", "RLT_GRAD_DCN_ONLY",
-                    # Telemetry env bus rides the same bridge: a
-                    # driver-side RLT_TELEMETRY must reach workers
-                    # spawned through node agents too.
-                    "RLT_TELEMETRY", "RLT_TELEMETRY_SAMPLE",
-                    "RLT_TELEMETRY_DIR", "RLT_TELEMETRY_PEAK",
-                    # Live-plane worker knobs: heartbeat cadence and the
-                    # flight-recorder/log-ring switches are read worker-
-                    # side at fit start.
-                    "RLT_HEARTBEAT_S", "RLT_FLIGHT_RECORDER",
-                    "RLT_LOG_RING",
-                    # Chaos plane (fault/inject.py): faults and their
-                    # exactly-once marker dir must reach remote workers,
-                    # or a driver-side RLT_FAULT would only ever test
-                    # the inline path.  The drain-agreement cadence
-                    # rides along (loop-side knob).
-                    "RLT_FAULT", "RLT_FAULT_STATE",
-                    "RLT_DRAIN_SYNC_EVERY",
-                    # Megastep execution mode (core/loop.py): a driver-
-                    # side RLT_MEGASTEP must reach remote workers or the
-                    # knob would only ever affect inline fits.  The
-                    # sharded-weight-update knob rides the same bridge —
-                    # it resolves worker-side against the real mesh.
-                    "RLT_MEGASTEP", "RLT_UPDATE_SHARDING"):
+        # Worker env bus: every forward-marked knob in the central
+        # registry (parallel/env_bus.py) rides the same bridge
+        # RLT_COMPILE_CACHE does — remote workers (node agents, Ray
+        # runtime_env) inherit the AGENT's env, not the driver's, so
+        # without this a driver-side RLT_GRAD_COMM would silently
+        # resolve to full-width on exactly the multi-host topology
+        # compression targets.  The knob list lives in ONE place; the
+        # rlt_lint RLT005 rule cross-checks every env read against it.
+        for var in env_bus.forwarded_vars():
             val = os.environ.get(var)
             if val is not None:
                 self.env_per_worker.setdefault(var, val)

@@ -100,11 +100,14 @@ class PrefillRunner:
         self._shm_threshold = shm_threshold
         self._segment_ttl_s = segment_ttl_s
         self._store = None           # SegmentStore, lazily created
-        self._live_segments: List[Tuple[str, float]] = []
         self._out = CachedSender()
+        # Work thread appends, beat thread prunes/drains: everything
+        # below is shared between them (the PR-12 review races).
         self._feed_lock = threading.Lock()
-        self._done: List[Tuple[str, str]] = []
-        self._failed: List[Tuple[str, str]] = []
+        # guarded by self._feed_lock
+        self._live_segments: List[Tuple[str, float]] = []
+        self._done: List[Tuple[str, str]] = []    # guarded by self._feed_lock
+        self._failed: List[Tuple[str, str]] = []  # guarded by self._feed_lock
         self._last_beat = 0.0
         self.prefills = 0
         # Distributed tracing: worker-side spans continue the router-

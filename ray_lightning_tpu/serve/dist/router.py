@@ -175,12 +175,14 @@ class Router:
         self._prefill_factory = prefill_factory
         self._beats = DriverQueue()
         self._requests = DriverQueue()
-        self._replicas: Dict[str, _Member] = {}
-        self._workers: Dict[str, _Member] = {}
-        self._inflight: Dict[str, _Track] = {}
+        # Fleet/request state shared between the poll thread,
+        # submitters, and outbox error callbacks.
+        self._replicas: Dict[str, _Member] = {}  # guarded by self._lock
+        self._workers: Dict[str, _Member] = {}   # guarded by self._lock
+        self._inflight: Dict[str, _Track] = {}   # guarded by self._lock
         # Failover re-submissions that found every candidate saturated:
         # retried each poll — a failed-over request is never dropped.
-        self._retry: deque = deque()
+        self._retry: deque = deque()             # guarded by self._lock
         self.counters: Dict[str, int] = {
             "routed": 0, "completed": 0, "rejected": 0, "expired": 0,
             "invalid": 0, "failovers": 0, "failed_over_requests": 0,
@@ -198,9 +200,10 @@ class Router:
         # lanes are reaped (clients come and go; re-creation on the
         # next send is one TCP connect) and _closing gates creation
         # during stop().
+        # guarded by self._lock
         self._outboxes: Dict[Tuple[str, int], MemberOutbox] = {}
         self._outbox_idle_s = 120.0
-        self._closing = False
+        self._closing = False                    # guarded by self._lock
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -332,11 +335,12 @@ class Router:
         self._sweep_segments()
 
     # -- beats ---------------------------------------------------------------
-    def _member(self, role: str, member_id: str) -> Optional[_Member]:
+    def _member(self, role: str,
+                member_id: str) -> Optional[_Member]:  # rlt: holds self._lock
         pool = self._replicas if role == "decode" else self._workers
         return pool.get(member_id)
 
-    def _drain_beats(self, now: float) -> None:
+    def _drain_beats(self, now: float) -> None:  # rlt: holds self._lock
         import queue as _pyqueue
 
         while True:
@@ -357,7 +361,8 @@ class Router:
             elif kind == "serve_replica_beat":
                 self._ingest_beat(item, now)
 
-    def _ingest_beat(self, item: Dict[str, Any], now: float) -> None:
+    def _ingest_beat(self, item: Dict[str, Any],
+                     now: float) -> None:  # rlt: holds self._lock
         m = self._member(str(item.get("role")), str(item.get("id")))
         if m is None:
             return
@@ -384,7 +389,8 @@ class Router:
         if item.get("closing") and m.alive:
             self._on_member_closing(m, now)
 
-    def _complete(self, rid: str, status: str) -> None:
+    def _complete(self, rid: str,
+                  status: str) -> None:  # rlt: holds self._lock
         track = self._inflight.pop(rid, None)
         if track is None:
             return
@@ -401,7 +407,8 @@ class Router:
                                 resubmits=track.resubmits),
             )
 
-    def _on_member_closing(self, m: _Member, now: float) -> None:
+    def _on_member_closing(self, m: _Member,
+                           now: float) -> None:  # rlt: holds self._lock
         """Planned member drain (the ``closing`` flag on a final beat —
         an operator scale-down, NOT a crash): stop routing to it and
         re-place its remaining work, without burning failure counters,
@@ -428,7 +435,8 @@ class Router:
                         must_place=True)
         self._sweep_segments()
 
-    def _on_handoff_failure(self, rid: str, err: str, now: float) -> None:
+    def _on_handoff_failure(self, rid: str, err: str,
+                            now: float) -> None:  # rlt: holds self._lock
         """A prefill worker could not deliver to the chosen replica —
         trust the signal and re-route AWAY from it (if that replica is
         healthy, losing one placement is cheap; if it is dying, beats
@@ -443,7 +451,7 @@ class Router:
         self._route(rid, track, now, exclude=exclude, must_place=True)
 
     # -- client submissions --------------------------------------------------
-    def _drain_requests(self, now: float) -> None:
+    def _drain_requests(self, now: float) -> None:  # rlt: holds self._lock
         import queue as _pyqueue
 
         while True:
@@ -518,7 +526,8 @@ class Router:
             self._route(rid, track, now)
             return rid
 
-    def _validate(self, req: Dict[str, Any]) -> Optional[str]:
+    def _validate(self,
+                  req: Dict[str, Any]) -> Optional[str]:  # rlt: holds self._lock
         """Cheap fleet-geometry validation so prefill workers never see
         a prompt they cannot bucket (the engines re-validate anyway)."""
         if not req["prompt"]:
@@ -542,11 +551,11 @@ class Router:
         return None
 
     # -- placement -----------------------------------------------------------
-    def _assigned(self, replica_id: str) -> int:
+    def _assigned(self, replica_id: str) -> int:  # rlt: holds self._lock
         return sum(1 for t in self._inflight.values()
                    if t.replica == replica_id)
 
-    def _pending(self, worker_id: str) -> int:
+    def _pending(self, worker_id: str) -> int:  # rlt: holds self._lock
         return sum(1 for t in self._inflight.values()
                    if t.worker == worker_id)
 
@@ -554,6 +563,7 @@ class Router:
         gauges = m.snapshot.get("gauges", {}) if m.snapshot else {}
         return float(gauges.get("blocks_free", 0.0))
 
+    # rlt: holds self._lock
     def _route(self, rid: str, track: _Track, now: float,
                exclude: Set[str] = frozenset(),
                must_place: bool = False) -> None:
@@ -683,10 +693,11 @@ class Router:
 
         return on_sent
 
-    def _park(self, rid: str) -> None:
+    def _park(self, rid: str) -> None:  # rlt: holds self._lock
         if rid not in self._retry:
             self._retry.append(rid)
 
+    # rlt: holds self._lock
     def _finish_unroutable(self, rid: str, track: _Track, status: str,
                            error: str) -> None:
         self._inflight.pop(rid, None)
@@ -702,7 +713,7 @@ class Router:
             done["error"] = error
         self._reply(tuple(track.req["reply"]), done)
 
-    def _drain_retry(self, now: float) -> None:
+    def _drain_retry(self, now: float) -> None:  # rlt: holds self._lock
         pending, self._retry = list(self._retry), deque()
         for rid in pending:
             track = self._inflight.get(rid)
@@ -712,7 +723,7 @@ class Router:
             self._route(rid, track, now, must_place=True)
 
     # -- liveness / failover -------------------------------------------------
-    def _check_liveness(self, now: float) -> None:
+    def _check_liveness(self, now: float) -> None:  # rlt: holds self._lock
         for m in list(self._replicas.values()):
             if m.alive and self._is_lost(m, now):
                 self._on_replica_death(m, now)
@@ -730,7 +741,8 @@ class Router:
             else self.hello_grace_s
         return m.beat_age_s(now) > grace
 
-    def _on_replica_death(self, m: _Member, now: float) -> None:
+    def _on_replica_death(self, m: _Member,
+                          now: float) -> None:  # rlt: holds self._lock
         """Serving-side fault tolerance: fail the dead replica's
         in-flight requests over to survivors.  Re-submission rides the
         engines' recompute-preemption path — tokens re-emit from index
@@ -772,7 +784,8 @@ class Router:
             self._route(rid, track, now, exclude={m.id}, must_place=True)
         self._reap(m)
 
-    def _on_worker_death(self, w: _Member, now: float) -> None:
+    def _on_worker_death(self, w: _Member,
+                         now: float) -> None:  # rlt: holds self._lock
         if not w.alive:
             return
         w.alive = False
@@ -815,8 +828,11 @@ class Router:
         def kill_quietly():
             try:
                 m.handle.kill()
-            except Exception:  # noqa: BLE001 - reaping is best-effort
-                pass
+            except Exception:  # noqa: BLE001 - reaping is best-effort,
+                # but a swallowed kill failure (RLT007) would hide a
+                # leaked member process from the operator entirely.
+                log.debug("reap of %s %s failed", m.role, m.id,
+                          exc_info=True)
 
         threading.Thread(target=kill_quietly, name="rlt-router-reap",
                          daemon=True).start()
@@ -833,7 +849,8 @@ class Router:
             pass
 
     # -- wire helpers --------------------------------------------------------
-    def _outbox(self, addr: Tuple[str, int]) -> MemberOutbox:
+    def _outbox(self,
+                addr: Tuple[str, int]) -> MemberOutbox:  # rlt: holds self._lock
         if self._closing:
             raise ConnectionError("router is stopping")
         addr = (addr[0], int(addr[1]))

@@ -269,17 +269,21 @@ class ServeEngine:
         )
         self._build_programs()
 
-        self._handles: Dict[str, ServeHandle] = {}
+        self._handles: Dict[str, ServeHandle] = {}  # guarded by self._lock
         # Terminal (rid, status) pairs since the last drain_done() —
         # the completion feed a disaggregated replica's beats carry so
         # the router can prune its in-flight tracking.  Bounded: an
         # undreained feed (no router) must never grow without bound.
-        self._done_feed: deque = deque(maxlen=4096)
+        self._done_feed: deque = deque(maxlen=4096)  # guarded by self._lock
         self._error: Optional[BaseException] = None
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._inbox = None           # DriverQueue, lazily created
+        # Serve-thread send cache; stop() closes it from the
+        # caller's thread after a join(timeout) that a wedged
+        # dispatch can outlive — so it shares the lock.
+        # guarded by self._lock
         self._reply_handles: Dict[Tuple[str, int], Any] = {}
         self._exporter = None
         self._live_path = None
@@ -559,7 +563,7 @@ class ServeEngine:
                                     preemptions=req.preemptions),
                 )
                 self.stats.note_phase("queue_wait", wait)
-            ids = np.asarray(
+            ids = np.asarray(  # rlt: noqa[RLT002] host block list, no device value
                 self.scheduler._blocks[slot][: bucket
                                              // self.config.block_size],
                 np.int32,
@@ -612,7 +616,7 @@ class ServeEngine:
                     self.draft_params, self._draft_pool, padded,
                     np.int32(req.prompt_len), ids,
                 )
-            first = int(first)
+            first = int(first)  # rlt: noqa[RLT002] deliberate TTFT sync at admission
             t_first = time.monotonic()
             if ctx is not None:
                 # The int() above synced the device, so this interval
@@ -675,6 +679,7 @@ class ServeEngine:
             if req is None or widths[slot] == 0:
                 continue
             w = widths[slot]
+            # rlt: noqa[RLT002] host np state
             seq_len = int(self.scheduler.seq_lens[slot])
             while w > 0 and not self.scheduler.cover(slot, seq_len + w):
                 w -= 1  # pool can't fund the window: draft less
@@ -747,6 +752,7 @@ class ServeEngine:
                 seq_lens + 1,
             )
             self.stats.bump("draft_steps")
+        # rlt: noqa[RLT002] deliberate: the tick must emit tokens
         toks = np.asarray(toks)
         dt = time.monotonic() - t0
         self.stats.bump("decode_steps")
@@ -754,7 +760,7 @@ class ServeEngine:
         for slot in active:
             self.scheduler.seq_lens[slot] += 1
             self.scheduler.draft_lens[slot] = self.scheduler.seq_lens[slot]
-            tok = int(toks[slot])
+            tok = int(toks[slot])  # rlt: noqa[RLT002] host np after the tick fetch
             self._cur_tokens[slot] = tok
             done = self.scheduler.append_token(slot, tok)
             if done:
@@ -788,8 +794,10 @@ class ServeEngine:
         t0 = time.monotonic()
         limits = np.zeros((self.config.num_slots,), np.int32)
         for slot in active:
-            limits[slot] = int(sched.seq_lens[slot]) + widths[slot] + 1
-        gaps = np.where(
+            limits[slot] = (  # rlt: noqa[RLT002] host np state
+                int(sched.seq_lens[slot]) + widths[slot] + 1
+            )
+        gaps = np.where(  # rlt: noqa[RLT002] host scheduler arrays
             np.asarray([r is not None for r in sched.slots]),
             sched.seq_lens - sched.draft_lens, 0,
         ).astype(np.int32)
@@ -800,7 +808,7 @@ class ServeEngine:
         for slot in active:
             req = sched.slots[slot]
             if gaps[slot]:
-                start[slot] = req.generated[
+                start[slot] = req.generated[  # rlt: noqa[RLT002] host np state
                     int(sched.draft_lens[slot]) - req.prompt_len
                 ]
             else:
@@ -825,14 +833,16 @@ class ServeEngine:
             )
             outs.append(prev)
         self.stats.bump("draft_steps", K + 1)
-        outs = np.stack([np.asarray(o) for o in outs])  # (K+1, W)
+        outs = np.stack(  # rlt: noqa[RLT002] deliberate: host accept/reject
+            [np.asarray(o) for o in outs]
+        )  # (K+1, W)
 
         # Per-slot proposals: the K chain outputs starting at the
         # slot's gap offset.
         window = np.zeros((self.config.num_slots, K + 1), np.int32)
         window[:, 0] = self._cur_tokens
         for slot in active:
-            g = int(gaps[slot])
+            g = int(gaps[slot])  # rlt: noqa[RLT002] host np state
             window[slot, 1: K + 1] = outs[g: g + K, slot]
 
         sampled, self._pool = self._verify_fn(
@@ -841,6 +851,7 @@ class ServeEngine:
             limits_j, jnp.asarray(sched.temperatures),
             jnp.asarray(sched.sample_seeds), self._tick_top_ks(),
         )
+        # rlt: noqa[RLT002] deliberate verify sync
         sampled = np.asarray(sampled)  # (W, K+1)
         self.stats.bump("verify_steps")
         dt = time.monotonic() - t0
@@ -853,10 +864,10 @@ class ServeEngine:
             accepted = 0
             while accepted < w and drafts[accepted] == target[accepted]:
                 accepted += 1
-            emit = [int(t) for t in drafts[:accepted]]
-            emit.append(int(target[accepted]))
-            seq_was = int(sched.seq_lens[slot])
-            draft_was = int(sched.draft_lens[slot])
+            emit = [int(t) for t in drafts[:accepted]]  # rlt: noqa[RLT002] host np
+            emit.append(int(target[accepted]))  # rlt: noqa[RLT002] host np
+            seq_was = int(sched.seq_lens[slot])  # rlt: noqa[RLT002] host np state
+            draft_was = int(sched.draft_lens[slot])  # rlt: noqa[RLT002] host np state
             n, done = sched.append_tokens(slot, emit)
             new_len = seq_was + n
             # Roll BOTH caches back to the emitted frontier: the target
@@ -945,14 +956,14 @@ class ServeEngine:
         instead of letting them block to their timeouts."""
         import logging
 
-        logging.getLogger(__name__).error(
-            "serve loop died: %r — failing %d pending request(s)",
-            exc, len(self._handles), exc_info=exc,
-        )
         self._error = exc
         with self._lock:
             handles = list(self._handles.values())
             self._handles.clear()
+        logging.getLogger(__name__).error(
+            "serve loop died: %r — failing %d pending request(s)",
+            exc, len(handles), exc_info=exc,
+        )
         for handle in handles:
             handle.error = exc
             req = handle.request
@@ -973,9 +984,11 @@ class ServeEngine:
         if self._inbox is not None:
             self._inbox.shutdown()
             self._inbox = None
-        for h in self._reply_handles.values():
+        with self._lock:
+            reply_handles = list(self._reply_handles.values())
+            self._reply_handles.clear()
+        for h in reply_handles:
             h.close()
-        self._reply_handles.clear()
         if self._exporter is not None:
             self._exporter.close()
         if self._trace_dir is not None and self.tracer.events():
@@ -1153,15 +1166,17 @@ class ServeEngine:
     def _reply(self, addr: Tuple[str, int], item: dict) -> None:
         from ray_lightning_tpu.cluster.queue import QueueHandle
 
-        handle = self._reply_handles.get(addr)
-        if handle is None:
-            handle = QueueHandle(addr[0], addr[1])
-            self._reply_handles[addr] = handle
+        with self._lock:
+            handle = self._reply_handles.get(addr)
+            if handle is None:
+                handle = QueueHandle(addr[0], addr[1])
+                self._reply_handles[addr] = handle
         try:
             handle.put(item)
         except (OSError, ConnectionError):
             # Client went away: drop its stream, keep serving others.
-            self._reply_handles.pop(addr, None)
+            with self._lock:
+                self._reply_handles.pop(addr, None)
 
     # -- telemetry -----------------------------------------------------------
     def _refresh_gauges(self) -> None:

@@ -137,8 +137,14 @@ class HeartbeatPublisher:
         self._sink = sink
         self._interval_s = interval_s
         self._telemetry = telemetry
-        self._seq = 0
-        self.beats_sent = 0
+        # Publish lock: stop() sends the final done beat from the
+        # CALLER's thread after joining the publisher with a timeout —
+        # a wedged sink can outlive that join, leaving two threads in
+        # _publish concurrently (duplicate seq numbers, interleaved
+        # file-sink writes).  The lock serializes them.
+        self._lock = threading.Lock()
+        self._seq = 0                # guarded by self._lock
+        self.beats_sent = 0          # guarded by self._lock
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -178,13 +184,19 @@ class HeartbeatPublisher:
 
     # -- publishing ---------------------------------------------------------
     def _publish(self, done: bool = False) -> bool:
+        with self._lock:
+            return self._publish_locked(done=done)
+
+    def _publish_locked(self, done: bool = False) -> bool:
+        # rlt: holds self._lock
         self._seq += 1
         beat = make_beat(self.rank, self._seq, self._ctx,
                          self._telemetry, done=done)
         try:
             self._sink.put(beat)
-        except Exception:  # noqa: BLE001 - the queue dies at teardown /
-            # driver restart; heartbeats are diagnostics, never load-bearing.
+        except Exception:  # noqa: BLE001 - the queue dies at
+            # teardown / driver restart; heartbeats are
+            # diagnostics, never load-bearing.
             return False
         self.beats_sent += 1
         return True
@@ -215,7 +227,16 @@ class HeartbeatPublisher:
         self._thread.join(timeout=timeout_s)
         self._thread = None
         if final:
-            self._publish(done=True)
+            # Bounded acquire, not `with`: when the join above timed
+            # out the publisher thread may be wedged INSIDE a sink put
+            # holding the lock — a final beat could never land on that
+            # sink anyway, so skip it rather than hang teardown
+            # unboundedly.
+            if self._lock.acquire(timeout=timeout_s):
+                try:
+                    self._publish_locked(done=True)
+                finally:
+                    self._lock.release()
         close = getattr(self._sink, "close", None)
         if close is not None:
             try:

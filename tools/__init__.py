@@ -1,0 +1,1 @@
+"""Repo tooling namespace (``python -m tools.rlt_lint``)."""

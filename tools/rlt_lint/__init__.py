@@ -1,0 +1,31 @@
+"""rlt-lint: AST-based invariant checker for this repo's hot-path,
+lock, clock, env-bus, schema and thread disciplines.
+
+The rules mechanize recurring review findings (docs/STATIC_ANALYSIS.md
+carries the catalog and the historical bug each rule encodes):
+
+======= ================================================================
+RLT001  per-call ``jax.jit``/``pjit`` construction on a hot path
+RLT002  host-sync calls inside registered hot-loop bodies
+RLT003  ``# guarded by self._lock`` attributes accessed outside the lock
+RLT004  clock discipline (wall vs perf_counter vs jit-pure step fns)
+RLT005  unregistered ``RLT_*`` env reads (``parallel/env_bus.py``)
+RLT006  telemetry dict-literal keys vs ``telemetry/schema.py`` key sets
+RLT007  thread hygiene (implicit ``daemon``, swallowed thread errors)
+RLT000  lint infrastructure (bad suppressions, registry/baseline drift)
+======= ================================================================
+
+Zero dependencies beyond the stdlib ``ast`` module; runnable standalone
+(``python -m tools.rlt_lint [--changed|--all]``) and wired into
+``format.sh`` as layer 6.  Suppress a single line with
+``# rlt: noqa[RLT00x] <reason>`` — the reason is mandatory.
+"""
+
+from tools.rlt_lint.core import (  # noqa: F401
+    Config,
+    Finding,
+    check_source,
+    load_env_registry,
+    load_schema_keys,
+    repo_config,
+)

@@ -1,0 +1,5 @@
+import sys
+
+from tools.rlt_lint.cli import main
+
+sys.exit(main())

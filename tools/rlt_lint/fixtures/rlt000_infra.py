@@ -1,0 +1,22 @@
+# rlt-fixture: hot-sync Engine.gone_method  # expect[RLT000]
+"""RLT000 fixture: suppression and registry hygiene.
+
+The ``hot-sync`` directive on line 1 registers ``Engine.gone_method``,
+which does not exist below — registry drift is itself a finding,
+reported at line 1 so the config moves with the refactor.
+"""
+
+
+def suppressions(x):
+    a = float(x)  # rlt: noqa[RLT999] unknown rule  # expect[RLT000]
+    b = float(x)  # rlt: noqa[RLT002]  # expect[RLT000]
+    # clean: a well-formed suppression (known rule + reason) is no
+    # finding even where the suppressed rule never fired.
+    c = float(x)  # rlt: noqa[RLT002] reasoned and well-formed
+    return a, b, c
+
+
+class Engine:
+    def present_method(self):
+        # clean: a qualname that resolves satisfies the drift check.
+        return 1

@@ -1,0 +1,52 @@
+# rlt-fixture: hot-jit Engine.step tick_helper
+"""RLT001 fixture: jit construction on registered hot paths."""
+import functools
+
+import jax
+
+
+# Clean: module-level jit construction is the intended shape.
+_DECODE = jax.jit(lambda x: x + 1)
+
+
+# Clean: module-level @partial(jax.jit) — one object for the process.
+@functools.partial(jax.jit, static_argnums=0)
+def _scale(n, x):
+    return x * n
+
+
+@functools.lru_cache(maxsize=8)
+def make_fn(mesh):
+    # Clean: lru_cache'd factory — one construction per mesh.
+    return jax.jit(lambda t: t, out_shardings=mesh)
+
+
+def tick_helper(x):
+    fn = jax.jit(lambda t: t * 2)  # expect[RLT001]
+    return fn(x)
+
+
+class Engine:
+    def __init__(self):
+        # Clean: not a registered hot path — engine build time.
+        self._fn = jax.jit(lambda t: t)
+
+    def step(self, x):
+        y = self._fn(x)          # clean: using the cached jit object
+        g = jax.jit(self._fn)    # expect[RLT001]
+
+        @jax.jit               # expect[RLT001]
+        def inner(t):
+            return t - 1
+
+        # @partial(jax.jit, ...) constructs a fresh jit object just
+        # like @jax.jit — the required form for static/donated args.
+        @functools.partial(jax.jit, donate_argnums=0)  # expect[RLT001]
+        def donated(t):
+            return t * 3
+
+        return inner(donated(g(y)))
+
+    def build(self, x):
+        # Clean: not registered — setup-time construction is fine.
+        return jax.jit(lambda t: t)(x)

@@ -1,0 +1,30 @@
+# rlt-fixture: hot-sync Engine.step loop_body
+"""RLT002 fixture: host/device syncs inside hot-loop bodies."""
+import jax
+import numpy as np
+
+
+def loop_body(batch, metric):
+    lr = float(metric)                    # expect[RLT002]
+    host = np.asarray(batch)              # expect[RLT002]
+    jax.block_until_ready(batch)          # expect[RLT002]
+    n = int(batch.shape)                  # expect[RLT002]
+    v = metric.item()                     # expect[RLT002]
+    k = int(7)      # clean: constant args never touch the device
+    return lr, host, n, v, k
+
+
+def setup(batch):
+    # Clean: not a registered hot-loop body.
+    return float(batch.mean()), np.asarray(batch)
+
+
+class Engine:
+    def step(self, x):
+        first = int(x)  # rlt: noqa[RLT002] deliberate TTFT sync
+        ok = jax.device_get(x)            # expect[RLT002]
+        return first, ok
+
+    def report(self, x):
+        # Clean: reporting path, not registered.
+        return x.item()

@@ -1,0 +1,51 @@
+"""RLT003 fixture: guarded-attribute lock discipline."""
+import threading
+
+
+class Feed:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._done = []        # guarded by self._lock
+        self._failed = []      # guarded by self._lock
+        self.free = 0          # clean: unguarded attribute
+
+    def add(self, item):
+        with self._lock:
+            self._done.append(item)   # clean: inside the lock
+
+    def add_failed(self, item):
+        self._failed.append(item)     # expect[RLT003]
+
+    def drain(self):
+        items = self._done            # expect[RLT003]
+        self.free += 1                # clean: not a guarded attr
+        return items
+
+    def _drain_locked(self):  # rlt: holds self._lock
+        # Clean: the method asserts its caller holds the lock.
+        items, self._done = self._done, []
+        return items
+
+    def deferred(self):
+        with self._lock:
+            # A closure defined under the lock does NOT run under it.
+            def cb():
+                return len(self._done)   # expect[RLT003]
+
+            return cb
+
+    def peek_suppressed(self):
+        return list(self._done)  # rlt: noqa[RLT003] stale-ok snapshot
+
+    def sneaky(self):
+        # A guard comment pasted on a USE site is not a suppression —
+        # only the declaration assignment is exempt.
+        return len(self._done)  # guarded by self._lock  # expect[RLT003]
+
+
+class Other:
+    def __init__(self):
+        self._done = []   # clean: same name, class never annotates it
+
+    def touch(self):
+        return self._done

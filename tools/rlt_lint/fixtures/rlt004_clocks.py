@@ -1,0 +1,50 @@
+# rlt-fixture: perf-timing
+# rlt-fixture: wall-clock-tracer
+"""RLT004 fixture: wall vs perf_counter vs jit-purity discipline."""
+import time
+
+import jax
+
+from telemetry.spans import SpanTracer  # fixture-local import shape
+
+
+def measure_step():
+    t0 = time.time()                      # expect[RLT004]
+    dur = time.time() - t0                # expect[RLT004]
+    good0 = time.perf_counter()           # clean: perf timing module
+    return dur, time.perf_counter() - good0
+
+
+def envelope(rank):
+    return {
+        "type": "heartbeat",
+        "rank": rank,
+        "ts": time.time(),   # clean: wall-timestamp dict key
+    }
+
+
+def make_tracers(enabled):
+    # Clean: distributed tracer passes the shared wall epoch.
+    a = SpanTracer(enabled=enabled, clock=time.time)
+    b = SpanTracer(enabled=enabled)       # expect[RLT004]
+    return a, b
+
+
+def _raw_step(state, batch):
+    noise = time.perf_counter()           # expect[RLT004]
+    seed = __import__("random").random
+    return state, noise, seed
+
+
+_STEP = jax.jit(_raw_step)
+
+
+@jax.jit
+def _other_step(x):
+    t = time.time()                       # expect[RLT004]
+    return x, t
+
+
+def host_helper():
+    # Clean: not jit-wrapped — perf_counter is the right clock here.
+    return time.perf_counter()

@@ -1,0 +1,22 @@
+# rlt-fixture: trace-envelope
+"""RLT004 fixture: cross-process envelopes must use the wall clock."""
+import time
+
+
+def inject(item, ctx):
+    item["trace"] = {
+        "trace_id": ctx,
+        "ts": time.time(),   # clean: wall clock IS the envelope epoch
+    }
+    return item
+
+
+def bad_envelope(item):
+    item["sent"] = time.perf_counter()    # expect[RLT004]
+    t0 = time.perf_counter()              # expect[RLT004]
+    return item, t0
+
+
+def wall_ok():
+    # Clean: time.time is unrestricted in envelope modules.
+    return time.time()

@@ -1,0 +1,18 @@
+# rlt-fixture: env-registry RLT_KNOWN RLT_ALSO_KNOWN
+"""RLT005 fixture: RLT_* env reads vs the env_bus registry."""
+import os
+
+
+def read_knobs():
+    a = os.environ.get("RLT_KNOWN")              # clean: registered
+    b = os.getenv("RLT_ALSO_KNOWN", "x")         # clean: registered
+    c = os.environ.get("RLT_MYSTERY_KNOB")       # expect[RLT005]
+    d = os.environ["RLT_OTHER_MYSTERY"]          # expect[RLT005]
+    e = os.environ.get("JAX_PLATFORMS")          # clean: not RLT_*
+    return a, b, c, d, e
+
+
+def dynamic(name):
+    # Clean: non-literal reads cannot be checked statically (the
+    # monitor's from_env map); the registry still documents them.
+    return os.environ.get(name)

@@ -1,0 +1,36 @@
+# rlt-fixture: producer make_beat BEAT
+# rlt-fixture: producer span_dict SPAN!any
+# rlt-fixture: schema-keys BEAT required=type,rank,ts optional=done,load
+# rlt-fixture: schema-keys SPAN required=name,ts,dur optional=args
+"""RLT006 fixture: producer dict keys vs validator key sets."""
+import time
+
+
+def make_beat(rank, done):
+    beat = {
+        "type": "beat",                   # clean: anchored + known
+        "rank": rank,
+        "ts": time.time(),
+        "typo_rank": rank,                # expect[RLT006]
+    }
+    if done:
+        beat["done"] = True               # clean: optional key
+        beat["dnoe"] = True               # expect[RLT006]
+    helper = {"scratch": 1}   # clean: no "type" anchor, not checked
+    return beat, helper
+
+
+def span_dict(span):
+    d = {
+        "name": span,                     # clean: !any producer
+        "ts": 0.0,
+        "dur": 1.0,
+        "detph": 0,                       # expect[RLT006]
+    }
+    d["args"] = {}                        # clean: optional key
+    return d
+
+
+def unrelated(rank):
+    # Clean: not a registered producer — keys are free-form.
+    return {"type": "whatever", "made_up": rank}

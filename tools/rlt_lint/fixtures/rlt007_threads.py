@@ -1,0 +1,46 @@
+"""RLT007 fixture: thread hygiene."""
+import threading
+
+
+def beat_loop():
+    while True:
+        try:
+            publish()
+        except Exception:                 # expect[RLT007]
+            pass
+
+
+def pump_loop():
+    while True:
+        try:
+            pump()
+        except:                           # expect[RLT007]
+            return
+
+
+def drive_loop():
+    # Clean: typed, handled — not swallowed.
+    try:
+        pump()
+    except (OSError, ConnectionError):
+        return
+
+
+def publish():
+    # Clean: not a thread target — narrow swallows elsewhere are
+    # flake8/review territory, not RLT007's.
+    try:
+        pass
+    except Exception:
+        pass
+
+
+def pump():
+    pass
+
+
+def start():
+    t1 = threading.Thread(target=beat_loop)   # expect[RLT007]
+    t2 = threading.Thread(target=pump_loop, daemon=True)   # clean
+    t3 = threading.Thread(target=drive_loop, daemon=False)  # clean
+    return t1, t2, t3
