@@ -52,6 +52,21 @@ per-phase p50/p95, and the measured closed-loop headline overhead of
 cheap-tier tracing (ONE monolith engine toggling its tracer flag,
 median of adjacent alternating on/off pairs — the <2% bar).
 
+A seventh phase benches **multi-tenant LoRA multiplexing** (the
+``multi_lora`` block, ``validate_bench_multi_lora``):
+``RLT_MAX_ADAPTERS`` (default 8) tenants' adapters stacked in ONE
+resident engine's pool (``serve/lora.py``) and served as mixed batches
+— per-slot ``adapter_ids`` operands, so any tenant mix shares the
+compiled-once program set — A/B'd against the **merge-and-swap**
+baseline (fold tenant k's factors into the weights, upload, serve its
+requests alone, swap for the next tenant: the pre-pool shape where
+every tenant needs its own resident merged copy).  Two of the tenants
+hot-join THROUGH the pool mid-load; both arms pin their steady-state
+recompile counters at ZERO, every tenant's multiplexed stream is
+token-for-token its merged baseline's (``greedy_parity``), and
+``fairness_spread`` reports min/max lifetime tokens across tenants
+under the uniform offered load.
+
 A fifth phase benches **disaggregated serving** (the ``serve_disagg``
 block, ``validate_bench_serve_disagg``): a real actor fleet —
 ``RLT_DISAGG_REPLICAS`` (default 2) decode replicas +
@@ -86,8 +101,9 @@ from ray_lightning_tpu.serve.engine import ServeConfig, ServeEngine
 from ray_lightning_tpu.serve.metrics import ServeStats
 from ray_lightning_tpu.telemetry import compile_event_count
 from ray_lightning_tpu.telemetry.schema import (
-    validate_bench_serve, validate_bench_serve_disagg,
-    validate_bench_spec_decode, validate_bench_trace,
+    validate_bench_multi_lora, validate_bench_serve,
+    validate_bench_serve_disagg, validate_bench_spec_decode,
+    validate_bench_trace,
 )
 
 PROMPT_LEN = 16
@@ -479,6 +495,127 @@ def _disagg_block(module, params, serve_cfg, monolith_rps,
         fleet.close()
 
 
+LORA_REQUESTS_PER_TENANT = 2
+LORA_MAX_NEW = 16
+LORA_RANK = 8
+
+
+def _lora_tenants(cfg, params, n: int, seed: int = 7):
+    """``(adapters, merged)`` for ``n`` synthetic tenants of one base
+    (``models/gpt.py::synthetic_lora_adapter``), each tenant's merged
+    tree kept as the baseline arm's resident copy — computed OUTSIDE
+    any timed section (merging is offline prep in the swap workflow;
+    the swap itself — the weight upload — is what the timed arm
+    pays)."""
+    import dataclasses
+
+    from ray_lightning_tpu.models.gpt import synthetic_lora_adapter
+
+    lora_cfg = dataclasses.replace(cfg, lora_rank=LORA_RANK)
+    rng = jax.random.PRNGKey(seed)
+    adapters, merged = {}, {}
+    for i in range(n):
+        rng, ki = jax.random.split(rng)
+        adapter, merged_tree = synthetic_lora_adapter(
+            params, lora_cfg, ki, scale=0.05
+        )
+        adapters[f"tenant{i}"] = adapter
+        merged[f"tenant{i}"] = jax.tree.map(np.asarray, merged_tree)
+    return adapters, merged
+
+
+def _multi_lora_block(module, params, serve_cfg: ServeConfig) -> dict:
+    """Phase 7: N-tenant multiplexed pool vs merge-and-swap baseline."""
+    n = int(os.environ.get("RLT_MAX_ADAPTERS", "8") or 8)
+    cfg = module.config
+    prompts = _prompts(n * LORA_REQUESTS_PER_TENANT, cfg.vocab_size,
+                       seed=55)
+    adapters, merged = _lora_tenants(cfg, params, n)
+    names = sorted(adapters)
+    hot = names[-2:] if n > 2 else []       # join through the pool
+    preloaded = {k: adapters[k] for k in names if k not in hot}
+
+    # -- multiplexed arm: ONE resident base, mixed-tenant batches -------
+    mux_cfg = ServeConfig(
+        num_slots=serve_cfg.num_slots, block_size=serve_cfg.block_size,
+        max_adapters=n, adapter_rank=LORA_RANK,
+        # The closed loop submits every request before the first drain:
+        # the admission queue must hold the whole wave or the default
+        # bound (64) rejects the tail at the hw sweep's 64 tenants.
+        max_queue=max(64, n * LORA_REQUESTS_PER_TENANT),
+    )
+    eng = ServeEngine(module, params, mux_cfg, adapters=preloaded)
+    for p in prompts[:2]:
+        eng.generate(p, LORA_MAX_NEW)       # warm every program
+    eng.stats = ServeStats()
+    before = compile_event_count()
+    t0 = time.perf_counter()
+    handles: dict = {k: [] for k in names}
+    for r in range(LORA_REQUESTS_PER_TENANT):
+        for i, name in enumerate(names):
+            if name in hot and not eng.adapters.has(name):
+                eng.add_adapter(name, adapters[name])   # hot join
+            handles[name].append(eng.submit(
+                prompts[r * n + i], LORA_MAX_NEW, adapter=name,
+            ))
+    eng.run_until_idle()
+    mux_wall = time.perf_counter() - t0
+    mux_recompiles = int(compile_event_count() - before)
+    snap = eng.snapshot()
+    mux_tokens = snap["counters"]["tokens_out"]
+    spread = snap["gauges"]["lora_fairness_spread"]
+    impl = eng.adapters.impl
+    pool_loads = eng.adapters.loads
+    mux_streams = {k: [h.result(0) for h in hs]
+                   for k, hs in handles.items()}
+    eng.stop()
+
+    # -- merge-and-swap baseline: one tenant resident at a time --------
+    base_cfg = ServeConfig(num_slots=serve_cfg.num_slots,
+                           block_size=serve_cfg.block_size)
+    beng = ServeEngine(module, params, base_cfg)
+    for p in prompts[:2]:
+        beng.generate(p, LORA_MAX_NEW)      # warm the shared programs
+    beng.stats = ServeStats()
+    before = compile_event_count()
+    t0 = time.perf_counter()
+    base_streams: dict = {}
+    for i, name in enumerate(names):
+        # The swap: tenant k's merged copy becomes the resident model
+        # (same shapes/dtypes — weights are operands, so no recompile;
+        # the cost is the upload plus losing cross-tenant batching).
+        beng.params = jax.device_put(merged[name])
+        hs = [beng.submit(prompts[r * n + i], LORA_MAX_NEW)
+              for r in range(LORA_REQUESTS_PER_TENANT)]
+        beng.run_until_idle()
+        base_streams[name] = [h.result(0) for h in hs]
+    base_wall = time.perf_counter() - t0
+    base_recompiles = int(compile_event_count() - before)
+    base_tokens = beng.stats.counters["tokens_out"]
+    beng.stop()
+
+    parity = all(mux_streams[k] == base_streams[k] for k in names)
+    return {
+        "adapters": n,
+        "rank": LORA_RANK,
+        "requests": n * LORA_REQUESTS_PER_TENANT,
+        "max_new_tokens": LORA_MAX_NEW,
+        "tokens_per_sec": round(mux_tokens / mux_wall, 1),
+        "baseline_tokens_per_sec": round(base_tokens / base_wall, 1),
+        "vs_baseline": round(
+            (mux_tokens / mux_wall) / (base_tokens / base_wall), 3
+        ),
+        "fairness_spread": round(float(spread), 4),
+        "recompiles_steady_state": mux_recompiles,
+        "baseline_recompiles_steady_state": base_recompiles,
+        "greedy_parity": parity,
+        "hot_adds": len(hot),
+        "pool_loads": int(pool_loads),
+        "bgmv_impl": impl,
+        "completed": n * LORA_REQUESTS_PER_TENANT,
+    }
+
+
 TRACE_REQUESTS = 24
 TRACE_AB_REQUESTS = 24
 
@@ -691,9 +828,26 @@ def main() -> None:
     # Phase 6: distributed-tracing stitch coverage + overhead A/B.
     trace_block = _trace_block(module, params, serve_cfg, cfg)
 
+    # Phase 7: multi-tenant LoRA multiplexed vs merge-and-swap A/B.
+    multi_lora_block = _multi_lora_block(module, params, serve_cfg)
+
     problems = validate_bench_serve(serve_block)
     problems += validate_bench_spec_decode(spec_block)
     problems += validate_bench_trace(trace_block)
+    problems += validate_bench_multi_lora(multi_lora_block)
+    for arm in ("recompiles_steady_state",
+                "baseline_recompiles_steady_state"):
+        if multi_lora_block[arm] != 0:
+            problems.append(
+                f"multi_lora: {arm} = {multi_lora_block[arm]} — the "
+                "zero-recompile contract covers adapter joins and "
+                "hot-adds in BOTH arms"
+            )
+    if not multi_lora_block["greedy_parity"]:
+        problems.append(
+            "multi_lora: multiplexed tenant streams diverged from "
+            "their merged-model baselines"
+        )
     if trace_block["coverage"] < 0.95:
         problems.append(
             f"trace: stitch coverage {trace_block['coverage']} below "
@@ -729,6 +883,7 @@ def main() -> None:
         "serve": serve_block,
         "spec_decode": spec_block,
         "trace": trace_block,
+        "multi_lora": multi_lora_block,
     }
     if disagg_block is not None:
         out["serve_disagg"] = disagg_block

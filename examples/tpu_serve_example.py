@@ -16,6 +16,11 @@ Run (CPU):
     # serving"):
     JAX_PLATFORMS=cpu python examples/tpu_serve_example.py \
         --smoke-test --replicas 2 --prefill-workers 1
+    # multi-tenant LoRA: N adapters multiplexed over ONE resident base
+    # (docs/SERVING.md "Multi-tenant LoRA"; composes with --replicas /
+    # --prefill-workers — the router hot-loads members on demand):
+    JAX_PLATFORMS=cpu python examples/tpu_serve_example.py \
+        --smoke-test --adapters 3
 """
 
 from __future__ import annotations
@@ -48,6 +53,11 @@ def main() -> None:
                         help="dedicated prefill workers shipping KV "
                         "handoffs to the decode replicas (0 = replicas "
                         "prefill locally)")
+    parser.add_argument("--adapters", type=int, default=0, metavar="N",
+                        help="multi-tenant LoRA: serve N synthetic "
+                        "tenants' adapters over ONE resident base "
+                        "model (per-slot gathered application; any "
+                        "tenant mix shares the compiled programs)")
     parser.add_argument("--trace", action="store_true",
                         help="request-scoped distributed tracing: "
                         "every component exports span JSONL into the "
@@ -84,8 +94,29 @@ def main() -> None:
 
         draft, draft_params = early_exit_draft(module, trainer.params, 1)
         draft_kw = dict(draft_module=draft, draft_params=draft_params)
+    # Multi-tenant LoRA: N synthetic tenants of the trained base —
+    # random non-zero factors so each tenant visibly generates its own
+    # stream.  Real tenants come out of a lora_rank > 0 fine-tune via
+    # models.extract_lora (docs/SERVING.md "Multi-tenant LoRA").
+    adapters = {}
+    if args.adapters > 0:
+        import dataclasses
+
+        import jax
+
+        from ray_lightning_tpu.models.gpt import synthetic_lora_adapter
+
+        lora_cfg = dataclasses.replace(cfg, lora_rank=4)
+        rng = jax.random.PRNGKey(7)
+        for i in range(args.adapters):
+            rng, ki = jax.random.split(rng)
+            adapters[f"tenant{i}"], _ = synthetic_lora_adapter(
+                trainer.params, lora_cfg, ki
+            )
     serve_cfg = ServeConfig(num_slots=args.num_slots, block_size=16,
-                            spec_k=args.spec)
+                            spec_k=args.spec,
+                            max_adapters=args.adapters,
+                            adapter_rank=4 if args.adapters else 0)
     telemetry_dir = "rlt_logs/serve_example/telemetry"
     trace_dir = telemetry_dir if args.trace else None
     if trace_dir:
@@ -108,6 +139,7 @@ def main() -> None:
             module, trainer.params, serve_cfg,
             n_replicas=args.replicas, n_prefill=args.prefill_workers,
             telemetry_dir=telemetry_dir, trace_dir=trace_dir,
+            adapters=adapters or None,
             **draft_kw,
         )
         handle = fleet.queue_handle()
@@ -115,19 +147,24 @@ def main() -> None:
         engine = ServeEngine(
             module, trainer.params, serve_cfg,
             telemetry_dir=telemetry_dir, trace_dir=trace_dir,
+            adapters=adapters or None,
             **draft_kw,
         ).start()
         handle = engine.queue_handle()
     client = ServeClient(handle)
     try:
         rng = np.random.default_rng(0)
+        tenant_names = sorted(adapters) if adapters else [None]
         rids = [
             client.submit(
                 rng.integers(1, cfg.vocab_size,
                              size=(int(rng.integers(4, 17)),)).tolist(),
                 args.max_new_tokens,
+                # Round-robin the tenants (None = the shared base
+                # model): any mix rides the same decode dispatches.
+                adapter=tenant_names[i % len(tenant_names)],
             )
-            for _ in range(args.requests - 1)
+            for i in range(args.requests - 1)
         ]
         # Streaming: tokens arrive as the decode loop emits them.
         stream = client.stream([1, 2, 3, 4], args.max_new_tokens)
@@ -153,6 +190,12 @@ def main() -> None:
             per = {e["id"]: e.get("slots_active") for e
                    in rsnap["replicas"]}
             print(f"per-replica slots: {per}")
+            if args.adapters > 0:
+                loaded = {e["id"]: e.get("adapters", 0)
+                          for e in rsnap["replicas"]}
+                print(f"lora: loads sent="
+                      f"{rsnap['counters']['adapter_loads_sent']}, "
+                      f"adapters/replica={loaded}")
         else:
             snap = engine.snapshot()
             lat = snap["latency"]
@@ -164,6 +207,17 @@ def main() -> None:
                       f"{snap['gauges']['spec_acceptance_rate']:.2f} "
                       f"drafted={snap['counters']['spec_drafted']} "
                       f"emitted={snap['counters']['spec_emitted']}")
+            if args.adapters > 0:
+                # .get: the per-tenant block is lazily created on the
+                # first adapter-bearing emission (--requests 1 serves
+                # only the base stream).
+                per = {name: entry["tokens_out"]
+                       for name, entry in snap.get("adapters",
+                                                   {}).items()}
+                print(f"lora: {int(snap['gauges']['lora_adapters_loaded'])}"
+                      f" tenant(s) over one resident base, fairness="
+                      f"{snap['gauges']['lora_fairness_spread']:.2f}, "
+                      f"tokens/tenant={per}")
             assert snap["counters"]["completed"] == args.requests
         print("OK — watch live with: "
               "python tools/rlt_top.py rlt_logs/serve_example/telemetry")

@@ -6,7 +6,9 @@ from .gpt import (
     GPTConfig,
     SyntheticLMDataModule,
     add_lora_adapters,
+    extract_lora,
     merge_lora,
+    synthetic_lora_adapter,
 )
 from .mnist import MNISTClassifier, MNISTDataModule
 from .quant import is_quantized, quantize_decode_params
@@ -30,7 +32,9 @@ __all__ = [
     "GPTConfig",
     "SyntheticLMDataModule",
     "add_lora_adapters",
+    "extract_lora",
     "merge_lora",
+    "synthetic_lora_adapter",
     "ResNet",
     "CIFARDataModule",
     "ViT",

@@ -57,6 +57,9 @@ def _block_pass(
     v_l: jax.Array,
     off,
     c,
+    ad: Optional[Dict[str, jax.Array]] = None,
+    ad_ids: Optional[jax.Array] = None,
+    lora_impl: str = "xla",
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One GPT block over ``x (B, T, d)`` against a KV cache layer.
 
@@ -67,10 +70,20 @@ def _block_pass(
     single-token decode (``T = 1, off = pos``) — block math has one
     source, and numerics (f32 scores/softmax/PV) are identical by
     construction.
+
+    ``ad``/``ad_ids`` (multi-tenant LoRA, ``serve/lora.py``): one
+    layer's stacked adapter factors plus a per-SEQUENCE int32 slot id
+    operand — each row's own adapter delta is added to the qkv/proj
+    projections via the gathered BGMV (``ops/lora.py``), slot 0 being
+    the zero-delta base model.  ``None`` (every non-serving caller)
+    leaves the graph byte-identical to pre-LoRA rounds.
     """
+    from ray_lightning_tpu.ops.lora import apply_lora
+
     B, T = x.shape[0], x.shape[1]
     h = _layer_norm(x, p["ln1_g"], p["ln1_b"])
     qkv = h @ resolve_weight(p, "qkv_w", c) + p["qkv_b"].astype(c)
+    qkv = apply_lora(qkv, h, ad, "qkv", ad_ids, lora_impl)
     q, k, v = jnp.split(qkv, 3, axis=-1)
 
     def heads(z):
@@ -94,7 +107,9 @@ def _block_pass(
     att = jnp.einsum(
         "bhqs,bshd->bqhd", probs, v_l.astype(jnp.float32)
     ).reshape(B, T, cfg.d_model).astype(c)
-    x = x + att @ resolve_weight(p, "proj_w", c) + p["proj_b"].astype(c)
+    proj = att @ resolve_weight(p, "proj_w", c) + p["proj_b"].astype(c)
+    proj = apply_lora(proj, att, ad, "proj", ad_ids, lora_impl)
+    x = x + proj
     if cfg.n_experts > 0:
         # Same routed-MLP math as training (groups=1 — inference is
         # chip-local).  Capacity competition is per ROUTED SET: the full
@@ -106,24 +121,36 @@ def _block_pass(
     return _mlp_residual(x, p, c), k_l, v_l
 
 
-def _trunk_blocks(cfg, params, cache, x, off, c):
+def _trunk_blocks(cfg, params, cache, x, off, c,
+                  adapters=None, adapter_ids=None, lora_impl="xla"):
     """Scan :func:`_block_pass` over the stacked layers; return the
     pre-``ln_f`` hidden for EVERY position and the updated cache.
 
     The building block shared by :func:`_trunk_pass` (full forward →
     last-position logits) and the serving plane's bucketed prefill
     (``serve/kv_cache.py`` needs the hidden at the last *valid* prompt
-    position of a padded bucket, not the last slot)."""
+    position of a padded bucket, not the last slot).  ``adapters``
+    (stacked per-layer LoRA factor buffers, leading axis L) rides the
+    scan xs exactly like ``params["blocks"]``; ``None`` keeps the
+    graph byte-identical to pre-LoRA rounds (the trace-time unpack is
+    the same one-body shape the paged decode/verify programs use)."""
 
     def block(carry, layer):
         x, = carry
-        p, k_l, v_l = layer
-        x, k_l, v_l = _block_pass(cfg, p, x, k_l, v_l, off, c)
+        if adapters is None:
+            p, k_l, v_l = layer
+            ad = None
+        else:
+            p, k_l, v_l, ad = layer
+        x, k_l, v_l = _block_pass(cfg, p, x, k_l, v_l, off, c,
+                                  ad=ad, ad_ids=adapter_ids,
+                                  lora_impl=lora_impl)
         return (x,), (k_l, v_l)
 
-    (x,), (k_new, v_new) = jax.lax.scan(
-        block, (x,), (params["blocks"], cache["k"], cache["v"])
-    )
+    xs = (params["blocks"], cache["k"], cache["v"])
+    if adapters is not None:
+        xs = xs + (adapters,)
+    (x,), (k_new, v_new) = jax.lax.scan(block, (x,), xs)
     return x, {"k": k_new, "v": v_new}
 
 
@@ -164,18 +191,27 @@ def _embed(params, tokens, c):
 
 
 def _reject_unmerged_lora(params: Dict[str, Any]) -> None:
-    """The decode block math consumes raw ``qkv_w``/``proj_w`` only; a
-    LoRA-bearing tree would silently generate from the frozen base
-    weights.  Checked at every public inference entry (trace-time cost
-    only — it inspects dict keys, not values)."""
+    """The BASE-model decode math consumes raw ``qkv_w``/``proj_w``
+    only; a LoRA-bearing tree passed as the base would silently
+    generate from the frozen base weights — the one truly-unsupported
+    case, rejected here at every public inference entry (trace-time
+    cost only — it inspects dict keys, not values).  Serving adapters
+    is supported, just not THIS way: the adapter pool applies them as
+    per-slot operands over one resident base (docs/SERVING.md
+    "Multi-tenant LoRA")."""
     from ray_lightning_tpu.models.gpt import has_lora_adapters
 
     if has_lora_adapters(params):
         raise ValueError(
-            "params contain LoRA adapters, which the decode path does "
-            "not apply — running them would silently generate from the "
-            "frozen base weights. Fold them first: "
-            "params = merge_lora(params, cfg)."
+            "params contain LoRA adapters, which the base-model decode "
+            "path does not apply — running them would silently generate "
+            "from the frozen base weights. Either fold ONE tenant in "
+            "(params = merge_lora(params, cfg)) or serve MANY tenants "
+            "over the shared base through the adapter pool: "
+            "adapter, base = extract_lora(params, cfg); "
+            "ServeEngine(module, base, ServeConfig(max_adapters=N, "
+            "adapter_rank=cfg.lora_rank), adapters={name: adapter}) — "
+            "see docs/SERVING.md 'Multi-tenant LoRA'."
         )
 
 

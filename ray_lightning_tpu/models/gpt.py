@@ -37,8 +37,9 @@ from ray_lightning_tpu.core.module import TpuModule
 from ray_lightning_tpu.ops import causal_attention
 
 __all__ = ["GPTConfig", "GPT", "SyntheticLMDataModule", "make_block_stage",
-           "gpt_adamw", "merge_lora", "add_lora_adapters",
-           "has_lora_adapters", "residual_save_bytes"]
+           "gpt_adamw", "merge_lora", "extract_lora", "add_lora_adapters",
+           "synthetic_lora_adapter", "has_lora_adapters",
+           "residual_save_bytes"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -776,6 +777,66 @@ def add_lora_adapters(
         **params,
         "blocks": {**params["blocks"], **_init_lora_blocks(cfg, rng)},
     }
+
+
+def extract_lora(
+    params: Dict[str, Any], cfg: GPTConfig
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """``(adapter, base_params)``: pull the four stacked LoRA factors
+    out of a ``lora_rank > 0`` tree for multi-tenant serving.
+
+    The adapter dict (``qkv_a/qkv_b/proj_a/proj_b`` + ``scale``) feeds
+    :class:`~ray_lightning_tpu.serve.lora.AdapterPool`; ``base_params``
+    is the same tree stripped of the adapters — the lora-free resident
+    base every tenant shares (byte-identical across tenants fine-tuned
+    from the same checkpoint, which is what makes one resident copy
+    serve them all).  Inverse direction of :func:`merge_lora`: merge
+    folds ONE tenant in forever, extract keeps the base shared.
+    """
+    if cfg.lora_rank <= 0:
+        raise ValueError("extract_lora needs a lora_rank > 0 config")
+    if not has_lora_adapters(params):
+        raise ValueError(
+            "params carry no LoRA adapters — nothing to extract"
+        )
+    blocks = dict(params["blocks"])
+    adapter = {
+        "qkv_a": blocks.pop("lora_qkv_a"),
+        "qkv_b": blocks.pop("lora_qkv_b"),
+        "proj_a": blocks.pop("lora_proj_a"),
+        "proj_b": blocks.pop("lora_proj_b"),
+        "scale": cfg.lora_alpha / cfg.lora_rank,
+    }
+    return adapter, {**params, "blocks": blocks}
+
+
+def synthetic_lora_adapter(
+    params: Dict[str, Any], cfg: GPTConfig, rng: jax.Array,
+    scale: float = 0.3,
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """``(adapter, merged_params)``: ONE synthetic LoRA tenant of a
+    lora-free base — random non-zero A *and* B factors, so the tenant
+    generates a visibly distinct greedy stream (``add_lora_adapters``
+    alone zero-inits B: delta exactly 0, every "tenant" IS the base).
+
+    The multi-tenant serving bench/example/test triple all need N
+    distinct tenants plus each tenant's fully-merged tree as the
+    parity reference; real tenants come out of a ``lora_rank > 0``
+    fine-tune via :func:`extract_lora` instead.  ``cfg.lora_rank``
+    must be > 0 (it is the adapter's rank).
+    """
+    ka, kq, kp = jax.random.split(rng, 3)
+    tree = add_lora_adapters(params, cfg, ka)
+    blocks = dict(tree["blocks"])
+    blocks["lora_qkv_b"] = (
+        jax.random.normal(kq, blocks["lora_qkv_b"].shape) * scale
+    ).astype(blocks["lora_qkv_b"].dtype)
+    blocks["lora_proj_b"] = (
+        jax.random.normal(kp, blocks["lora_proj_b"].shape) * scale
+    ).astype(blocks["lora_proj_b"].dtype)
+    tree = {**tree, "blocks": blocks}
+    adapter, _ = extract_lora(tree, cfg)
+    return adapter, merge_lora(tree, cfg)
 
 
 def merge_lora(params: Dict[str, Any], cfg: GPTConfig) -> Dict[str, Any]:

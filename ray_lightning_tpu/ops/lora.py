@@ -1,0 +1,154 @@
+"""Batched-gather LoRA application (BGMV): ``y += (x @ A[ids]) @ B[ids]``.
+
+The device-side primitive of multi-tenant LoRA serving
+(``serve/lora.py``): every hook site holds the pool's adapters STACKED
+in one resident buffer — ``A (N, d, r)`` / ``B (N, r, k)`` per layer —
+and a dispatch applies each row's own adapter by gathering its factors
+with an int32 ``ids`` operand (the S-LoRA/Punica shape).  ``ids`` is a
+VALUE, never a shape, so a batch can mix any adapters and the serving
+plane's compiled-once program set never grows with the tenant count.
+
+Slot 0 is the pool's NULL adapter (zero factors): rows with no adapter
+gather zeros and pay one rank-``r`` matmul pair for a delta of exactly
+0.0 — no branch in the program, mixed base/adapter batches ride the
+same dispatch.
+
+Two implementations, selected ONCE at engine build (never per call):
+
+* ``xla`` — gathered einsum pair.  Works everywhere; on CPU (the test
+  container) it is the only sensible path.
+* ``pallas`` — a per-row kernel that scalar-prefetches ``ids`` and DMAs
+  ONLY the selected adapter's factors into VMEM (the gathered einsum
+  materializes an ``(W, d, r)`` copy first).  TPU-gated through the
+  shared :mod:`.kernel_probe` machinery with the xla path as fallback;
+  ``RLT_LORA_BGMV=xla|pallas`` forces an arm for A/B runs
+  (``tools/hw_session.sh``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_lightning_tpu.ops.kernel_probe import kernel_available
+
+__all__ = ["lora_delta", "apply_lora", "bgmv_xla", "bgmv_pallas",
+           "resolve_bgmv_impl"]
+
+
+def apply_lora(y: jax.Array, h: jax.Array, ad, site: str,
+               ids, impl: str) -> jax.Array:
+    """``y`` plus hook-site ``site``'s per-slot adapter delta — the ONE
+    application hook every program family (static trunk, paged decode,
+    paged verify) traces, so the contract (factor naming, id
+    semantics, a future per-site operand) has a single edit point.
+    ``ad is None`` (every non-serving caller) returns ``y`` unchanged:
+    the traced graph is byte-identical to pre-LoRA rounds."""
+    if ad is None:
+        return y
+    return y + lora_delta(h, ad[f"{site}_a"], ad[f"{site}_b"], ids,
+                          impl=impl)
+
+
+def bgmv_xla(h: jax.Array, a: jax.Array, b: jax.Array,
+             ids: jax.Array) -> jax.Array:
+    """Gathered two-matmul delta for ``h (W, d)``: ``(h @ a[ids]) @
+    b[ids]`` → ``(W, k)``.  ``b`` carries the adapter's LoRA scale
+    pre-folded (``AdapterPool.add``), so there is no per-row scale
+    operand."""
+    t = jnp.einsum("wd,wdr->wr", h, a[ids].astype(h.dtype))
+    return jnp.einsum("wr,wrk->wk", t, b[ids].astype(h.dtype))
+
+
+def bgmv_pallas(h: jax.Array, a: jax.Array, b: jax.Array,
+                ids: jax.Array) -> jax.Array:
+    """Per-row BGMV kernel: grid over the W rows; each step
+    scalar-prefetches ``ids[w]`` and block-indexes the stacked factor
+    buffers with it, so only the SELECTED adapter's ``(d, r)``/``(r,
+    k)`` factors cross HBM→VMEM — the whole point over the gather."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from ray_lightning_tpu.ops.kernel_probe import _interpret
+
+    W, d = h.shape
+    k = b.shape[-1]
+
+    def kernel(ids_ref, h_ref, a_ref, b_ref, out_ref):
+        del ids_ref  # consumed by the index maps
+        t = jnp.dot(h_ref[...], a_ref[0],
+                    preferred_element_type=jnp.float32)
+        out_ref[...] = jnp.dot(
+            t, b_ref[0].astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        ).astype(out_ref.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(W,),
+        in_specs=[
+            pl.BlockSpec((1, d), lambda w, ids: (w, 0)),
+            pl.BlockSpec((1, a.shape[1], a.shape[2]),
+                         lambda w, ids: (ids[w], 0, 0)),
+            pl.BlockSpec((1, b.shape[1], b.shape[2]),
+                         lambda w, ids: (ids[w], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, k), lambda w, ids: (w, 0)),
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((W, k), h.dtype),
+        interpret=_interpret(),
+    )(ids.astype(jnp.int32), h, a.astype(h.dtype), b.astype(h.dtype))
+
+
+def resolve_bgmv_impl(d: int, r: int, k: int, dtype) -> str:
+    """Pick the BGMV arm once (engine build time, never per dispatch).
+
+    ``RLT_LORA_BGMV`` forces an arm; otherwise the Pallas kernel is
+    probed at the call shapes through :func:`kernel_available` — on TPU
+    a failed probe (tiny ranks Mosaic will not tile) falls back to the
+    gathered einsum permanently, off-TPU the gather is simply the
+    faster path so the kernel is not selected at all.
+    """
+    forced = os.environ.get("RLT_LORA_BGMV", "").strip().lower()
+    if forced in ("xla", "pallas"):
+        return forced
+    if jax.default_backend() != "tpu":
+        return "xla"
+
+    def probe():
+        h = jnp.zeros((2, d), dtype)
+        a = jnp.zeros((2, d, r), dtype)
+        b = jnp.zeros((2, r, k), dtype)
+        jax.block_until_ready(
+            bgmv_pallas(h, a, b, jnp.zeros((2,), jnp.int32))
+        )
+
+    ok = kernel_available(("lora_bgmv", d, r, k, jnp.dtype(dtype).name),
+                          probe)
+    return "pallas" if ok else "xla"
+
+
+def lora_delta(h: jax.Array, a: jax.Array, b: jax.Array,
+               ids: jax.Array, impl: str = "xla") -> jax.Array:
+    """Adapter delta for ``h`` of shape ``(W, d)`` or ``(B, T, d)``.
+
+    ``ids`` matches the leading axis (one adapter per row/sequence).
+    The 3-D form (prefill buckets, verify windows) flattens to rows
+    with per-position repeated ids, so both arms serve every program
+    family from one entry point.
+    """
+    if h.ndim == 3:
+        B, T, d = h.shape
+        flat = lora_delta(
+            h.reshape(B * T, d), a, b, jnp.repeat(ids, T), impl=impl
+        )
+        return flat.reshape(B, T, -1)
+    if impl == "pallas":
+        return bgmv_pallas(h, a, b, ids)
+    return bgmv_xla(h, a, b, ids)

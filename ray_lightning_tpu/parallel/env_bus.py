@@ -73,6 +73,10 @@ KNOBS: Tuple[EnvKnob, ...] = (
     EnvKnob("RLT_SEGMENT_MIN_BYTES", False, "shm threshold (per-process)"),
     EnvKnob("RLT_DISABLE_KERNELS", False, "kernel-probe opt-out (local)"),
     EnvKnob("RLT_DISABLE_NATIVE", False, "native-ext opt-out (local)"),
+    EnvKnob("RLT_LORA_BGMV", False,
+            "force the multi-LoRA BGMV arm: xla|pallas (resolved once "
+            "at engine/worker build; serving actors inherit the local "
+            "env, so no strategy bridge)"),
     # -- monitor/prom knobs (telemetry/monitor.py from_env map) ----------
     EnvKnob("RLT_MONITOR_HANG_INTERVALS", False, "stall threshold"),
     EnvKnob("RLT_MONITOR_ABORT_S", False, "hang-abort deadline"),
@@ -86,6 +90,7 @@ KNOBS: Tuple[EnvKnob, ...] = (
     EnvKnob("RLT_SPEC_K", False, "bench speculative width"),
     EnvKnob("RLT_DISAGG_REPLICAS", False, "bench fleet width"),
     EnvKnob("RLT_DISAGG_PREFILL", False, "bench prefill workers"),
+    EnvKnob("RLT_MAX_ADAPTERS", False, "bench multi-LoRA tenant count"),
     EnvKnob("RLT_DRYRUN_MPMD", False, "graft-entry mpmd flavor gate"),
 )
 

@@ -22,6 +22,11 @@ compiled ONCE and re-dispatched forever:
   decoding**: a small draft proposes K tokens per tick, the target
   verifies them in ONE fixed-width dispatch (``spec_k``/``spec=``
   knobs; lossless for greedy, position-keyed sampling elsewhere);
+* :mod:`.lora` — **multi-tenant LoRA multiplexing**: one resident
+  lora-free base model, up to ``max_adapters`` tenants' A/B factors
+  stacked in resident device buffers, applied per-slot via a gathered
+  BGMV with an int32 ``adapter_ids`` operand — any tenant mix shares
+  the compiled-once program set (zero steady-state recompiles);
 * :mod:`.metrics` — the jax-free SLO stats engine the bench and the
   exporters share;
 * :mod:`.dist` — **disaggregated multi-replica serving**: prefill
@@ -39,6 +44,11 @@ from ray_lightning_tpu.serve.draft import (
     pad_identity_layers,
 )
 from ray_lightning_tpu.serve.engine import ServeConfig, ServeEngine
+from ray_lightning_tpu.serve.lora import (
+    AdapterPool,
+    decode_adapter,
+    encode_adapter,
+)
 from ray_lightning_tpu.serve.kv_cache import (
     BlockAllocator,
     PagedKVCache,
@@ -68,6 +78,9 @@ __all__ = [
     "sample_tokens",
     "early_exit_draft",
     "pad_identity_layers",
+    "AdapterPool",
+    "encode_adapter",
+    "decode_adapter",
     "Request",
     "RequestState",
     "Scheduler",

@@ -80,12 +80,15 @@ class ServeClient:
                eos_token_id: Optional[int] = None,
                top_k: Optional[int] = None,
                spec: Optional[int] = None,
+               adapter: Optional[str] = None,
                deadline_s: Optional[float] = None) -> str:
         """Ship one request; returns its id immediately (streaming and
         completion arrive asynchronously).  ``spec`` caps the engine's
         speculative draft count for this request (0 = plain decode);
         tokens stream back in variable-width bursts either way, deduped
-        by index like any re-emission."""
+        by index like any re-emission.  ``adapter`` names the LoRA
+        tenant to decode through (multi-tenant serving; a router
+        places the request on — or hot-loads — a member holding it)."""
         rid = uuid.uuid4().hex[:12]
         with self._lock:
             self._pending[rid] = _Pending(rid)
@@ -98,6 +101,7 @@ class ServeClient:
             "eos_token_id": eos_token_id,
             "top_k": None if top_k is None else int(top_k),
             "spec": None if spec is None else int(spec),
+            "adapter": None if adapter is None else str(adapter),
             "deadline_s": deadline_s,
             "reply": list(self._reply_addr),
         })

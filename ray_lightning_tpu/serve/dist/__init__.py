@@ -10,8 +10,9 @@ in-flight requests recompute on survivors, streams bitwise-continuous
 via the router-stamped sampling seeds + token-index dedup) and a
 sliding-window restart governor for prefill workers.
 
-* :mod:`.handoff` — the wire frames (dispatch / KV handoff / hello /
-  beat; envelopes schema-pinned in ``telemetry/schema.py``);
+* :mod:`.handoff` — the wire frames (dispatch / KV handoff / adapter
+  hot-load / hello / beat; envelopes schema-pinned in
+  ``telemetry/schema.py``);
 * :mod:`.prefill` — the prefill worker loop (prefill → export →
   handoff);
 * :mod:`.replica` — decode-replica runners, in-process and
@@ -26,6 +27,7 @@ disagg-vs-monolith A/B and the kill-a-replica chaos arm.
 
 from ray_lightning_tpu.serve.dist.handoff import (
     KV_SEGMENT_PREFIX,
+    make_adapter_load_item,
     make_beat_item,
     make_dispatch_item,
     make_handoff_item,
@@ -61,6 +63,7 @@ __all__ = [
     "request_fields",
     "make_dispatch_item",
     "make_handoff_item",
+    "make_adapter_load_item",
     "make_hello_item",
     "make_beat_item",
 ]

@@ -42,6 +42,7 @@ __all__ = [
     "request_fields",
     "make_dispatch_item",
     "make_handoff_item",
+    "make_adapter_load_item",
     "make_hello_item",
     "make_beat_item",
     "encode_kv_payload",
@@ -213,6 +214,7 @@ def request_fields(
     eos_token_id: Optional[int] = None,
     top_k: Optional[int] = None,
     spec: Optional[int] = None,
+    adapter: Optional[str] = None,
     deadline_s: Optional[float] = None,
     trace=None,
 ) -> Dict[str, Any]:
@@ -229,6 +231,7 @@ def request_fields(
         "eos_token_id": eos_token_id,
         "top_k": None if top_k is None else int(top_k),
         "spec": None if spec is None else int(spec),
+        "adapter": None if adapter is None else str(adapter),
         "deadline_s": deadline_s,
         "sample_seed": int(sample_seed),
         "reply": list(reply),
@@ -290,6 +293,33 @@ def make_handoff_item(
     return item
 
 
+def make_adapter_load_item(
+    name: str,
+    rank: int,
+    *,
+    data: Optional[bytes] = None,
+    shm: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Router/operator → member (decode replica OR prefill worker):
+    hot-load one tenant's LoRA adapter into the member's pool.
+    Exactly one of ``data``/``shm`` carries the
+    ``serve/lora.py::encode_adapter`` blob — the same dual transport
+    as KV handoffs (inline bytes chunk-sent past 8MB cross-host, a
+    tmpfs segment path same-host)."""
+    if (data is None) == (shm is None):
+        raise ValueError("exactly one of data/shm payload required")
+    item: Dict[str, Any] = {
+        "type": "serve_adapter_load",
+        "name": str(name),
+        "rank": int(rank),
+    }
+    if data is not None:
+        item["data"] = data
+    else:
+        item["shm"] = shm
+    return item
+
+
 def make_hello_item(role: str, member_id: str, inbox: Tuple[str, int],
                     **caps: Any) -> Dict[str, Any]:
     """Member registration: the router learns the inbox address and the
@@ -312,13 +342,16 @@ def make_beat_item(
     failed: Sequence[Tuple[str, str]] = (),
     snapshot: Optional[Dict[str, Any]] = None,
     recompiles: Optional[int] = None,
+    adapters: Optional[Sequence[str]] = None,
     closing: bool = False,
 ) -> Dict[str, Any]:
     """Periodic member liveness + completion feed.  ``done`` carries
     terminal ``(rid, status)`` pairs since the last beat (the router's
     in-flight pruning signal); ``failed`` carries ``(rid, error)``
     pairs a prefill worker could not hand off (the router re-routes
-    them)."""
+    them); ``adapters`` advertises the member's loaded LoRA tenants
+    (adapter-aware placement routes a tenant's requests to members
+    already holding its factors)."""
     item: Dict[str, Any] = {
         "type": "serve_replica_beat",
         "role": role,
@@ -331,6 +364,8 @@ def make_beat_item(
         item["snapshot"] = snapshot
     if recompiles is not None:
         item["recompiles"] = int(recompiles)
+    if adapters is not None:
+        item["adapters"] = [str(a) for a in adapters]
     if closing:
         item["closing"] = True
     return item

@@ -87,10 +87,28 @@ class PrefillRunner:
         )
         self._pool = self.cache.init_pool()
         cfg, c = self.cfg, self._c
+        # Multi-tenant LoRA: the worker mirrors the decode replicas'
+        # adapter pool (serve/lora.py) — a tenant's prompt must be
+        # prefilled THROUGH its adapter or the handed-off KV would be
+        # the base model's.  The router hot-loads adapters here over
+        # the same serve_adapter_load frames replicas get.
+        self.adapters = None
+        if getattr(serve_cfg, "max_adapters", 0) > 0:
+            from ray_lightning_tpu.serve.lora import AdapterPool
 
-        def _prefill(params, pool, tokens, prompt_len, block_ids):
+            self.adapters = AdapterPool(
+                self.cfg, serve_cfg.max_adapters,
+                serve_cfg.adapter_rank, dtype=self._c,
+            )
+        lora_impl = self.adapters.impl if self.adapters is not None \
+            else "xla"
+
+        def _prefill(params, pool, tokens, prompt_len, block_ids,
+                     ad, ad_id):
             return paged_prefill(cfg, params, pool, tokens, prompt_len,
-                                 block_ids, compute_dtype=c)
+                                 block_ids, compute_dtype=c,
+                                 adapters=ad, adapter_id=ad_id,
+                                 lora_impl=lora_impl)
 
         # One executable per bucket length, like the engine's set.
         self._prefill_fn = jax.jit(_prefill)
@@ -138,6 +156,7 @@ class PrefillRunner:
             max_prompt_len=self.buckets[-1],
             max_model_len=self.max_model_len,
             block_size=self.serve_cfg.block_size,
+            max_adapters=getattr(self.serve_cfg, "max_adapters", 0),
         ))
 
     # -- the loop ------------------------------------------------------------
@@ -207,6 +226,20 @@ class PrefillRunner:
         import jax.numpy as jnp
         import numpy as np
 
+        if isinstance(item, dict) \
+                and item.get("type") == "serve_adapter_load":
+            # Tenant hot-load: the router ensures the load frame lands
+            # BEFORE any of the tenant's dispatches (one ordered inbox
+            # lane per member), so resolution below never races it.
+            from ray_lightning_tpu.serve.lora import decode_adapter
+
+            if self.adapters is None:
+                raise ValueError(
+                    "serve_adapter_load on a prefill worker without an "
+                    "adapter pool (serve_cfg.max_adapters == 0)"
+                )
+            self.adapters.add(str(item["name"]), decode_adapter(item))
+            return
         if not (isinstance(item, dict)
                 and item.get("type") == "serve_prefill_dispatch"):
             raise ValueError(
@@ -214,6 +247,20 @@ class PrefillRunner:
             )
         req = item["req"]
         rid = str(req["rid"])
+        adapter = req.get("adapter")
+        ad, ad_id = None, None
+        if self.adapters is not None:
+            ad = self.adapters.buffers
+            # Unknown tenant raises → the failed feed → router
+            # re-routes (and re-ensures the load) — never a silent
+            # base-model prefill for a tenant's prompt.
+            ad_id = np.int32(0 if adapter is None
+                             else self.adapters.slot_of(adapter))
+        elif adapter is not None:
+            raise ValueError(
+                f"dispatch names adapter {adapter!r} but this worker "
+                f"has no adapter pool"
+            )
         prompt = [int(t) for t in req["prompt"]]
         bucket = next(b for b in self.buckets if b >= len(prompt))
         n_blocks = bucket // self.serve_cfg.block_size
@@ -234,6 +281,7 @@ class PrefillRunner:
                     self.params, self._pool, jnp.asarray(padded),
                     np.int32(len(prompt)), jnp.asarray(np.asarray(ids,
                                                                   np.int32)),
+                    ad, ad_id,
                 )
                 # export_blocks device_gets the blocks, so the span
                 # closes on a SYNCED device — real prefill compute.
@@ -321,6 +369,8 @@ class PrefillRunner:
         try:
             self._beat_handle.put(make_beat_item(
                 "prefill", self.worker_id, done=done, failed=failed,
+                adapters=(None if self.adapters is None
+                          else self.adapters.names()),
                 closing=closing,
             ))
         except (OSError, ConnectionError):

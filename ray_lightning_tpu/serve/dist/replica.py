@@ -77,16 +77,20 @@ class DecodeReplicaRunner:
             max_prompt_len=engine.max_prompt_len,
             max_model_len=engine.max_model_len,
             block_size=engine.config.block_size,
+            max_adapters=engine.config.max_adapters,
         ))
 
     def publish_beat(self, closing: bool = False) -> None:
         from ray_lightning_tpu.telemetry import compile_event_count
 
+        engine = self.engine
         self._beat_handle.put(make_beat_item(
             "decode", self.replica_id,
-            done=self.engine.drain_done(),
-            snapshot=self.engine.snapshot(),
+            done=engine.drain_done(),
+            snapshot=engine.snapshot(),
             recompiles=compile_event_count(),
+            adapters=(engine.adapter_names()
+                      if engine.adapters is not None else None),
             closing=closing,
         ))
 
@@ -325,6 +329,12 @@ class ServeFleet:
     def queue_handle(self):
         return self.router.queue_handle()
 
+    def register_adapter(self, name: str, adapter: Dict[str, Any]) -> None:
+        """Register one LoRA tenant fleet-wide (see
+        :meth:`~.router.Router.register_adapter`): members are
+        hot-loaded lazily at placement time."""
+        self.router.register_adapter(name, adapter)
+
     def close(self) -> None:
         # Router first: a planned teardown must not read as member
         # deaths (spurious failovers/respawns on the way down).
@@ -359,17 +369,22 @@ def launch_inproc_fleet(module, params, serve_cfg, *, n_replicas: int = 2,
                         draft_params=None, beat_s: float = 0.1,
                         lost_after_s: float = 1.0,
                         trace_dir: Optional[str] = None,
+                        adapters: Optional[Dict[str, Any]] = None,
                         **router_kwargs) -> ServeFleet:
     """N engines + M prefill workers on driver threads behind a started
     router — the cheap fleet for tests/examples (real TCP beat/handoff
     wire, no subprocesses).  ``trace_dir`` turns on request-scoped
     distributed tracing fleet-wide (router + every member exports
     per-component span JSONL there; stitch with
-    ``tools/trace_stitch.py``)."""
+    ``tools/trace_stitch.py``).  ``adapters`` pre-registers LoRA
+    tenants with the router (``serve_cfg.max_adapters`` sizes every
+    member's pool; members are hot-loaded lazily at placement)."""
     from ray_lightning_tpu.serve.engine import ServeConfig, ServeEngine
 
     router = Router(lost_after_s=lost_after_s, trace_dir=trace_dir,
                     **router_kwargs)
+    for name, adapter in (adapters or {}).items():
+        router.register_adapter(name, adapter)
 
     def make_engine(name):
         return ServeEngine(
@@ -410,15 +425,19 @@ def launch_actor_fleet(module, params, serve_cfg, *, n_replicas: int = 2,
                        governor: Optional[RestartGovernor] = None,
                        startup_timeout_s: float = 180.0,
                        trace_dir: Optional[str] = None,
+                       adapters: Optional[Dict[str, Any]] = None,
                        **router_kwargs) -> ServeFleet:
     """The real fleet: one ProcessActor per member, each owning its own
     devices (1 CPU device per actor on this container; a TPU host's
     chips in production), beats and handoffs over the queue plane.
     ``trace_dir`` (a SHARED path — same-host fleets, or a shared mount)
     turns on fleet-wide request tracing; members export their span
-    JSONL on graceful teardown."""
+    JSONL on graceful teardown.  ``adapters`` pre-registers LoRA
+    tenants with the router for lazy hot-load."""
     router = Router(lost_after_s=lost_after_s, governor=governor,
                     trace_dir=trace_dir, **router_kwargs)
+    for name, adapter in (adapters or {}).items():
+        router.register_adapter(name, adapter)
     beat_addr = (router.beat_handle.host, router.beat_handle.port)
     params = _host_params(params)
     draft_params = (_host_params(draft_params)

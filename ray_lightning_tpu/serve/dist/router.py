@@ -19,8 +19,13 @@ Responsibilities, all jax-free host logic:
 * **placement** — least-loaded by in-flight count (free-block and
   slot-occupancy gauges from the latest ``ServeStats`` beat snapshot
   break ties), with stickiness: a request re-routed after a prefill
-  failure prefers the replica it was already bound to, and ``spec>0``
-  requests are placed only on draft-capable replicas;
+  failure prefers the replica it was already bound to, ``spec>0``
+  requests are placed only on draft-capable replicas, and
+  ``adapter=`` requests only on pool-capable members — preferring
+  ones already HOLDING the tenant's factors (the beat advertises
+  them), hot-loading via :meth:`Router.register_adapter` blobs
+  otherwise (a ``serve_adapter_load`` frame down the member's ordered
+  inbox lane, so the load always lands before the dispatch);
 * **prefill dispatch** — with prefill workers registered, a routed
   request first goes to the least-busy worker
   (``serve_prefill_dispatch``), which runs the prompt and ships the KV
@@ -124,6 +129,10 @@ class _Member:
         self.last_beat: Optional[float] = None
         self.snapshot: Dict[str, Any] = {}
         self.recompiles: Optional[int] = None
+        # LoRA tenants this member holds: beat-advertised truth,
+        # optimistically extended when the router sends a load frame
+        # (the next beat confirms or corrects it).
+        self.adapters: Set[str] = set()
         self.alive = True
 
     def beat_age_s(self, now: float) -> float:
@@ -190,7 +199,12 @@ class Router:
             "replica_deaths": 0, "worker_deaths": 0,
             "replica_drains": 0, "worker_drains": 0,
             "prefill_respawns": 0, "prefill_respawns_denied": 0,
+            "adapter_loads_sent": 0,
         }
+        # Multi-tenant LoRA registry: name -> {"rank", "data"} (the
+        # encode_adapter blob, encoded ONCE at registration) — the
+        # source the router hot-loads members from on demand.
+        self._adapters: Dict[str, Dict[str, Any]] = {}  # guarded by self._lock
         # Staleness of the last dead replica's final beat at detection —
         # the failover-latency component the router can observe.
         self.last_failover_detect_s: Optional[float] = None
@@ -257,6 +271,29 @@ class Router:
     def add_prefill(self, handle) -> None:
         with self._lock:
             self._workers[handle.id] = _Member(handle, "prefill")
+
+    def register_adapter(self, name: str, adapter: Dict[str, Any]) -> None:
+        """Register one tenant's LoRA adapter with the fleet: the
+        factors are encoded ONCE (``serve/lora.py::encode_adapter``)
+        and kept host-side; members are hot-loaded lazily, at the
+        moment a request for the tenant is placed on one that does not
+        yet hold it.  Registration is cheap and does not touch any
+        member — a registered-but-idle tenant costs the fleet nothing
+        until its first request.
+
+        Re-registering an existing name updates the ROUTER's blob only:
+        members already advertising the tenant keep their loaded
+        factors (the engines refuse live replacement anyway — see
+        ``ServeEngine.add_adapter``).  To roll a tenant's factors,
+        drain the tenant, remove it on the members, then register the
+        new version."""
+        from ray_lightning_tpu.serve.lora import encode_adapter
+
+        name = str(name)
+        rank = int(adapter["qkv_a"].shape[-1])
+        data = encode_adapter(adapter)
+        with self._lock:
+            self._adapters[name] = {"rank": rank, "data": data}
 
     def wait_ready(self, timeout: float = 120.0) -> None:
         """Block until every registered member has hello'd its inbox."""
@@ -371,6 +408,11 @@ class Router:
             m.snapshot = item["snapshot"]
         if "recompiles" in item:
             m.recompiles = int(item["recompiles"])
+        if "adapters" in item:
+            # Beat-advertised truth replaces the optimistic set — a
+            # member that dropped a load frame (restart, full pool)
+            # stops being preferred for that tenant within one beat.
+            m.adapters = {str(a) for a in item["adapters"]}
         for rid, status in item.get("done", []):
             if m.role == "decode":
                 self._complete(str(rid), str(status))
@@ -508,6 +550,7 @@ class Router:
                 eos_token_id=item.get("eos_token_id"),
                 top_k=item.get("top_k"),
                 spec=item.get("spec"),
+                adapter=item.get("adapter"),
                 deadline_s=item.get("deadline_s"),
                 trace=ctx,
             )
@@ -548,6 +591,15 @@ class Router:
             if len(req["prompt"]) + req["max_new_tokens"] > max_len:
                 return (f"prompt + max_new_tokens exceeds the fleet's "
                         f"max_model_len ({max_len})")
+        adapter = req.get("adapter")
+        if adapter is not None and adapter not in self._adapters \
+                and not any(adapter in m.adapters
+                            for m in self._replicas.values() if m.alive):
+            # Typed, synchronous: an unknown tenant must never fall
+            # back silently to the base model on some replica.
+            return (f"unknown adapter {adapter!r} — register it with "
+                    f"Router.register_adapter (or hot-load a replica) "
+                    f"first")
         return None
 
     # -- placement -----------------------------------------------------------
@@ -573,6 +625,23 @@ class Router:
         capacity frees up — a request the fleet already accepted is
         never lost to a transient squeeze."""
         req = track.req
+        if track.resubmits > 16:
+            # Re-route budget: a legitimate failover chain burns one
+            # resubmit per member death — far below this bound.  What
+            # does hit it is a PERSISTENT per-request failure loop
+            # (e.g. a member whose adapter pool is full raises on every
+            # hot-load, the dispatch fails, the failed feed re-routes,
+            # the next beat erases the optimistic adapters entry,
+            # repeat) — without the bound that loop re-ships the blob
+            # forever while the client blocks to its timeout.
+            self._finish_unroutable(
+                rid, track, "error",
+                f"re-route budget exhausted after {track.resubmits} "
+                f"attempts (persistent placement failure — check "
+                f"member capacity, e.g. ServeConfig.max_adapters vs "
+                f"registered tenants)",
+            )
+            return
         live = [m for m in self._replicas.values()
                 if m.alive and m.inbox is not None and m.id not in exclude]
         spec = req.get("spec")
@@ -601,6 +670,39 @@ class Router:
                         rid, track, "invalid",
                         "spec > 0 but no draft-capable replica in "
                         "the fleet",
+                    )
+                return
+            live = capable
+        adapter = req.get("adapter")
+        if adapter is not None:
+            # Pool-capable replicas only; a pool-less engine would fail
+            # the request as "invalid" (its submit raises on adapter=).
+            # When the router holds the registered blob any capable
+            # replica is loadable on demand; otherwise only members
+            # already advertising the tenant can serve it.
+            capable = [m for m in live if m.caps.get("max_adapters", 0) > 0]
+            if adapter not in self._adapters:
+                capable = [m for m in capable if adapter in m.adapters]
+            if not capable:
+                fleet_capable = any(
+                    m.caps.get("max_adapters", 0) > 0
+                    for m in self._replicas.values() if m.alive
+                )
+                if fleet_capable and adapter in self._adapters:
+                    if must_place:
+                        self._park(rid)
+                    else:
+                        self._finish_unroutable(
+                            rid, track, "rejected",
+                            "adapter-capable replica temporarily "
+                            "unavailable",
+                        )
+                else:
+                    self._finish_unroutable(
+                        rid, track, "invalid",
+                        f"adapter {adapter!r}: no adapter-capable "
+                        f"replica holds it and no registered blob to "
+                        f"hot-load from",
                     )
                 return
             live = capable
@@ -638,16 +740,43 @@ class Router:
         if target is None:
             target = min(
                 candidates,
-                key=lambda m: (self._assigned(m.id),
+                key=lambda m: (adapter is not None
+                               and adapter not in m.adapters,
+                               self._assigned(m.id),
                                -self._blocks_free(m), m.id),
             )
         track.replica = target.id
         workers = [w for w in self._workers.values()
                    if w.alive and w.inbox is not None]
+        if adapter is not None:
+            # A tenant's prompt must be prefilled THROUGH its adapter —
+            # a pool-less worker would hand off base-model KV, and a
+            # pool-capable worker the router cannot hot-load (tenant
+            # loaded member-side only, never registered here) must
+            # already HOLD the factors.  No usable worker = direct
+            # submission (the replica prefills through its own pool).
+            workers = [w for w in workers
+                       if w.caps.get("max_adapters", 0) > 0
+                       and (adapter in self._adapters
+                            or adapter in w.adapters)]
+        if adapter is not None:
+            # The decode replica needs the factors resident whichever
+            # path the prompt takes (handoff admission decodes through
+            # them).  A dead replica outbox here is a REPLICA incident:
+            # its death path re-routes this rid (track.replica is set).
+            try:
+                self._ensure_adapter(target, adapter)
+            except (OSError, ConnectionError):
+                self._on_replica_death(target, now)
+                return
         if workers:
             worker = min(workers,
-                         key=lambda w: (self._pending(w.id), w.id))
+                         key=lambda w: (adapter is not None
+                                        and adapter not in w.adapters,
+                                        self._pending(w.id), w.id))
             try:
+                if adapter is not None:
+                    self._ensure_adapter(worker, adapter)
                 # tmpfs zero-copy only when the worker and the replica
                 # advertise the same host; otherwise the payload rides
                 # inline bytes over the (chunk-sending) queue.
@@ -669,6 +798,38 @@ class Router:
             self.counters["direct_submits"] += 1
         except (OSError, ConnectionError):
             self._on_replica_death(target, now)
+
+    def _ensure_adapter(self, m: _Member,
+                        name: str) -> None:  # rlt: holds self._lock
+        """Hot-load ``name`` onto ``m`` unless it already holds it: a
+        ``serve_adapter_load`` frame down the member's ordered inbox
+        lane, so the factors always land BEFORE the dispatch that
+        references them.  The optimistic set-add keeps one tenant's
+        burst from re-shipping the blob every placement; the member's
+        next beat is the correcting truth."""
+        if name in m.adapters:
+            return
+        from ray_lightning_tpu.serve.dist.handoff import (
+            make_adapter_load_item,
+        )
+
+        entry = self._adapters.get(name)
+        if entry is None:
+            # Beat-advertised-only tenant (loaded member-side, never
+            # registered with the router) placed on a non-holder —
+            # placement filters should prevent this; if one slips
+            # through, the member's own typed "unknown adapter" reply
+            # is the failure surface, not a router crash.
+            log.warning(
+                "no registered blob to hot-load adapter %r onto %s %s",
+                name, m.role, m.id,
+            )
+            return
+        self._put(m.inbox, make_adapter_load_item(
+            name, entry["rank"], data=entry["data"],
+        ))
+        m.adapters.add(name)
+        self.counters["adapter_loads_sent"] += 1
 
     def _placement_cb(self, track: _Track, rid: str,
                       worker_id: Optional[str], replica_id: str):
@@ -968,9 +1129,12 @@ class Router:
                         entry[key] = float(gauges[key])
                 if m.recompiles is not None:
                     entry["recompiles"] = m.recompiles
+                if m.caps.get("max_adapters", 0) > 0:
+                    entry["adapters"] = len(m.adapters)
                 replicas.append(entry)
-            workers = [
-                {
+            workers = []
+            for w in self._workers.values():
+                wentry: Dict[str, Any] = {
                     "id": w.id,
                     "alive": bool(w.alive),
                     "pending": self._pending(w.id),
@@ -979,8 +1143,9 @@ class Router:
                         if w.last_beat is not None else None
                     ),
                 }
-                for w in self._workers.values()
-            ]
+                if w.caps.get("max_adapters", 0) > 0:
+                    wentry["adapters"] = len(w.adapters)
+                workers.append(wentry)
             return {
                 "ts": time.time(),
                 "counters": dict(self.counters),

@@ -80,6 +80,18 @@ class ServeConfig:
     # Admission-queue bound: submissions beyond it are REJECTED
     # synchronously (backpressure, never silent queue bloat).
     max_queue: int = 64
+    # Multi-tenant LoRA multiplexing (serve/lora.py): capacity of the
+    # resident adapter pool (0 = no pool — the engine's program set is
+    # byte-identical to pre-LoRA rounds) and the stacked-buffer rank
+    # every loaded adapter must match.  Adapters ride every dispatch
+    # as a per-slot int32 OPERAND, so any tenant mix shares the
+    # compiled-once program set (zero steady-state recompiles).
+    max_adapters: int = 0
+    adapter_rank: int = 0
+    # Per-tenant admission bound: one adapter's burst beyond it is
+    # REJECTED while other tenants keep their queue seats (None = the
+    # shared max_queue only).
+    max_queue_per_adapter: Optional[int] = None
     # Speculative decoding: default drafted tokens per tick when a
     # draft model is loaded (the verify program's width is spec_k + 1).
     # Requires draft_module/draft_params at engine build; per-request
@@ -142,7 +154,8 @@ class ServeEngine:
                  prom_port: Optional[int] = None,
                  draft_module=None, draft_params=None,
                  trace_dir: Optional[str] = None,
-                 trace_name: Optional[str] = None):
+                 trace_name: Optional[str] = None,
+                 adapters: Optional[Dict[str, dict]] = None):
         import jax
         import jax.numpy as jnp
 
@@ -187,6 +200,32 @@ class ServeEngine:
             raise ValueError(
                 "a draft model without spec_k >= 1 would never be "
                 "consulted — set ServeConfig(spec_k=K)"
+            )
+        # Multi-tenant LoRA: the resident adapter pool (None = no
+        # multiplexing; every program stays byte-identical to
+        # pre-LoRA rounds).  Base params stay lora-FREE either way —
+        # _reject_unmerged_lora above guards the truly-unsupported
+        # case (adapters smuggled in as the base tree).
+        self.adapters = None
+        if cfg.max_adapters > 0:
+            from ray_lightning_tpu.serve.lora import AdapterPool
+
+            if cfg.adapter_rank < 1:
+                raise ValueError(
+                    "max_adapters > 0 needs adapter_rank >= 1 (the "
+                    "stacked-buffer rank every adapter shares)"
+                )
+            self.adapters = AdapterPool(
+                self.cfg, cfg.max_adapters, cfg.adapter_rank,
+                dtype=self._c,
+            )
+            for name, adapter in (adapters or {}).items():
+                self.adapters.add(name, adapter)
+        elif adapters:
+            raise ValueError(
+                "adapters= passed but ServeConfig.max_adapters is 0 — "
+                "size the pool (max_adapters/adapter_rank) to serve "
+                "multi-tenant LoRA"
             )
         self.draft_module = draft_module
         self.draft_params = None
@@ -233,6 +272,7 @@ class ServeEngine:
         self.scheduler = Scheduler(
             cfg.num_slots, self.cache.allocator, cfg.block_size,
             blocks_per_seq, buckets, max_queue=cfg.max_queue,
+            max_queue_per_adapter=cfg.max_queue_per_adapter,
         )
         self.stats = ServeStats()
         self._pool = self.cache.init_pool()
@@ -280,6 +320,12 @@ class ServeEngine:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._inbox = None           # DriverQueue, lazily created
+        # Handoffs whose tenant's serve_adapter_load frame has not
+        # landed yet (the worker's handoff rides its OWN connection and
+        # can outrun the router's load frame): re-tried each drain for
+        # a bounded number of cycles before the typed-invalid fallback.
+        # Serve-loop-thread only — never shared, no lock.
+        self._deferred_inbox: deque = deque()
         # Serve-thread send cache; stop() closes it from the
         # caller's thread after a join(timeout) that a wedged
         # dispatch can outlive — so it shares the lock.
@@ -315,21 +361,29 @@ class ServeEngine:
         # Donation keeps the pool update in place on TPU; XLA:CPU cannot
         # donate and would warn on every dispatch.
         donate = (1,) if jax.default_backend() == "tpu" else ()
+        # Multi-tenant LoRA: the BGMV arm is resolved ONCE here (probe
+        # or RLT_LORA_BGMV), then closed over — never re-decided on the
+        # dispatch path.  Pool-less engines trace with adapters=None,
+        # keeping their graphs byte-identical to pre-LoRA rounds.
+        lora_impl = self.adapters.impl if self.adapters is not None \
+            else "xla"
 
         def _decode(params, pool, block_tables, seq_lens, tokens, temps,
-                    seeds, top_ks):
+                    seeds, top_ks, ad, ad_ids):
             logits, pool = paged_decode_step(
                 cfg, params, pool, block_tables, seq_lens, tokens,
-                compute_dtype=c,
+                compute_dtype=c, adapters=ad, adapter_ids=ad_ids,
+                lora_impl=lora_impl,
             )
             keys = make_slot_keys(base_key, seeds, seq_lens)
             return sample_tokens(logits, keys, temps, top_ks), pool
 
         def _prefill(params, pool, tokens, prompt_len, block_ids, temp,
-                     seed, top_k):
+                     seed, top_k, ad, ad_id):
             logits, pool = paged_prefill(
                 cfg, params, pool, tokens, prompt_len, block_ids,
-                compute_dtype=c,
+                compute_dtype=c, adapters=ad, adapter_id=ad_id,
+                lora_impl=lora_impl,
             )
             keys = make_slot_keys(
                 base_key, seed[None], (prompt_len - 1)[None]
@@ -393,10 +447,11 @@ class ServeEngine:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), dpool
 
         def _verify(params, pool, block_tables, seq_lens, tokens, limits,
-                    temps, seeds, top_ks):
+                    temps, seeds, top_ks, ad, ad_ids):
             logits, pool = paged_verify_step(
                 cfg, params, pool, block_tables, seq_lens, tokens,
-                limits, compute_dtype=c,
+                limits, compute_dtype=c, adapters=ad,
+                adapter_ids=ad_ids, lora_impl=lora_impl,
             )
             W, T = tokens.shape
             pos = (seq_lens[:, None] + jnp.arange(T)).reshape(-1)
@@ -421,6 +476,7 @@ class ServeEngine:
                eos_token_id: Optional[int] = None,
                top_k: Optional[int] = None,
                spec: Optional[int] = None,
+               adapter: Optional[str] = None,
                deadline_s: Optional[float] = None,
                sample_seed: Optional[int] = None,
                on_token=None, rid: Optional[str] = None,
@@ -434,6 +490,12 @@ class ServeEngine:
         the engine's ``spec_k`` default, 0 = plain target decode, K =
         at most K drafted tokens verified per tick (clamped to the
         engine width).
+
+        ``adapter`` decodes this request through the named tenant's
+        LoRA adapter (the pool's per-slot gathered delta, slot 0 for
+        None) — unknown or pool-less names are typed ``ValueError``
+        rejections (the queue plane surfaces them as ``invalid``
+        replies), never silent base-model fallbacks.
 
         ``sample_seed`` presets the request's sampling-stream identity
         (None = this engine's submission ordinal).  The disaggregated
@@ -476,6 +538,14 @@ class ServeEngine:
                 raise ValueError(
                     f"sample_seed must be >= 0, got {sample_seed}"
                 )
+        if adapter is not None:
+            adapter = str(adapter)
+            if self.adapters is None:
+                raise ValueError(
+                    f"request names adapter {adapter!r} but this engine "
+                    f"has no adapter pool — build it with "
+                    f"ServeConfig(max_adapters=N, adapter_rank=r)"
+                )
         if len(prompt) + max_new_tokens > self.max_model_len:
             raise ValueError(
                 f"prompt ({len(prompt)}) + max_new_tokens "
@@ -506,7 +576,7 @@ class ServeEngine:
         req = Request(
             rid=rid, prompt=prompt, max_new_tokens=max_new_tokens,
             temperature=float(temperature), eos_token_id=eos_token_id,
-            top_k=top_k, spec=spec,
+            top_k=top_k, spec=spec, adapter=adapter,
             deadline_s=deadline_s, sample_seed=sample_seed,
             on_token=on_token, trace=trace_ctx,
         )
@@ -515,6 +585,21 @@ class ServeEngine:
             req._handoff = _handoff
         handle = ServeHandle(rid, req)
         with self._lock:
+            if adapter is not None:
+                # Resolved under the SAME lock that enqueues: a
+                # remove_adapter/add_adapter on another thread either
+                # completes first (unknown name -> the typed rejection
+                # below) or sees this request via references_adapter —
+                # a slot can never be re-issued to a new tenant while a
+                # request resolved against the old one is in flight.
+                try:
+                    req._adapter_slot = self.adapters.slot_of(adapter)
+                except KeyError:
+                    raise ValueError(
+                        f"unknown adapter {adapter!r} — hot-load it "
+                        f"first (engine.add_adapter / "
+                        f"serve_adapter_load frame)"
+                    ) from None
             self.stats.bump("submitted")
             accepted = self.scheduler.submit(req)
             if accepted:
@@ -601,12 +686,17 @@ class ServeEngine:
                 )
             else:
                 self.stats.bump("prefills")
+                ad = None if self.adapters is None \
+                    else self.adapters.buffers
+                ad_id = None if self.adapters is None \
+                    else np.int32(req._adapter_slot)
                 first, self._pool = self._prefill_fn(
                     self.params, self._pool, padded,
                     np.int32(req.prompt_len), ids,
                     np.float32(req.temperature),
                     np.int32(req.sample_seed),
                     np.int32(req.top_k or 0),
+                    ad, ad_id,
                 )
             if self.draft_module is not None:
                 # The draft cache tracks every admission (one bucketed
@@ -637,6 +727,8 @@ class ServeEngine:
                                           rid=req.rid, token_index=0))
                 self.stats.note_phase("first_token", ft_dur)
             self.stats.bump("tokens_out")
+            if req.adapter is not None:
+                self.stats.note_adapter(req.adapter, tokens=1)
             self._cur_tokens[slot] = first
             if done:
                 self._complete(slot)
@@ -713,6 +805,23 @@ class ServeEngine:
             widths[slot] = max(0, min(k, remaining - 1))
         return widths
 
+    def _lora_operands(self):
+        """``(stacked adapter buffers, per-slot adapter_ids operand)``
+        for this tick — ``(None, None)`` on pool-less engines, which
+        keeps their compiled graphs byte-identical to pre-LoRA rounds.
+        The buffers reference is read once per tick: a concurrent hot
+        add swaps the pool's (immutable) tree atomically, and a new
+        slot cannot appear in ``adapter_slots`` before its add()
+        returned — so a tick sees either the old world or the new one,
+        never a torn mix."""
+        import jax.numpy as jnp
+
+        if self.adapters is None:
+            return None, None
+        return self.adapters.buffers, jnp.asarray(
+            self.scheduler.adapter_slots
+        )
+
     def _tick_top_ks(self):
         """``top_ks`` operand for this tick, or None when NO slot uses
         top-k — the None variant compiles without the full-vocab sort,
@@ -734,11 +843,13 @@ class ServeEngine:
         seq_lens = jnp.asarray(self.scheduler.seq_lens)
         cur = jnp.asarray(self._cur_tokens)
         tables = jnp.asarray(self.scheduler.block_tables)
+        ad, ad_ids = self._lora_operands()
         toks, self._pool = self._decode_fn(
             self.params, self._pool, tables, seq_lens, cur,
             jnp.asarray(self.scheduler.temperatures),
             jnp.asarray(self.scheduler.sample_seeds),
             self._tick_top_ks(),
+            ad, ad_ids,
         )
         if self.draft_module is not None:
             # Mirror the write into the draft cache so its frontier
@@ -762,6 +873,9 @@ class ServeEngine:
             self.scheduler.draft_lens[slot] = self.scheduler.seq_lens[slot]
             tok = int(toks[slot])  # rlt: noqa[RLT002] host np after the tick fetch
             self._cur_tokens[slot] = tok
+            req = self.scheduler.slots[slot]
+            if req is not None and req.adapter is not None:
+                self.stats.note_adapter(req.adapter, tokens=1)
             done = self.scheduler.append_token(slot, tok)
             if done:
                 self._complete(slot)
@@ -845,11 +959,13 @@ class ServeEngine:
             g = int(gaps[slot])  # rlt: noqa[RLT002] host np state
             window[slot, 1: K + 1] = outs[g: g + K, slot]
 
+        ad, ad_ids = self._lora_operands()
         sampled, self._pool = self._verify_fn(
             self.params, self._pool, tables,
             jnp.asarray(sched.seq_lens), jnp.asarray(window),
             limits_j, jnp.asarray(sched.temperatures),
             jnp.asarray(sched.sample_seeds), self._tick_top_ks(),
+            ad, ad_ids,
         )
         # rlt: noqa[RLT002] deliberate verify sync
         sampled = np.asarray(sampled)  # (W, K+1)
@@ -879,6 +995,9 @@ class ServeEngine:
             self._cur_tokens[slot] = emit[n - 1]
             total_emitted += n
             self.stats.note_spec_slot(w, min(accepted, n), n)
+            req = sched.slots[slot]
+            if req is not None and req.adapter is not None:
+                self.stats.note_adapter(req.adapter, tokens=n)
             if done:
                 self._complete(slot)
         self.stats.bump("spec_ticks")
@@ -896,6 +1015,8 @@ class ServeEngine:
         req = self.scheduler.finish(slot)
         e2e = req.finished_t - req.arrival_t
         self.stats.note_completed(e2e)
+        if req.adapter is not None:
+            self.stats.note_adapter(req.adapter, completed=1)
         if (self.tracer.enabled and req.trace is not None
                 and getattr(req, "_trace_local", False)):
             # Engine-owned traces (no router upstream) anchor their own
@@ -914,6 +1035,56 @@ class ServeEngine:
         if handle is not None:
             handle._done.set()
         self._reply_done(req)
+
+    # -- multi-tenant LoRA ---------------------------------------------------
+    def add_adapter(self, name: str, adapter: dict) -> int:
+        """Hot-load (or replace) one tenant's LoRA adapter; returns its
+        pool slot.  Replacement of an adapter any queued/active request
+        is decoding through is refused loudly — swapping factors under
+        a live sequence would change its model mid-stream."""
+        if self.adapters is None:
+            raise ValueError(
+                "engine has no adapter pool — build it with "
+                "ServeConfig(max_adapters=N, adapter_rank=r)"
+            )
+        name = str(name)
+        with self._lock:
+            # Guard and load under ONE lock hold: a submit landing
+            # between them would resolve the name against the factors
+            # being replaced (submit resolves slots under this lock).
+            if self.adapters.has(name) \
+                    and self.scheduler.references_adapter(name):
+                raise RuntimeError(
+                    f"adapter {name!r} is serving queued/active "
+                    f"requests — replacing its factors would change "
+                    f"their model mid-stream; drain the tenant first"
+                )
+            slot = self.adapters.add(name, adapter)
+        self.stats.bump("adapter_loads")
+        return slot
+
+    def remove_adapter(self, name: str) -> None:
+        """Free one tenant's pool slot.  Refused while any queued or
+        active request references the name (a freed slot re-issued to
+        a new tenant would serve the old tenant's requests the NEW
+        tenant's delta — the cross-tenant corruption a serving pool
+        must never allow)."""
+        if self.adapters is None:
+            raise ValueError("engine has no adapter pool")
+        name = str(name)
+        with self._lock:
+            if self.scheduler.references_adapter(name):
+                raise RuntimeError(
+                    f"adapter {name!r} is serving queued/active "
+                    f"requests — drain the tenant before removing it"
+                )
+            self.adapters.remove(name)
+        self.stats.bump("adapter_unloads")
+
+    def adapter_names(self) -> List[str]:
+        """Loaded tenant names (the replica beat advertises these for
+        adapter-aware router placement)."""
+        return [] if self.adapters is None else self.adapters.names()
 
     def drain_done(self) -> List[Tuple[str, str]]:
         """Terminal ``(rid, status)`` pairs since the last call — the
@@ -1033,7 +1204,7 @@ class ServeEngine:
             try:
                 item = self._inbox.get_nowait()
             except _pyqueue.Empty:
-                return
+                break
             try:
                 self._handle_queue_request(item)
             except Exception as e:  # noqa: BLE001 - a bad request must
@@ -1043,13 +1214,53 @@ class ServeEngine:
                 logging.getLogger(__name__).warning(
                     "serve: dropped malformed queue request: %s", e
                 )
+        if self._deferred_inbox:
+            # One retry pass per drain: each item re-defers (bounded)
+            # or proceeds now that its adapter-load frame landed above.
+            retry, self._deferred_inbox = self._deferred_inbox, deque()
+            for item in retry:
+                try:
+                    self._handle_queue_request(item)
+                except Exception as e:  # noqa: BLE001 - as above
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "serve: dropped malformed queue request: %s", e
+                    )
 
     def _handle_queue_request(self, item: dict) -> None:
         if not isinstance(item, dict):
             raise ValueError(f"not a serve item: {type(item).__name__}")
         kind = item.get("type")
+        if kind == "serve_adapter_load":
+            # Tenant hot-load from the queue plane (router dispatch or
+            # operator tooling): scatter into the pool through the ONE
+            # compiled scatter program — a join-on-arrival for MODELS,
+            # recompile-free like every other admission.
+            self._load_adapter_item(item)
+            return
         if kind == "serve_kv_handoff":
             fields = dict(item["req"])
+            adapter = fields.get("adapter")
+            if (adapter is not None and self.adapters is not None
+                    and not self.adapters.has(str(adapter))):
+                # The router's serve_adapter_load frame rides the
+                # router->replica lane; the handoff arrives from the
+                # prefill WORKER's own connection and can outrun it.
+                # Defer on a WALL-CLOCK deadline (a drain-count bound
+                # would scale with loop speed: an idle replica drains
+                # every ~2ms, exhausting any count long before a
+                # chunk-sent multi-MB blob lands cross-host) instead of
+                # failing a valid request "unknown adapter" — checked
+                # BEFORE _decode_handoff so the read-once shm payload
+                # survives the retry.
+                deadline = item.get("_adapter_wait_deadline")
+                if deadline is None:
+                    deadline = time.monotonic() + 10.0
+                    item["_adapter_wait_deadline"] = deadline
+                if time.monotonic() < deadline:
+                    self._deferred_inbox.append(item)
+                    return
         elif kind == "serve_request":
             fields = item
         else:
@@ -1100,6 +1311,7 @@ class ServeEngine:
                 eos_token_id=fields.get("eos_token_id"),
                 top_k=fields.get("top_k"),
                 spec=fields.get("spec"),
+                adapter=fields.get("adapter"),
                 deadline_s=fields.get("deadline_s"),
                 sample_seed=fields.get("sample_seed"),
                 on_token=on_token, rid=rid, _handoff=handoff,
@@ -1126,6 +1338,23 @@ class ServeEngine:
         handle.request._reply = reply
         if handle.status == "rejected":
             self._reply_done(handle.request)
+
+    def _load_adapter_item(self, item: dict) -> None:
+        """One ``serve_adapter_load`` frame: resolve the chunked-bytes
+        / tmpfs-segment payload (same dual transport as KV handoffs)
+        and add the tenant.  Raises on pool-less engines or malformed
+        payloads — ``_drain_inbox`` logs and drops, and the tenant's
+        subsequent requests come back as typed ``invalid`` replies
+        ("unknown adapter"), so a failed load is never silent."""
+        from ray_lightning_tpu.serve.lora import decode_adapter
+
+        if self.adapters is None:
+            raise ValueError(
+                "serve_adapter_load on an engine without an adapter "
+                "pool (ServeConfig.max_adapters == 0) — router caps "
+                "should have excluded this replica"
+            )
+        self.add_adapter(str(item["name"]), decode_adapter(item))
 
     def _decode_handoff(self, item: dict) -> dict:
         """Decode a ``serve_kv_handoff`` frame's ``{"kv", "logits"}``
@@ -1181,6 +1410,18 @@ class ServeEngine:
     # -- telemetry -----------------------------------------------------------
     def _refresh_gauges(self) -> None:
         gauges = self.scheduler.snapshot()
+        if self.adapters is not None:
+            pool = self.adapters.snapshot()
+            gauges["lora_adapters_loaded"] = pool["loaded"]
+            gauges["lora_slots_free"] = pool["slots_free"]
+            counts = [t for t in
+                      self.stats.adapter_token_counts().values() if t]
+            # Fairness spread: min/max lifetime tokens across tenants
+            # with traffic (1.0 = perfectly fair; the DRR grant policy
+            # keeps this near 1 under uniform per-tenant load).
+            gauges["lora_fairness_spread"] = (
+                min(counts) / max(counts) if len(counts) > 1 else 1.0
+            )
         if self.spec_k > 0:
             counters = self.stats.counters
             drafted = counters.get("spec_drafted", 0)

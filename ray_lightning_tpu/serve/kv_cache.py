@@ -53,6 +53,7 @@ from ray_lightning_tpu.models.gpt import (
 )
 from ray_lightning_tpu.models.quant import resolve_weight
 from ray_lightning_tpu.ops.attention import _NEG_INF
+from ray_lightning_tpu.ops.lora import apply_lora
 
 __all__ = [
     "BlockAllocator",
@@ -265,6 +266,9 @@ def paged_prefill(
     prompt_len: jax.Array,
     block_ids: jax.Array,
     compute_dtype=jnp.float32,
+    adapters: Optional[Dict[str, jax.Array]] = None,
+    adapter_id: Optional[jax.Array] = None,
+    lora_impl: str = "xla",
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """One prompt through the full-sequence causal pass, cache written
     into the sequence's pool blocks.
@@ -285,6 +289,12 @@ def paged_prefill(
 
     Compiled once per bucket length ``T`` — the "few bucketed prompt
     lengths" prefill programs of the serving plane.
+
+    ``adapters``/``adapter_id`` (multi-tenant LoRA): the pool's stacked
+    per-layer factor buffers plus THIS prompt's scalar int32 slot id
+    (an operand — any tenant rides the same bucket program; slot 0 is
+    the zero-delta base model).  ``None`` keeps the graph
+    byte-identical to pre-LoRA rounds.
     """
     c = compute_dtype
     T = tokens.shape[0]
@@ -304,7 +314,10 @@ def paged_prefill(
         "v": jnp.zeros((cfg.n_layer, 1, T, cfg.n_head, cfg.head_dim),
                        pool["v"].dtype),
     }
-    hidden, tmp = _trunk_blocks(cfg, params, tmp, x, 0, c)
+    ad_ids = None if adapter_id is None else adapter_id.reshape((1,))
+    hidden, tmp = _trunk_blocks(cfg, params, tmp, x, 0, c,
+                                adapters=adapters, adapter_ids=ad_ids,
+                                lora_impl=lora_impl)
     h_last = jax.lax.dynamic_index_in_dim(
         hidden[0], prompt_len - 1, axis=0, keepdims=False
     )
@@ -328,6 +341,9 @@ def paged_decode_step(
     tokens: jax.Array,
     compute_dtype=jnp.float32,
     write_limit: Optional[jax.Array] = None,
+    adapters: Optional[Dict[str, jax.Array]] = None,
+    adapter_ids: Optional[jax.Array] = None,
+    lora_impl: str = "xla",
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """One token for every slot of the fixed-width active set.
 
@@ -347,6 +363,12 @@ def paged_decode_step(
             (uniform chain length over non-uniform per-slot widths);
             the limit redirects those strays.  ``None`` = the plain
             serve decode program, graph-identical to pre-spec rounds.
+        adapters: optional stacked per-layer LoRA factor buffers
+            (``serve/lora.py`` pool; leading axis L rides the scan
+            like the KV pool) with per-slot ``adapter_ids`` int32 —
+            each slot's own adapter delta lands on its qkv/proj
+            projections (slot 0 = zero delta).  ``None`` = the
+            pre-LoRA program, byte-identical.
 
     Returns:
         ``(logits (W, V) f32, updated pool)``.
@@ -385,9 +407,14 @@ def paged_decode_step(
 
     def block(carry, layer):
         x, = carry
-        p, k_pool, v_pool = layer  # (N, Bs, H, Dh) each
+        if adapters is None:
+            p, k_pool, v_pool = layer  # (N, Bs, H, Dh) each
+            ad = None
+        else:
+            p, k_pool, v_pool, ad = layer
         h = _layer_norm(x, p["ln1_g"], p["ln1_b"])
         qkv = h @ resolve_weight(p, "qkv_w", c) + p["qkv_b"].astype(c)
+        qkv = apply_lora(qkv, h, ad, "qkv", adapter_ids, lora_impl)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def heads(z):
@@ -410,7 +437,9 @@ def paged_decode_step(
         att = jnp.einsum(
             "whs,wshd->whd", probs, ctx_v.astype(jnp.float32)
         ).reshape(W, cfg.d_model).astype(c)
-        x = x + att @ resolve_weight(p, "proj_w", c) + p["proj_b"].astype(c)
+        proj = att @ resolve_weight(p, "proj_w", c) + p["proj_b"].astype(c)
+        proj = apply_lora(proj, att, ad, "proj", adapter_ids, lora_impl)
+        x = x + proj
         if cfg.n_experts > 0:
             # Same routed-MLP math as the static decode; the routed set
             # here is the W current tokens (see generate() caveat).
@@ -420,9 +449,10 @@ def paged_decode_step(
             x = _mlp_residual(x, p, c)
         return (x,), (k_pool, v_pool)
 
-    (x,), (k_new, v_new) = jax.lax.scan(
-        block, (x,), (params["blocks"], pool["k"], pool["v"])
-    )
+    xs = (params["blocks"], pool["k"], pool["v"])
+    if adapters is not None:
+        xs = xs + (adapters,)
+    (x,), (k_new, v_new) = jax.lax.scan(block, (x,), xs)
     logits = _head_logits(params, x, c)
     return logits, {"k": k_new, "v": v_new}
 
@@ -436,9 +466,17 @@ def paged_verify_step(
     tokens: jax.Array,
     write_limit: jax.Array,
     compute_dtype=jnp.float32,
+    adapters: Optional[Dict[str, jax.Array]] = None,
+    adapter_ids: Optional[jax.Array] = None,
+    lora_impl: str = "xla",
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """``T`` tokens for every slot in ONE dispatch — the target model's
-    speculative verification program.
+    speculative verification program.  ``adapters``/``adapter_ids``
+    apply each slot's own LoRA delta across its whole window (see
+    :func:`paged_decode_step`); verification composes with the
+    adapter pool because the TARGET is what carries the tenant's
+    adapter — a base-model draft just proposes, and disagreements are
+    corrected by the adapter-bearing verify sample.
 
     Where :func:`paged_decode_step` feeds one token per slot at
     ``seq_lens``, this feeds a ``(W, T)`` window — each slot's current
@@ -486,9 +524,14 @@ def paged_verify_step(
 
     def block(carry, layer):
         x, = carry
-        p, k_pool, v_pool = layer  # (N, Bs, H, Dh) each
+        if adapters is None:
+            p, k_pool, v_pool = layer  # (N, Bs, H, Dh) each
+            ad = None
+        else:
+            p, k_pool, v_pool, ad = layer
         h = _layer_norm(x, p["ln1_g"], p["ln1_b"])
         qkv = h @ resolve_weight(p, "qkv_w", c) + p["qkv_b"].astype(c)
+        qkv = apply_lora(qkv, h, ad, "qkv", adapter_ids, lora_impl)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def heads(z):
@@ -511,7 +554,9 @@ def paged_verify_step(
         att = jnp.einsum(
             "whts,wshd->wthd", probs, ctx_v.astype(jnp.float32)
         ).reshape(W, T, cfg.d_model).astype(c)
-        x = x + att @ resolve_weight(p, "proj_w", c) + p["proj_b"].astype(c)
+        proj = att @ resolve_weight(p, "proj_w", c) + p["proj_b"].astype(c)
+        proj = apply_lora(proj, att, ad, "proj", adapter_ids, lora_impl)
+        x = x + proj
         if cfg.n_experts > 0:
             # Routed set = the W*T window tokens (see generate() caveat).
             x, _ = _moe_residual(x, p, cfg, groups=1)
@@ -519,9 +564,10 @@ def paged_verify_step(
             x = _mlp_residual(x, p, c)
         return (x,), (k_pool, v_pool)
 
-    (x,), (k_new, v_new) = jax.lax.scan(
-        block, (x,), (params["blocks"], pool["k"], pool["v"])
-    )
+    xs = (params["blocks"], pool["k"], pool["v"])
+    if adapters is not None:
+        xs = xs + (adapters,)
+    (x,), (k_new, v_new) = jax.lax.scan(block, (x,), xs)
     logits = _head_logits(params, x, c)
     return logits, {"k": k_new, "v": v_new}
 

@@ -97,6 +97,11 @@ class ServeStats:
         # lazily created by note_phase so engines that never trace keep
         # snapshots byte-identical to pre-tracing rounds.
         self._phases: Dict[str, _Reservoir] = {}
+        # Per-adapter (tenant) accounting — lazily created by
+        # note_adapter, so engines without an adapter pool keep
+        # snapshots byte-identical to pre-LoRA rounds.  The bench's
+        # fairness spread and the rlt_top tenant pane read these.
+        self._adapters: Dict[str, Dict[str, int]] = {}
         self.gauges: Dict[str, float] = {}
 
     def bump(self, name: str, n: int = 1) -> None:
@@ -142,6 +147,21 @@ class ServeStats:
                            ("spec_emitted", emitted)):
                 self.counters[key] = self.counters.get(key, 0) + n
 
+    def note_adapter(self, name: str, tokens: int = 0,
+                     completed: int = 0) -> None:
+        """Per-tenant accounting for one emission/completion on a
+        multi-LoRA engine (``serve/lora.py``) — the fairness surface:
+        spread across these token counters is what the
+        deficit-round-robin grant policy bounds."""
+        with self._lock:
+            entry = self._adapters.get(name)
+            if entry is None:
+                entry = self._adapters[name] = {
+                    "tokens_out": 0, "completed": 0,
+                }
+            entry["tokens_out"] += tokens
+            entry["completed"] += completed
+
     def note_phase(self, phase: str, dur_s: float) -> None:
         """One critical-path phase interval for one request (the
         tracing plane feeds these; see docs/OBSERVABILITY.md
@@ -151,6 +171,12 @@ class ServeStats:
             if res is None:
                 res = self._phases[phase] = _Reservoir()
             res.add(dur_s)
+
+    def adapter_token_counts(self) -> Dict[str, int]:
+        """Lifetime emitted tokens per adapter — the engine's fairness
+        gauge (min/max spread) reads this each tick."""
+        with self._lock:
+            return {k: v["tokens_out"] for k, v in self._adapters.items()}
 
     def set_gauges(self, **gauges: float) -> None:
         with self._lock:
@@ -180,4 +206,9 @@ class ServeStats:
                     if s is not None:
                         phases[name] = s
                 out["phases"] = phases
+            if self._adapters:  # multi-LoRA engines only — see __init__
+                out["adapters"] = {
+                    name: dict(entry)
+                    for name, entry in self._adapters.items()
+                }
             return out

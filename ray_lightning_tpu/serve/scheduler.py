@@ -28,7 +28,7 @@ import enum
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable, Deque, List, Optional, Sequence, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -38,6 +38,10 @@ from ray_lightning_tpu.serve.kv_cache import (
 
 __all__ = ["Request", "RequestState", "Scheduler", "default_buckets",
            "derive_geometry"]
+
+# Deficit-round-robin "no grant yet" marker (None is a real key: the
+# base model).
+_RR_NEVER = object()
 
 
 class RequestState(enum.Enum):
@@ -64,6 +68,11 @@ class Request:
     # engine default, 0 = plain target decode, K > 0 = up to K drafted
     # tokens verified per tick (capped per tick by the tokens left).
     spec: Optional[int] = None
+    # Multi-tenant LoRA: the adapter (tenant) this request decodes
+    # through (None = the shared base model).  The engine resolves the
+    # name to its pool slot at submit; the slot id rides the compiled
+    # step as the per-slot ``adapter_ids`` operand.
+    adapter: Optional[str] = None
     # Seconds from arrival the FIRST token must land by (TTFT SLO at
     # admission; None = no deadline).
     deadline_s: Optional[float] = None
@@ -95,6 +104,10 @@ class Request:
     # Set once at submit and NEVER cleared on preemption requeue, so a
     # recompute replay's spans land in the original trace.
     trace: Optional[object] = None
+    # The adapter's resolved pool slot (engine-set at submit; 0 = the
+    # NULL/base slot).  Stable across preemption requeues — the pool
+    # refuses to remove an adapter any queued/active request holds.
+    _adapter_slot: int = 0
 
     @property
     def prompt_len(self) -> int:
@@ -170,6 +183,7 @@ class Scheduler:
         max_blocks_per_seq: int,
         buckets: Sequence[int],
         max_queue: int = 64,
+        max_queue_per_adapter: Optional[int] = None,
     ):
         if num_slots < 1:
             raise ValueError(f"num_slots must be >= 1, got {num_slots}")
@@ -185,6 +199,9 @@ class Scheduler:
         self.max_blocks_per_seq = max_blocks_per_seq
         self.buckets = sorted(buckets)
         self.max_queue = max_queue
+        # Per-tenant admission-queue bound: one tenant's burst must not
+        # consume the whole shared queue (None = shared bound only).
+        self.max_queue_per_adapter = max_queue_per_adapter
         self.queue: Deque[Request] = deque()
         self.slots: List[Optional[Request]] = [None] * num_slots
         # Per-slot allocated physical blocks, in logical order.
@@ -202,8 +219,19 @@ class Scheduler:
         # position draft_lens[slot] - 1.  Trails seq_lens by at most 1
         # (the bonus-token tick), never leads it.
         self.draft_lens = np.zeros((num_slots,), np.int32)
+        # Multi-tenant LoRA: each slot's adapter-pool slot id, ridden
+        # into the compiled step as the ``adapter_ids`` operand (0 =
+        # the NULL/base adapter — inactive slots gather a zero delta).
+        self.adapter_slots = np.zeros((num_slots,), np.int32)
         self._admit_counter = 0
         self._submit_counter = 0
+        # Fairness state: the adapter key granted the LAST slot —
+        # deficit-round-robin with a unit quantum (request costs are
+        # uniform at admission: one slot, one bucket) cycles grants
+        # across the tenants with queued work starting after this key.
+        # The sentinel distinguishes "never granted" from "last grant
+        # was the base (None) key".
+        self._rr_last: object = _RR_NEVER
 
     # -- queue side ----------------------------------------------------------
     @property
@@ -217,11 +245,33 @@ class Scheduler:
     def has_work(self) -> bool:
         return bool(self.queue) or self.active_slots > 0
 
+    def queued_for(self, adapter: Optional[str]) -> int:
+        """Queued requests for one adapter key (None = base model)."""
+        return sum(1 for r in self.queue if r.adapter == adapter)
+
+    def references_adapter(self, name: str) -> bool:
+        """True while any queued or active request decodes through
+        ``name`` — the engine's remove-adapter guard (freeing a slot a
+        live request still gathers would serve it a neighbour's —
+        or stale — delta)."""
+        return any(r.adapter == name for r in self.queue) or any(
+            r is not None and r.adapter == name for r in self.slots
+        )
+
     def submit(self, req: Request) -> bool:
-        """Enqueue, or reject (backpressure) when the queue is full.
-        Rejection is synchronous and typed — the client decides whether
-        to retry, never the server."""
+        """Enqueue, or reject (backpressure) when the shared queue — or
+        the request's PER-ADAPTER bound — is full.  Rejection is
+        synchronous and typed — the client decides whether to retry,
+        never the server.  The per-adapter cap is the multi-tenant
+        admission contract: one tenant's burst saturates its own bound
+        and starts bouncing while every other tenant keeps its seats.
+        """
         if len(self.queue) >= self.max_queue:
+            req.state = RequestState.REJECTED
+            return False
+        if (self.max_queue_per_adapter is not None
+                and self.queued_for(req.adapter)
+                >= self.max_queue_per_adapter):
             req.state = RequestState.REJECTED
             return False
         req.state = RequestState.QUEUED
@@ -275,12 +325,21 @@ class Scheduler:
             )
             if slot is None:
                 break
-            req = self.queue[0]
+            pick = self._next_grant_index()
+            req = self.queue[pick]
             bucket = self.bucket_for(req.prompt_len)
             ids = self.allocator.alloc(bucket // self.block_size)
             if ids is None:
-                break  # pool dry: wait for evictions, keep FIFO order
-            self.queue.popleft()
+                break  # pool dry: wait for evictions, keep grant order
+            del self.queue[pick]
+            if not req.preemptions:
+                # Only ROTATION grants advance the fairness pointer: a
+                # preempted request rides the priority lane, and letting
+                # it move _rr_last would skip the tenants between the
+                # last rotation grant and its key — one tenant's
+                # repeated preemptions would systematically defer the
+                # others a full cycle each time.
+                self._rr_last = req.adapter
             req.state = RequestState.RUNNING
             req.slot = slot
             req.admitted_t = now
@@ -297,8 +356,46 @@ class Scheduler:
             self.top_ks[slot] = req.top_k or 0
             self.sample_seeds[slot] = req.sample_seed
             self.draft_lens[slot] = req.prompt_len
+            self.adapter_slots[slot] = req._adapter_slot
             admissions.append((slot, req, bucket))
         return admissions, expired
+
+    def _next_grant_index(self) -> int:
+        """Queue index of the next slot grant.
+
+        Priority 1 — preempted requests, in queue order: the
+        front-requeue contract (latency already invested is never
+        thrown away) outranks fairness.  Priority 2 —
+        deficit-round-robin over the adapter keys with queued work
+        (unit quantum: every admission costs one slot and one bucket,
+        so the deficit counter degenerates to strict rotation), FIFO
+        within a key: the grant goes to the first key cyclically AFTER
+        the last granted one, so one tenant's burst cannot monopolize
+        slot turnover while others queue.  Single-key traffic (the
+        whole pre-LoRA world: every request keys to the base model)
+        reduces exactly to the old FIFO order.
+        """
+        for i, r in enumerate(self.queue):
+            if r.preemptions:
+                return i
+        first_idx: Dict[Optional[str], int] = {}
+        for i, r in enumerate(self.queue):
+            if r.adapter not in first_idx:
+                first_idx[r.adapter] = i
+        if len(first_idx) == 1:
+            return next(iter(first_idx.values()))
+
+        def keypos(k: Optional[str]) -> Tuple[bool, str]:
+            # Canonical cyclic order: base (None) first, then names.
+            return (k is not None, k or "")
+
+        order = sorted(first_idx, key=keypos)
+        if self._rr_last is not _RR_NEVER:
+            last = keypos(self._rr_last)
+            for k in order:
+                if keypos(k) > last:
+                    return first_idx[k]
+        return first_idx[order[0]]
 
     # -- per-step slot transitions ------------------------------------------
     def append_token(self, slot: int, token: int,
@@ -443,6 +540,7 @@ class Scheduler:
         self.top_ks[slot] = 0
         self.sample_seeds[slot] = 0
         self.draft_lens[slot] = 0
+        self.adapter_slots[slot] = 0
 
     # -- introspection -------------------------------------------------------
     def snapshot(self) -> dict:

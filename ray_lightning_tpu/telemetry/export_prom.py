@@ -283,11 +283,43 @@ def _render_serve(serve: Dict[str, Any]) -> list:
          "accepted / drafted over the engine lifetime"),
         ("spec_goodput_tokens_per_sec",
          "client-visible emitted tokens per second"),
+        ("lora_adapters_loaded",
+         "LoRA tenants resident in the adapter pool"),
+        ("lora_slots_free", "free adapter-pool slots"),
+        ("lora_fairness_spread",
+         "min/max lifetime tokens across LoRA tenants with traffic "
+         "(1.0 = perfectly fair)"),
     ):
         if name in gauges:
             lines.append(f"# TYPE {_PREFIX}_serve_{name} gauge")
             lines.append(f"# HELP {_PREFIX}_serve_{name} {help_}")
             lines.append(f"{_PREFIX}_serve_{name} {gauges[name]}")
+    # Multi-tenant LoRA (engines with an adapter pool): per-tenant
+    # token/completion accounting — the fairness-spread decomposition.
+    adapters = serve.get("adapters", {})
+    if adapters:
+        lines.append(f"# TYPE {_PREFIX}_serve_lora_tokens counter")
+        lines.append(
+            f"# HELP {_PREFIX}_serve_lora_tokens emitted tokens per "
+            f"LoRA tenant"
+        )
+        for name in sorted(adapters):
+            lines.append(
+                f'{_PREFIX}_serve_lora_tokens_total'
+                f'{{adapter="{_esc(name)}"}} '
+                f"{adapters[name].get('tokens_out', 0)}"
+            )
+        lines.append(f"# TYPE {_PREFIX}_serve_lora_completed counter")
+        lines.append(
+            f"# HELP {_PREFIX}_serve_lora_completed completed requests "
+            f"per LoRA tenant"
+        )
+        for name in sorted(adapters):
+            lines.append(
+                f'{_PREFIX}_serve_lora_completed_total'
+                f'{{adapter="{_esc(name)}"}} '
+                f"{adapters[name].get('completed', 0)}"
+            )
     latency = serve.get("latency", {})
     for family, summary in sorted(latency.items()):
         metric = f"serve_{family}_latency_ms"

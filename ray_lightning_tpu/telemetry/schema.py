@@ -39,10 +39,12 @@ __all__ = [
     "validate_serve_reply",
     "validate_serve_snapshot",
     "validate_serve_kv_handoff",
+    "validate_serve_adapter_load",
     "validate_router_snapshot",
     "validate_bench_serve",
     "validate_bench_spec_decode",
     "validate_bench_serve_disagg",
+    "validate_bench_multi_lora",
     "validate_mpmd_stage_item",
     "validate_mpmd_xfer",
     "validate_mpmd_snapshot",
@@ -376,6 +378,9 @@ _SERVE_REQUEST_OPTIONAL = {
     "eos_token_id": (int, type(None)),
     "top_k": (int, type(None)),       # shape-static sampler truncation
     "spec": (int, type(None)),        # per-request draft count cap
+    # Multi-tenant LoRA: the adapter (tenant) to decode through
+    # (None/absent = the shared base model).
+    "adapter": (str, type(None)),
     "deadline_s": (int, float, type(None)),
     # Disaggregated serving: the router's fleet-wide sampling-stream
     # identity (absent/None = the engine assigns its own ordinal).
@@ -450,9 +455,16 @@ _SERVE_SNAPSHOT_REQUIRED = {
     "latency": dict,
 }
 # "phases" appears only on TRACING engines (ServeStats.note_phase is
-# lazily fed by the request tracer) — per critical-path phase p50/p95.
+# lazily fed by the request tracer) — per critical-path phase p50/p95;
+# "adapters" only on multi-LoRA engines (ServeStats.note_adapter) —
+# per-tenant token/completion accounting, the fairness surface.
 _SERVE_SNAPSHOT_OPTIONAL = {
     "phases": dict,
+    "adapters": dict,
+}
+_SERVE_ADAPTER_ENTRY_FIELDS = {
+    "tokens_out": int,
+    "completed": int,
 }
 _SERVE_LATENCY_KEYS = ("ttft", "token", "queue_wait", "e2e")
 _SERVE_LATENCY_FIELDS = {
@@ -490,6 +502,16 @@ def validate_serve_snapshot(doc: Any,
     if isinstance(rate, (int, float)) and not 0.0 <= rate <= 1.0:
         problems.append(
             f"{where}: spec_acceptance_rate {rate} outside [0, 1]"
+        )
+    spread = doc["gauges"].get("lora_fairness_spread")
+    if isinstance(spread, (int, float)) and not 0.0 <= spread <= 1.0:
+        problems.append(
+            f"{where}: lora_fairness_spread {spread} outside [0, 1]"
+        )
+    for name, entry in doc.get("adapters", {}).items():
+        problems += _check_fields(
+            entry, _SERVE_ADAPTER_ENTRY_FIELDS, {},
+            f"{where}.adapters.{name}",
         )
     counters = doc["counters"]
     if all(isinstance(counters.get(k), int)
@@ -567,6 +589,41 @@ def validate_serve_kv_handoff(item: Any,
     return problems
 
 
+# The router/operator → member adapter hot-load envelope (multi-tenant
+# LoRA; serve/dist/handoff.py::make_adapter_load_item).  Like KV
+# handoffs, the bulk factor payload (encode_adapter bytes) rides
+# EXACTLY ONE of data/shm and is deliberately outside the schema.
+_SERVE_ADAPTER_LOAD_REQUIRED = {
+    "type": str,          # always "serve_adapter_load"
+    "name": str,          # tenant name (the pool registry key)
+    "rank": int,          # stacked-buffer rank the pool must match
+}
+_SERVE_ADAPTER_LOAD_OPTIONAL = {
+    "data": bytes,
+    "shm": str,
+}
+
+
+def validate_serve_adapter_load(item: Any,
+                                where: str = "serve_adapter_load"
+                                ) -> List[str]:
+    problems = _validate_typed(
+        item, "serve_adapter_load", _SERVE_ADAPTER_LOAD_REQUIRED,
+        _SERVE_ADAPTER_LOAD_OPTIONAL, where,
+    )
+    if problems:
+        return problems
+    if ("data" in item) == ("shm" in item):
+        problems.append(
+            f"{where}: exactly one of data/shm payload required"
+        )
+    if item["rank"] < 1:
+        problems.append(f"{where}: rank must be >= 1")
+    if not item["name"]:
+        problems.append(f"{where}: empty adapter name")
+    return problems
+
+
 # router-live.json (Router.snapshot — the rlt_top router pane and the
 # per-replica rlt_serve_* OpenMetrics labels parse this).
 _ROUTER_SNAPSHOT_REQUIRED = {
@@ -584,9 +641,11 @@ _ROUTER_REPLICA_OPTIONAL = {
     "num_blocks": (int, float),
     "spec_acceptance_rate": (int, float),
     "recompiles": int,
+    "adapters": int,       # loaded LoRA tenants (pool-capable members)
 }
 _ROUTER_WORKER_OPTIONAL = {
     "last_beat_age_s": (int, float, type(None)),
+    "adapters": int,
 }
 
 
@@ -841,6 +900,67 @@ def validate_bench_serve_disagg(block: Any,
                     f"{where}.chaos: completed + lost > submitted"
                 )
         problems += chaos_problems
+    return problems
+
+
+# The bench_serve.py multi-tenant LoRA block: N adapters multiplexed
+# over ONE resident base engine vs the merge-and-swap-per-tenant
+# baseline (fold tenant k's factors into the weights, serve its batch,
+# swap for the next tenant — the pre-pool serving shape).  Both arms
+# pin their steady-state recompile counters (the zero-recompile
+# contract covers adapter joins and hot-adds); fairness_spread is
+# min/max lifetime tokens across tenants under uniform offered load
+# (1.0 = perfectly fair, the DRR grant surface); greedy_parity pins
+# every tenant's multiplexed stream token-for-token against its
+# merged-model baseline.
+_BENCH_MULTI_LORA_REQUIRED = {
+    "adapters": int,                           # tenant count (N)
+    "rank": int,                               # stacked-buffer rank
+    "tokens_per_sec": (int, float),            # multiplexed arm
+    "baseline_tokens_per_sec": (int, float),   # merge-and-swap arm
+    "vs_baseline": (int, float),               # the >= 3x headline
+    "fairness_spread": (int, float),
+    "recompiles_steady_state": int,
+    "baseline_recompiles_steady_state": int,
+}
+_BENCH_MULTI_LORA_OPTIONAL = {
+    "requests": int,
+    "max_new_tokens": int,
+    "requests_per_sec": (int, float, type(None)),
+    "greedy_parity": bool,
+    "hot_adds": int,              # tenants joined AFTER warmup
+    "pool_loads": int,
+    "bgmv_impl": str,             # "xla" | "pallas" (engine-resolved)
+    "completed": int,
+}
+
+
+def validate_bench_multi_lora(block: Any,
+                              where: str = "multi_lora") -> List[str]:
+    """Validate the ``multi_lora`` block of a bench artifact (absent on
+    pre-multi-tenant rounds)."""
+    problems = _check_fields(
+        block, _BENCH_MULTI_LORA_REQUIRED, _BENCH_MULTI_LORA_OPTIONAL,
+        where,
+    )
+    if problems:
+        return problems
+    if block["adapters"] < 1:
+        problems.append(f"{where}: adapters must be >= 1")
+    if block["rank"] < 1:
+        problems.append(f"{where}: rank must be >= 1")
+    if not 0.0 <= block["fairness_spread"] <= 1.0:
+        problems.append(
+            f"{where}: fairness_spread {block['fairness_spread']} "
+            "outside [0, 1]"
+        )
+    for key in ("recompiles_steady_state",
+                "baseline_recompiles_steady_state"):
+        if block[key] < 0:
+            problems.append(f"{where}: negative {key}")
+    impl = block.get("bgmv_impl")
+    if impl is not None and impl not in ("xla", "pallas"):
+        problems.append(f"{where}: unknown bgmv_impl {impl!r}")
     return problems
 
 

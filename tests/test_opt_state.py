@@ -216,6 +216,9 @@ def _fit_loss(cfg, **trainer_kw):
     return float(t.callback_metrics["train_loss"])
 
 
+@pytest.mark.slow  # tier-1 diet (round 20): two full fits, ~20s on a
+# loaded container; the quantize units + bytes-ratio bar are the
+# tier-1 smoke, the fit-parity arms run via -m slow
 def test_int8_fit_loss_parity_vs_f32():
     """The tentpole gate: the int8 opt-state fit matches the f32 arm's
     loss curve within the tolerance the int8_ef grad-comm gate uses
@@ -225,7 +228,7 @@ def test_int8_fit_loss_parity_vs_f32():
     assert abs(got - ref) <= 0.01 * abs(ref)
 
 
-@pytest.mark.slow  # tier-1 budget: the int8 arm above is the gate
+@pytest.mark.slow  # tier-1 budget: fit-parity arms are slow-tier
 def test_bf16_fit_loss_parity_vs_f32():
     ref = _fit_loss(tiny(opt_state_dtype="float32"))
     got = _fit_loss(tiny(opt_state_dtype="bfloat16"))

@@ -237,6 +237,8 @@ class TestPagedParity:
             rtol=1e-5, atol=1e-5,
         )
 
+    @pytest.mark.slow  # tier-1 diet (round 20): ~11s token-by-token
+    # sweep; prefill_logits parity is the tier-1 paged-parity smoke
     def test_teacher_forced_decode_matches_full_forward(self, model):
         """Feeding tokens one-by-one through the PAGED cache reproduces
         the full forward's logits at every position — across block
@@ -296,6 +298,8 @@ class TestEngine:
         assert h2.result(5) == _ref_tokens(m, params, p2, 8)
         assert eng.snapshot()["counters"]["completed"] == 2
 
+    @pytest.mark.slow  # tier-1 diet (round 20): ~8s multi-wave fit;
+    # join_on_arrival + preemption keep block reuse covered in tier-1
     def test_block_free_and_reuse_is_clean(self, model):
         """After a request finishes its blocks are reused by the next
         admission — stale cache content leaking through would corrupt

@@ -866,6 +866,8 @@ class TestInprocFleet:
             client.close()
             fleet.close()
 
+    @pytest.mark.slow  # tier-1 diet (round 20): ~8s fleet fit; the
+    # router-rig failover units + fleet_parity smoke stay in tier-1
     def test_client_failover_dedup_mid_stream(self, dist_model):
         """Satellite: engineered replica death mid-stream — the
         survivor's re-emission is deduped by token index and the final
@@ -910,6 +912,9 @@ class TestInprocFleet:
             client.close()
             fleet.close()
 
+    @pytest.mark.slow  # tier-1 diet (round 20): ~16s, the largest
+    # serve_dist fit; spec x fleet composition is covered via -m slow,
+    # fleet_parity_and_zero_recompiles is the tier-1 fleet smoke
     def test_spec_fleet_parity(self, dist_model):
         """Disagg x speculation: draft-capable replicas serve spec
         requests token-for-token like the monolith spec engine (KV
@@ -948,6 +953,9 @@ class TestInprocFleet:
 
 @pytest.mark.remote
 class TestActorFleet:
+    @pytest.mark.slow  # tier-1 diet (round 20): ~15s actor spawn +
+    # model build x2; the inproc fleet smoke covers the dataflow in
+    # tier-1, the actor shapes run via -m slow with the chaos arm
     def test_two_actor_smoke(self, dist_model, tmp_path):
         """1 prefill actor + 1 decode actor — the full cross-process
         dataflow (dispatch → prefill → segment/queue handoff → import

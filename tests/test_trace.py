@@ -561,6 +561,9 @@ class TestFleetTracing:
 
 
 class TestMpmdTracing:
+    @pytest.mark.slow  # tier-1 diet (round 20): ~7s 2-worker pipeline
+    # fit; the strategy trace-dir unit + untraced-runner pin stay in
+    # tier-1, the stitched-timeline fit runs via -m slow
     def test_two_worker_stitched_step_timeline(self, tmp_path):
         """In-proc 2-worker pipeline: both workers' instruction spans
         share one step trace (minted on the embed worker, adopted from

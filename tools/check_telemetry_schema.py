@@ -32,6 +32,7 @@ from ray_lightning_tpu.telemetry.schema import (  # noqa: E402
     validate_bench_fault,
     validate_bench_host_overhead,
     validate_bench_mpmd,
+    validate_bench_multi_lora,
     validate_bench_opt_state,
     validate_bench_residual_policy,
     validate_bench_serve,
@@ -503,6 +504,109 @@ def _self_test_serve() -> list:
         )
     problems += _self_test_spec_decode(stats)
     problems += _self_test_serve_disagg()
+    problems += _self_test_multi_lora()
+    return problems
+
+
+def _self_test_multi_lora() -> list:
+    """Multi-tenant LoRA producers vs their schema: a REAL per-tenant
+    ServeStats snapshot (note_adapter feeds the ``adapters`` block and
+    the fairness gauge), an adapter-bearing wire request
+    (request_fields), a REAL hot-load frame (make_adapter_load_item),
+    and the bench multi_lora block — plus negatives (both payload
+    forms, a non-string adapter field, a fairness spread outside
+    [0, 1], per-tenant accounting with a dropped counter, recompile
+    pins missing)."""
+    from ray_lightning_tpu.serve.dist.handoff import (
+        make_adapter_load_item, request_fields,
+    )
+    from ray_lightning_tpu.serve.metrics import ServeStats
+    from ray_lightning_tpu.telemetry.schema import (
+        validate_bench_multi_lora, validate_serve_adapter_load,
+    )
+
+    stats = ServeStats()
+    stats.bump("submitted", 2)
+    stats.note_adapter("tenant0", tokens=16, completed=1)
+    stats.note_adapter("tenant1", tokens=16, completed=1)
+    stats.set_gauges(queue_depth=0, lora_adapters_loaded=2,
+                     lora_slots_free=6, lora_fairness_spread=1.0)
+    snap = stats.snapshot()
+    problems = validate_serve_snapshot(snap, "self-test lora snapshot")
+    bad = json_roundtrip(snap)
+    bad["gauges"]["lora_fairness_spread"] = 1.5
+    if not validate_serve_snapshot(bad):
+        problems.append(
+            "self-test lora snapshot: validator accepted a fairness "
+            "spread > 1"
+        )
+    bad = json_roundtrip(snap)
+    del bad["adapters"]["tenant0"]["completed"]
+    if not validate_serve_snapshot(bad):
+        problems.append(
+            "self-test lora snapshot: validator accepted a tenant "
+            "entry missing its completion counter"
+        )
+
+    req = request_fields(
+        "abc", [1, 2, 3], 8, reply=("127.0.0.1", 12345), sample_seed=3,
+        adapter="tenant0",
+    )
+    problems += validate_serve_request(req, "self-test lora request")
+    if not validate_serve_request({**req, "adapter": 7}):
+        problems.append(
+            "self-test lora request: validator accepted a non-string "
+            "adapter"
+        )
+
+    load = make_adapter_load_item("tenant0", 8, data=b"\x00factors")
+    problems += validate_serve_adapter_load(load, "self-test lora load")
+    problems += validate_serve_adapter_load(
+        make_adapter_load_item("tenant0", 8, shm="/dev/shm/rlt-kv-1"),
+        "self-test lora load shm",
+    )
+    if not validate_serve_adapter_load({**load, "shm": "/dev/shm/x"}):
+        problems.append(
+            "self-test lora load: validator accepted data AND shm"
+        )
+    if not validate_serve_adapter_load(
+        {**{k: v for k, v in load.items() if k != "data"},
+         "shm": "/x", "rank": 0}
+    ):
+        problems.append(
+            "self-test lora load: validator accepted rank 0"
+        )
+
+    block = {
+        "adapters": 8, "rank": 8, "requests": 16, "max_new_tokens": 16,
+        "tokens_per_sec": 300.0, "baseline_tokens_per_sec": 90.0,
+        "vs_baseline": 3.33, "fairness_spread": 1.0,
+        "recompiles_steady_state": 0,
+        "baseline_recompiles_steady_state": 0,
+        "greedy_parity": True, "hot_adds": 2, "pool_loads": 8,
+        "bgmv_impl": "xla", "completed": 16,
+    }
+    problems += validate_bench_multi_lora(
+        block, "self-test bench multi_lora"
+    )
+    if not validate_bench_multi_lora(
+        {k: v for k, v in block.items()
+         if k != "baseline_recompiles_steady_state"}
+    ):
+        problems.append(
+            "self-test multi_lora: validator accepted a block missing "
+            "the baseline recompile pin"
+        )
+    if not validate_bench_multi_lora({**block, "fairness_spread": -0.1}):
+        problems.append(
+            "self-test multi_lora: validator accepted a negative "
+            "fairness spread"
+        )
+    if not validate_bench_multi_lora({**block, "bgmv_impl": "magic"}):
+        problems.append(
+            "self-test multi_lora: validator accepted an unknown BGMV "
+            "arm"
+        )
     return problems
 
 
@@ -564,7 +668,7 @@ def _self_test_serve_disagg() -> list:
         beat_handle.put(make_hello_item(
             "decode", "r0", ("127.0.0.1", 1), num_slots=8, max_queue=64,
             spec_k=4, max_prompt_len=64, max_model_len=128,
-            block_size=16,
+            block_size=16, max_adapters=4,
         ))
         beat_handle.put(make_hello_item(
             "prefill", "p0", ("127.0.0.1", 2), max_prompt_len=64,
@@ -578,6 +682,7 @@ def _self_test_serve_disagg() -> list:
                                  "queue_depth": 0,
                                  "spec_acceptance_rate": 0.9}},
             recompiles=12,
+            adapters=["tenant0", "tenant1"],
         ))
         router.poll()
         beat_handle.close()
@@ -798,6 +903,12 @@ def scan_bench_files() -> list:
         trace = doc.get("trace") or (serve or {}).get("trace")
         if trace is not None:  # pre-tracing rounds lack it
             problems += validate_bench_trace(trace, f"{name}:trace")
+        multi_lora = (doc.get("multi_lora")
+                      or (serve or {}).get("multi_lora"))
+        if multi_lora is not None:  # pre-multi-tenant rounds lack it
+            problems += validate_bench_multi_lora(
+                multi_lora, f"{name}:multi_lora"
+            )
         mpmd = doc.get("mpmd")
         if mpmd is not None:  # pre-MPMD rounds lack it
             problems += validate_bench_mpmd(mpmd, f"{name}:mpmd")

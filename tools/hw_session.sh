@@ -79,6 +79,21 @@ log "serve A/B: request-tracing overhead, cheap tier on/off (trace block)"
 RLT_DISAGG_REPLICAS=0 timeout 1800 python bench_serve.py \
   2>&1 | tee "tools/hw_logs/${stamp}_bench_serve_trace.log"
 
+log "serve A/B: multi-tenant LoRA — Pallas BGMV vs XLA gather, multiplexed vs merge-and-swap (multi_lora block)"
+# Adapter-count sweep x BGMV-arm A/B on real chips: phase 7 runs the
+# N-tenant multiplexed pool against the merge-and-swap baseline with
+# recompile counters pinned 0 in both arms; RLT_LORA_BGMV forces the
+# kernel arm (pallas = scalar-prefetched per-row DMA of only the
+# selected adapter's factors; xla = gathered einsum fallback) so the
+# two logs isolate the kernel win at each tenant count.
+for n in 8 64; do
+  for impl in xla pallas; do
+    RLT_MAX_ADAPTERS=$n RLT_LORA_BGMV=$impl RLT_DISAGG_REPLICAS=0 \
+      timeout 2400 python bench_serve.py \
+      2>&1 | tee "tools/hw_logs/${stamp}_bench_serve_lora_n${n}_${impl}.log"
+  done
+done
+
 log "serve A/B: disaggregated fleet vs monolith (serve_disagg block)"
 # Replica-count sweep on real chips: each decode replica + prefill
 # worker owns its own device set, so (unlike the contended CPU arm)

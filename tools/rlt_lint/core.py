@@ -813,12 +813,22 @@ _HOT_JIT = {
         "ServeEngine._spec_tick", "ServeEngine._tick_widths",
         "ServeEngine._tick_top_ks", "ServeEngine._complete",
         "ServeEngine._handle_queue_request",
+        # Multi-LoRA hot paths: per-tick operand assembly and the
+        # queue-plane hot-add (the round-17 fresh-jit-per-request
+        # footgun must stay mechanically impossible here — the pool's
+        # ONE scatter program is built at AdapterPool.__init__).
+        "ServeEngine._lora_operands", "ServeEngine.add_adapter",
+        "ServeEngine._load_adapter_item",
+    }),
+    f"{_PKG}/serve/lora.py": frozenset({
+        "AdapterPool.add", "AdapterPool.remove", "AdapterPool.slot_of",
     }),
     f"{_PKG}/serve/dist/prefill.py": frozenset({
         "PrefillRunner.step", "PrefillRunner._process",
     }),
     f"{_PKG}/serve/dist/router.py": frozenset({
         "Router.submit_request", "Router._route",
+        "Router._ensure_adapter",
     }),
     f"{_PKG}/mpmd/stage.py": frozenset({
         "StageRunner._run_opt_step",
@@ -835,7 +845,7 @@ _HOT_JIT = {
 _HOT_SYNC = {
     f"{_PKG}/serve/engine.py": frozenset({
         "ServeEngine.step", "ServeEngine._decode_tick",
-        "ServeEngine._spec_tick",
+        "ServeEngine._spec_tick", "ServeEngine._lora_operands",
     }),
     f"{_PKG}/mpmd/stage.py": frozenset({
         "StageRunner._run_opt_step",
@@ -855,6 +865,7 @@ _SCHEMA_PRODUCERS = {
     f"{_PKG}/serve/dist/handoff.py": {
         "request_fields": "SERVE_REQUEST",
         "make_handoff_item": "SERVE_HANDOFF",
+        "make_adapter_load_item": "SERVE_ADAPTER_LOAD",
     },
 }
 

@@ -135,7 +135,31 @@ def _render_serve(serve: Dict[str, Any]) -> list:
                 for family, s in sorted(latency.items())
             )
         )
+    lines += _render_lora(serve)
     lines += _render_phases(serve)
+    return lines
+
+
+def _render_lora(serve: Dict[str, Any]) -> list:
+    """The multi-tenant LoRA pane (engines with an adapter pool):
+    pool occupancy, the fairness spread, and the busiest tenants'
+    lifetime token/completion counts."""
+    g = serve.get("gauges", {})
+    adapters = serve.get("adapters")
+    if not adapters and "lora_adapters_loaded" not in g:
+        return []
+    head = (f"lora:    {g.get('lora_adapters_loaded', 0):.0f} loaded"
+            f" ({g.get('lora_slots_free', 0):.0f} slots free)"
+            f"  fairness {g.get('lora_fairness_spread', 1.0):.2f}")
+    lines = [head]
+    if adapters:
+        top = sorted(adapters.items(),
+                     key=lambda kv: -kv[1].get("tokens_out", 0))[:6]
+        lines.append("         " + "  ".join(
+            f"{name} {entry.get('tokens_out', 0)}tok/"
+            f"{entry.get('completed', 0)}done"
+            for name, entry in top
+        ))
     return lines
 
 
@@ -183,7 +207,7 @@ def _render_router(router: Dict[str, Any]) -> list:
         f"{c.get('worker_deaths', 0)}"
         f"  respawns {c.get('prefill_respawns', 0)}",
         "replica  alive  inflight  slots      blocks   beat_age  "
-        "spec_acc",
+        "spec_acc  adapters",
     ]
     for r in router.get("replicas", []):
         slots = (f"{r.get('slots_active', 0):.0f}/"
@@ -200,13 +224,16 @@ def _render_router(router: Dict[str, Any]) -> list:
             + blocks.rjust(13)
             + _fmt(r.get("last_beat_age_s"), 11)
             + _fmt(None if acc is None else acc, 10)
+            + _fmt(r.get("adapters"), 10)
         )
     workers = router.get("workers", [])
     if workers:
         lines.append(
             "prefill: " + "  ".join(
                 f"{w.get('id')}[{'up' if w.get('alive') else 'DEAD'}"
-                f" pend {w.get('pending', 0)}]"
+                f" pend {w.get('pending', 0)}"
+                + (f" adp {w['adapters']}" if "adapters" in w else "")
+                + "]"
                 for w in workers
             )
         )
