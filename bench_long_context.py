@@ -131,6 +131,101 @@ def _one_in_subprocess(impl: str, S: int, B: int, H: int, D: int):
     return f"error: subprocess rc={proc.returncode}: {proc.stderr[-200:]}"
 
 
+def _chunked_prefill_block() -> dict:
+    """Serving-side long-context story: a long prompt admitted against
+    RESIDENT decode traffic through chunked prefill
+    (``ServeConfig.prefill_chunk``) — one fixed-width chunk per engine
+    step interleaved with the decode tick, so the long admission never
+    head-of-line-blocks in-flight streams.  CPU-runnable (tiny model;
+    the contract being measured is scheduling, not flops).  Emits the
+    schema-gated ``chunked_prefill`` block
+    (``validate_bench_chunked_prefill``): ``resident_max_stall_ticks``
+    is the max consecutive engine steps a resident slot went without
+    emitting while the long prompt chunked in — the no-stall bound
+    is 1.  ``RLT_PREFILL_CHUNK`` overrides the chunk width (the
+    ``tools/hw_session.sh`` width sweep: {512, 1024, 2048} on real
+    chips); the prompt and positional table scale with it so every
+    width measures the same 6-chunk admission shape."""
+    import os
+
+    import numpy as np
+
+    from ray_lightning_tpu.models.gpt import GPT, GPTConfig
+    from ray_lightning_tpu.serve.engine import ServeConfig, ServeEngine
+    from ray_lightning_tpu.serve.metrics import ServeStats
+    from ray_lightning_tpu.telemetry import compile_event_count
+
+    chunk = int(os.environ.get("RLT_PREFILL_CHUNK", "0") or 0) or 64
+    prompt_len = 6 * chunk
+    seq_len = max(512, 1 << (prompt_len + 128 - 1).bit_length())
+    cfg = GPTConfig(vocab_size=128, n_layer=2, n_head=4, d_model=64,
+                    seq_len=seq_len, warmup_steps=1)
+    module = GPT(cfg, attn_impl="xla")
+    params = module.init_params(jax.random.PRNGKey(0))
+    eng = ServeEngine(module, params, ServeConfig(
+        num_slots=4, block_size=16, prefill_chunk=chunk,
+    ))
+    rng = np.random.default_rng(3)
+
+    def _short():
+        return rng.integers(1, cfg.vocab_size, size=(24,)).tolist()
+
+    long_prompt = rng.integers(1, cfg.vocab_size,
+                               size=(prompt_len,)).tolist()
+    try:
+        # Warm every program the measured pass replays: the short-
+        # bucket prefill + decode, and the chunk program (a full
+        # chunked admission end to end).
+        eng.generate(_short(), 4)
+        eng.generate(rng.integers(1, cfg.vocab_size,
+                                  size=(prompt_len,)).tolist(), 4)
+        eng.stats = ServeStats()
+        before = compile_event_count()
+
+        emitted = {0: 0, 1: 0}
+        residents = [
+            eng.submit(_short(), 64,
+                       on_token=lambda idx, tok, i=i: emitted.__setitem__(
+                           i, emitted[i] + 1))
+            for i in (0, 1)
+        ]
+        while not all(emitted.values()):    # both resident + decoding
+            eng.step()
+        first_long = []
+        t_submit = time.perf_counter()
+        h_long = eng.submit(
+            long_prompt, 8,
+            on_token=lambda idx, tok: first_long.append(
+                time.perf_counter()),
+        )
+        # Drive until the long prompt's first token lands, tracking how
+        # many consecutive steps each resident went token-less.
+        stall, max_stall = {0: 0, 1: 0}, 0
+        while not first_long:
+            seen = dict(emitted)
+            eng.step()
+            for i in (0, 1):
+                stall[i] = 0 if emitted[i] > seen[i] else stall[i] + 1
+                max_stall = max(max_stall, stall[i])
+        ttft_ms = (first_long[0] - t_submit) * 1e3
+        eng.run_until_idle()
+        assert h_long.done() and all(h.done() for h in residents)
+        chunks = eng.stats.counters.get("prefill_chunks", 0)
+        recompiles = int(compile_event_count() - before)
+    finally:
+        eng.stop()
+    return {
+        "prompt_len": prompt_len,
+        "chunk_width": chunk,
+        "chunks": int(chunks),
+        "resident_requests": 2,
+        "resident_max_stall_ticks": int(max_stall),
+        "ttft_ms": round(ttft_ms, 2),
+        "tokens_per_sec": None,
+        "recompiles_steady_state": recompiles,
+    }
+
+
 def main() -> None:
     import sys
 
@@ -175,6 +270,31 @@ def main() -> None:
                 "xla": _one_in_subprocess("xla", S, B, H, D),
             }
         result["seq_sweep_fwd_bwd"] = sweep
+    # The serving-side long-context arm: chunked prefill vs resident
+    # decode traffic (schema-gated; fails the bench on a stall or a
+    # steady-state recompile).
+    from ray_lightning_tpu.telemetry.schema import (
+        validate_bench_chunked_prefill,
+    )
+
+    chunked = _chunked_prefill_block()
+    problems = validate_bench_chunked_prefill(chunked)
+    if chunked["resident_max_stall_ticks"] > 1:
+        problems.append(
+            f"chunked_prefill: resident stalled "
+            f"{chunked['resident_max_stall_ticks']} ticks — the "
+            "no-stall bound is 1 chunk tick"
+        )
+    if chunked["recompiles_steady_state"] != 0:
+        problems.append(
+            f"chunked_prefill: {chunked['recompiles_steady_state']} "
+            "steady-state recompile(s)"
+        )
+    if problems:
+        for p in problems:
+            sys.stderr.write(f"bench_long_context schema: {p}\n")
+        raise SystemExit(1)
+    result["chunked_prefill"] = chunked
     print(json.dumps(result))
     with open("BENCH_LONGCTX.json", "w") as f:
         json.dump(result, f, indent=1)

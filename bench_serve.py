@@ -67,6 +67,18 @@ token-for-token its merged baseline's (``greedy_parity``), and
 ``fairness_spread`` reports min/max lifetime tokens across tenants
 under the uniform offered load.
 
+An eighth phase benches **prefix-aware KV reuse** (the
+``prefix_cache`` block, ``validate_bench_prefix_cache``): a
+shared-system-prompt mix (every request is the same 6-block prefix
+plus a unique one-block tail, ``prefix_share`` ≈ 0.86) through a
+cache-on engine — resident prefix blocks claimed by refcount, only
+the unique tail prefilled through the suffix chunk program — A/B'd
+against the same mix on a cache-off engine.  Sequential closed loop
+(one request in flight), so the TTFT percentiles are the prefill path
+itself; acceptance is ``ttft_speedup`` ≥ 1.5x with bitwise token
+parity, a live hit-rate, and steady-state recompiles pinned at ZERO
+in both arms.  ``RLT_PREFIX_CACHE=0`` skips the phase.
+
 A fifth phase benches **disaggregated serving** (the ``serve_disagg``
 block, ``validate_bench_serve_disagg``): a real actor fleet —
 ``RLT_DISAGG_REPLICAS`` (default 2) decode replicas +
@@ -101,9 +113,9 @@ from ray_lightning_tpu.serve.engine import ServeConfig, ServeEngine
 from ray_lightning_tpu.serve.metrics import ServeStats
 from ray_lightning_tpu.telemetry import compile_event_count
 from ray_lightning_tpu.telemetry.schema import (
-    validate_bench_multi_lora, validate_bench_serve,
-    validate_bench_serve_disagg, validate_bench_spec_decode,
-    validate_bench_trace,
+    validate_bench_multi_lora, validate_bench_prefix_cache,
+    validate_bench_serve, validate_bench_serve_disagg,
+    validate_bench_spec_decode, validate_bench_trace,
 )
 
 PROMPT_LEN = 16
@@ -616,6 +628,126 @@ def _multi_lora_block(module, params, serve_cfg: ServeConfig) -> dict:
     }
 
 
+PREFIX_REQUESTS = 16
+PREFIX_MAX_NEW = 8
+PREFIX_SHARED_BLOCKS = 6    # shared system-prompt prefix, whole blocks
+PREFIX_UNIQUE_BLOCKS = 1    # per-request unique tail
+
+
+def _prefix_prompts(cfg, block_size: int, seed: int = 91,
+                    share_pct: int = 100) -> tuple:
+    """A shared-prefix request mix: ``share_pct``% of the prompts are
+    the SAME ``PREFIX_SHARED_BLOCKS``-block system prefix followed by
+    a unique one-block tail — the many-users-one-system-prompt shape
+    prefix caching exists for — and the rest are fully unique
+    same-length prompts (cache misses by construction).
+    ``RLT_PREFIX_SHARE`` sweeps this axis on hardware sessions.
+    Returns ``(prompts, prefix_share)`` with the share measured in
+    TOKENS across the whole mix."""
+    rng = np.random.default_rng(seed)
+    shared = rng.integers(
+        1, cfg.vocab_size, size=(PREFIX_SHARED_BLOCKS * block_size,)
+    ).tolist()
+    total = (PREFIX_SHARED_BLOCKS + PREFIX_UNIQUE_BLOCKS) * block_size
+    carriers = max(1, round(PREFIX_REQUESTS * share_pct / 100))
+    prompts = [
+        shared + rng.integers(
+            1, cfg.vocab_size,
+            size=(PREFIX_UNIQUE_BLOCKS * block_size,),
+        ).tolist()
+        if i < carriers else
+        rng.integers(1, cfg.vocab_size, size=(total,)).tolist()
+        for i in range(PREFIX_REQUESTS)
+    ]
+    share = carriers * len(shared) / (PREFIX_REQUESTS * total)
+    return prompts, share
+
+
+def _prefix_arm(module, params, serve_cfg: ServeConfig, prompts: list,
+                prefix_on: bool) -> dict:
+    """One sequential closed loop on a fresh engine (one request in
+    flight at a time, so TTFT is the prefill path and nothing else).
+    Warmup covers every program the arm uses — the full-bucket prefill
+    AND (cache arm) the suffix chunk program plus a resident chain —
+    then the recompile counter is pinned across the timed pass."""
+    eng = ServeEngine(module, params, ServeConfig(
+        num_slots=serve_cfg.num_slots, block_size=serve_cfg.block_size,
+        prefix_cache=prefix_on,
+    ))
+    try:
+        # Two warm requests sharing the mix's prefix: the first
+        # compiles the cold full-bucket prefill (and seeds the chain),
+        # the second compiles the claimed-suffix program on the cache
+        # arm.  Distinct tails keep them out of the measured set.
+        rng = np.random.default_rng(977)
+        tail = len(prompts[0]) - PREFIX_SHARED_BLOCKS * serve_cfg.block_size
+        for _ in range(2):
+            warm = prompts[0][: PREFIX_SHARED_BLOCKS
+                              * serve_cfg.block_size]
+            warm += rng.integers(1, module.config.vocab_size,
+                                 size=(tail,)).tolist()
+            eng.generate(warm, PREFIX_MAX_NEW)
+        eng.stats = ServeStats()
+        before = compile_event_count()
+        tokens = []
+        t0 = time.perf_counter()
+        for p in prompts:
+            h = eng.submit(p, PREFIX_MAX_NEW)
+            eng.run_until_idle()
+            tokens.append(h.result(0))
+        wall = time.perf_counter() - t0
+        recompiles = int(compile_event_count() - before)
+        snap = eng.snapshot()
+        return {
+            "tokens": tokens,
+            "wall_s": wall,
+            "tokens_per_sec": snap["counters"]["tokens_out"] / wall,
+            "ttft_p50_ms": _lat(snap, "ttft", "p50_ms"),
+            "recompiles": recompiles,
+            "prefix": snap.get("prefix"),
+            "prefill_chunks": snap["counters"].get("prefill_chunks", 0),
+        }
+    finally:
+        eng.stop()
+
+
+def _prefix_cache_block(module, params, serve_cfg: ServeConfig,
+                        cfg) -> dict:
+    """Phase 8: prefix-aware KV reuse A/B — the same shared-prefix mix
+    through a cache-on and a cache-off engine.  The cache arm claims
+    the resident prefix by refcount and prefills only the unique tail;
+    the headline is the TTFT win, with both arms' steady-state
+    recompile counters pinned and bitwise token parity required."""
+    share_pct = int(os.environ.get("RLT_PREFIX_SHARE", "100") or 100)
+    prompts, share = _prefix_prompts(cfg, serve_cfg.block_size,
+                                     share_pct=share_pct)
+    cached = _prefix_arm(module, params, serve_cfg, prompts, True)
+    baseline = _prefix_arm(module, params, serve_cfg, prompts, False)
+    pstats = cached["prefix"] or {}
+    return {
+        "prefix_share": round(share, 4),
+        "requests": PREFIX_REQUESTS,
+        "max_new_tokens": PREFIX_MAX_NEW,
+        "hit_rate": pstats.get("hit_rate", 0.0),
+        "blocks_claimed": int(pstats.get("blocks_claimed", 0)),
+        "blocks_inserted": int(pstats.get("blocks_inserted", 0)),
+        "cached_blocks": int(pstats.get("cached_blocks", 0)),
+        "prefill_chunks": int(cached["prefill_chunks"]),
+        "ttft_p50_ms": cached["ttft_p50_ms"],
+        "baseline_ttft_p50_ms": baseline["ttft_p50_ms"],
+        "ttft_speedup": round(
+            baseline["ttft_p50_ms"] / cached["ttft_p50_ms"], 3
+        ),
+        "tokens_per_sec": round(cached["tokens_per_sec"], 1),
+        "baseline_tokens_per_sec": round(
+            baseline["tokens_per_sec"], 1
+        ),
+        "recompiles_steady_state": cached["recompiles"],
+        "baseline_recompiles_steady_state": baseline["recompiles"],
+        "token_parity": cached["tokens"] == baseline["tokens"],
+    }
+
+
 TRACE_REQUESTS = 24
 TRACE_AB_REQUESTS = 24
 
@@ -831,6 +963,12 @@ def main() -> None:
     # Phase 7: multi-tenant LoRA multiplexed vs merge-and-swap A/B.
     multi_lora_block = _multi_lora_block(module, params, serve_cfg)
 
+    # Phase 8: prefix-aware KV reuse A/B (cache on vs off).
+    prefix_block = None
+    if os.environ.get("RLT_PREFIX_CACHE", "1") != "0":
+        prefix_block = _prefix_cache_block(module, params, serve_cfg,
+                                           cfg)
+
     problems = validate_bench_serve(serve_block)
     problems += validate_bench_spec_decode(spec_block)
     problems += validate_bench_trace(trace_block)
@@ -859,6 +997,37 @@ def main() -> None:
             f"trace: cheap-tier overhead {trace_block['overhead_pct']}% "
             "at or above the 2% bar"
         )
+    if prefix_block is not None:
+        problems += validate_bench_prefix_cache(prefix_block)
+        for arm in ("recompiles_steady_state",
+                    "baseline_recompiles_steady_state"):
+            if prefix_block[arm] != 0:
+                problems.append(
+                    f"prefix_cache: {arm} = {prefix_block[arm]} — "
+                    "claimed-prefix admissions must replay warmed "
+                    "programs in BOTH arms"
+                )
+        if not prefix_block["token_parity"]:
+            problems.append(
+                "prefix_cache: cached streams diverged from the "
+                "cache-off baseline — shared blocks are not "
+                "transparent"
+            )
+        if prefix_block["hit_rate"] <= 0.0:
+            problems.append(
+                "prefix_cache: hit_rate 0 under a shared-prefix mix — "
+                "the cache never matched"
+            )
+        # The TTFT bar holds for prefix-heavy mixes (the acceptance
+        # shape: >= 50% shared tokens); an RLT_PREFIX_SHARE sweep arm
+        # below that measures the hit-rate curve, not the headline.
+        if (prefix_block["prefix_share"] >= 0.5
+                and prefix_block["ttft_speedup"] < 1.5):
+            problems.append(
+                f"prefix_cache: ttft_speedup "
+                f"{prefix_block['ttft_speedup']} below the 1.5x bar "
+                f"at prefix_share {prefix_block['prefix_share']}"
+            )
     if disagg_block is not None:
         problems += validate_bench_serve_disagg(disagg_block)
         if disagg_block["chaos"]["lost_requests"]:
@@ -887,6 +1056,8 @@ def main() -> None:
     }
     if disagg_block is not None:
         out["serve_disagg"] = disagg_block
+    if prefix_block is not None:
+        out["prefix_cache"] = prefix_block
     print(json.dumps(out))
 
 

@@ -58,6 +58,13 @@ def main() -> None:
                         "tenants' adapters over ONE resident base "
                         "model (per-slot gathered application; any "
                         "tenant mix shares the compiled programs)")
+    parser.add_argument("--prefix-cache", action="store_true",
+                        help="prefix-aware KV reuse: serve a "
+                        "shared-prefix request mix through resident "
+                        "prompt chains — later requests claim the "
+                        "shared blocks by refcount bumps and prefill "
+                        "only their suffix (docs/SERVING.md § Prefix "
+                        "caching)")
     parser.add_argument("--trace", action="store_true",
                         help="request-scoped distributed tracing: "
                         "every component exports span JSONL into the "
@@ -116,7 +123,8 @@ def main() -> None:
     serve_cfg = ServeConfig(num_slots=args.num_slots, block_size=16,
                             spec_k=args.spec,
                             max_adapters=args.adapters,
-                            adapter_rank=4 if args.adapters else 0)
+                            adapter_rank=4 if args.adapters else 0,
+                            prefix_cache=args.prefix_cache)
     telemetry_dir = "rlt_logs/serve_example/telemetry"
     trace_dir = telemetry_dir if args.trace else None
     if trace_dir:
@@ -155,10 +163,17 @@ def main() -> None:
     try:
         rng = np.random.default_rng(0)
         tenant_names = sorted(adapters) if adapters else [None]
+        # With the prefix cache on, make the mix prefix-heavy (the
+        # production shape: one shared system prompt, per-request
+        # tails) so claims actually happen; otherwise fully random.
+        shared_head = (rng.integers(1, cfg.vocab_size,
+                                    size=(32,)).tolist()
+                       if args.prefix_cache else [])
         rids = [
             client.submit(
-                rng.integers(1, cfg.vocab_size,
-                             size=(int(rng.integers(4, 17)),)).tolist(),
+                shared_head + rng.integers(
+                    1, cfg.vocab_size,
+                    size=(int(rng.integers(4, 17)),)).tolist(),
                 args.max_new_tokens,
                 # Round-robin the tenants (None = the shared base
                 # model): any mix rides the same decode dispatches.
@@ -207,6 +222,12 @@ def main() -> None:
                       f"{snap['gauges']['spec_acceptance_rate']:.2f} "
                       f"drafted={snap['counters']['spec_drafted']} "
                       f"emitted={snap['counters']['spec_emitted']}")
+            if args.prefix_cache:
+                pb = snap.get("prefix", {})
+                print(f"prefix cache: hit_rate="
+                      f"{snap['gauges']['prefix_cache_hit_rate']:.2f} "
+                      f"claimed={pb.get('blocks_claimed', 0)} "
+                      f"resident={pb.get('cached_blocks', 0)} blocks")
             if args.adapters > 0:
                 # .get: the per-tenant block is lazily created on the
                 # first adapter-bearing emission (--requests 1 serves

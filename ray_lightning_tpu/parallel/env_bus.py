@@ -88,6 +88,9 @@ KNOBS: Tuple[EnvKnob, ...] = (
     EnvKnob("RLT_OPT_STATE_DTYPE", False, "bench opt-state arm"),
     EnvKnob("RLT_REMAT_POLICY", False, "bench remat arm"),
     EnvKnob("RLT_SPEC_K", False, "bench speculative width"),
+    EnvKnob("RLT_PREFIX_CACHE", False, "bench prefix-cache arm gate"),
+    EnvKnob("RLT_PREFIX_SHARE", False, "bench shared-prefix mix %"),
+    EnvKnob("RLT_PREFILL_CHUNK", False, "bench chunked-prefill width"),
     EnvKnob("RLT_DISAGG_REPLICAS", False, "bench fleet width"),
     EnvKnob("RLT_DISAGG_PREFILL", False, "bench prefill workers"),
     EnvKnob("RLT_MAX_ADAPTERS", False, "bench multi-LoRA tenant count"),

@@ -64,7 +64,7 @@ class PrefillRunner:
         from ray_lightning_tpu.cluster.queue import DriverQueue
         from ray_lightning_tpu.models.generate import _reject_unmerged_lora
         from ray_lightning_tpu.serve.kv_cache import (
-            PagedKVCache, paged_prefill,
+            PagedKVCache, PrefixIndex, paged_prefill, paged_verify_step,
         )
         from ray_lightning_tpu.serve.scheduler import derive_geometry
 
@@ -80,10 +80,20 @@ class PrefillRunner:
         )
         # The worker's pool only ever holds ONE in-flight prompt (the
         # dispatch loop is sequential): the largest bucket's blocks
-        # plus the reserved trash block.
+        # plus the reserved trash block.  With the prefix cache on, the
+        # pool also hosts RESIDENT chains between dispatches, so it is
+        # sized like an engine pool (cfg.num_blocks, or a few buckets'
+        # worth) — eviction, not sizing, handles the pressure.
+        blocks_per_bucket = self.buckets[-1] // serve_cfg.block_size
+        pool_blocks = blocks_per_bucket + 1
+        if getattr(serve_cfg, "prefix_cache", False):
+            pool_blocks = max(
+                pool_blocks,
+                getattr(serve_cfg, "num_blocks", None)
+                or 4 * blocks_per_bucket + 1,
+            )
         self.cache = PagedKVCache(
-            self.cfg, self.buckets[-1] // serve_cfg.block_size + 1,
-            serve_cfg.block_size, dtype=self._c,
+            self.cfg, pool_blocks, serve_cfg.block_size, dtype=self._c,
         )
         self._pool = self.cache.init_pool()
         cfg, c = self.cfg, self._c
@@ -112,6 +122,39 @@ class PrefillRunner:
 
         # One executable per bucket length, like the engine's set.
         self._prefill_fn = jax.jit(_prefill)
+
+        def _suffix(params, pool, table_row, start, tokens, limit,
+                    sample_idx, ad, ad_ids):
+            # Suffix-only prefill over claimed prefix blocks: the
+            # engine's chunk program minus the sampling tail (a prefill
+            # WORKER ships final-position logits, it never samples —
+            # the consuming replica's _first program does, bitwise the
+            # local path).  Window writes land at start + [0, T); the
+            # claimed frontier sits strictly below start, so resident
+            # chain blocks are read-only here.
+            logits, pool = paged_verify_step(
+                cfg, params, pool, table_row, start, tokens, limit,
+                compute_dtype=c, adapters=ad, adapter_ids=ad_ids,
+                lora_impl=lora_impl,
+            )
+            pick = jax.lax.dynamic_index_in_dim(
+                logits[0], sample_idx, axis=0, keepdims=False
+            )
+            return pick, pool
+
+        # One executable per suffix bucket width (the same bounded set
+        # the bucketed prefill compiles over).
+        self._suffix_fn = jax.jit(_suffix)
+        # Prefix-aware KV reuse on the worker: a dispatch whose prompt
+        # shares a resident whole-block prefix claims those blocks by
+        # refcount and computes ONLY the suffix — the export still
+        # covers the full bucket, so the handoff wire format (and the
+        # consuming replica) are unchanged.
+        self.prefix: Optional[PrefixIndex] = None
+        if getattr(serve_cfg, "prefix_cache", False):
+            self.prefix = PrefixIndex(
+                self.cache.allocator, serve_cfg.block_size
+            )
         self._inbox = DriverQueue()
         self._beat_handle = beat_handle
         self.beat_s = beat_s
@@ -128,6 +171,7 @@ class PrefillRunner:
         self._failed: List[Tuple[str, str]] = []  # guarded by self._feed_lock
         self._last_beat = 0.0
         self.prefills = 0
+        self.suffix_prefills = 0  # dispatches served over a claimed prefix
         # Distributed tracing: worker-side spans continue the router-
         # stamped request context (SpanTracer.start_remote), exported
         # at close for trace_collect.py to stitch.
@@ -238,7 +282,15 @@ class PrefillRunner:
                     "serve_adapter_load on a prefill worker without an "
                     "adapter pool (serve_cfg.max_adapters == 0)"
                 )
-            self.adapters.add(str(item["name"]), decode_adapter(item))
+            name = str(item["name"])
+            if self.prefix is not None:
+                # A hot-(re)load may replace the adapter's weights:
+                # chains prefilled through the old weights are stale.
+                # _process runs only on the work thread, so the drop
+                # needs no deferral (unlike the engine's step-drained
+                # queue).
+                self.prefix.drop(name)
+            self.adapters.add(name, decode_adapter(item))
             return
         if not (isinstance(item, dict)
                 and item.get("type") == "serve_prefill_dispatch"):
@@ -264,8 +316,32 @@ class PrefillRunner:
         prompt = [int(t) for t in req["prompt"]]
         bucket = next(b for b in self.buckets if b >= len(prompt))
         n_blocks = bucket // self.serve_cfg.block_size
-        ids = self.cache.allocator.alloc(n_blocks)
+        claimed: List[int] = []
+        if self.prefix is not None:
+            # Same cap as the engine's claim hook: the FINAL prompt
+            # token's block is always computed here — its forward
+            # produces the logits the handoff ships.
+            cap = (len(prompt) - 1) // self.serve_cfg.block_size
+            claimed = self.prefix.claim(adapter, prompt, cap)
+        start = len(claimed) * self.serve_cfg.block_size
+        ids = self.cache.allocator.alloc(n_blocks - len(claimed))
+        if ids is None and self.prefix is not None:
+            # Cache pressure: shed cold chains first, then (if this
+            # very claim pins too much) fall back to a full recompute
+            # with the cache flushed — never fail the dispatch.
+            self.prefix.evict(n_blocks - len(claimed))
+            ids = self.cache.allocator.alloc(n_blocks - len(claimed))
+            if ids is None:
+                if claimed:
+                    self.cache.allocator.free(claimed)
+                    claimed, start = [], 0
+                self.prefix.evict(n_blocks)
+                ids = self.cache.allocator.alloc(n_blocks)
+            if ids is None:
+                self.prefix.drop_all()
+                ids = self.cache.allocator.alloc(n_blocks)
         assert ids is not None, "worker pool sized for the largest bucket"
+        ids = list(claimed) + list(ids)
         req_ctx = None
         if self.tracer.enabled:
             from ray_lightning_tpu.telemetry.propagate import extract
@@ -274,19 +350,55 @@ class PrefillRunner:
         with self.tracer.start_remote(
                 req_ctx, "prefill_compute", rid=rid,
                 worker=self.worker_id, bucket=bucket) as pf_span:
+            ok = False
             try:
-                padded = np.zeros((bucket,), np.int32)
-                padded[: len(prompt)] = prompt
-                logits, self._pool = self._prefill_fn(
-                    self.params, self._pool, jnp.asarray(padded),
-                    np.int32(len(prompt)), jnp.asarray(np.asarray(ids,
-                                                                  np.int32)),
-                    ad, ad_id,
-                )
+                if start == 0:
+                    padded = np.zeros((bucket,), np.int32)
+                    padded[: len(prompt)] = prompt
+                    logits, self._pool = self._prefill_fn(
+                        self.params, self._pool, jnp.asarray(padded),
+                        np.int32(len(prompt)),
+                        jnp.asarray(np.asarray(ids, np.int32)),
+                        ad, ad_id,
+                    )
+                else:
+                    # Shared prefix resident: compute ONLY the suffix.
+                    suffix = len(prompt) - start
+                    width = next(b for b in self.buckets if b >= suffix)
+                    window = np.zeros((1, width), np.int32)
+                    window[0, :suffix] = prompt[start:]
+                    row = np.zeros(
+                        (1, self.buckets[-1]
+                         // self.serve_cfg.block_size), np.int32,
+                    )  # TRASH-padded past the prompt's blocks
+                    row[0, : len(ids)] = ids
+                    ad_ids = None if ad is None else jnp.asarray(
+                        [int(ad_id)], jnp.int32
+                    )
+                    logits, self._pool = self._suffix_fn(
+                        self.params, self._pool, jnp.asarray(row),
+                        jnp.asarray(np.full((1,), start, np.int32)),
+                        jnp.asarray(window),
+                        jnp.asarray(np.full((1,), len(prompt),
+                                            np.int32)),
+                        np.int32(suffix - 1), ad, ad_ids,
+                    )
+                    self.suffix_prefills += 1
                 # export_blocks device_gets the blocks, so the span
                 # closes on a SYNCED device — real prefill compute.
                 kv = self.cache.export_blocks(self._pool, ids)
+                ok = True
             finally:
+                if ok and self.prefix is not None:
+                    # Publish the whole-block prompt prefix; the index
+                    # retains the chain, so the free below only drops
+                    # THIS dispatch's handles and resident blocks
+                    # survive for the next sharing prompt to claim.
+                    n_full = len(prompt) // self.serve_cfg.block_size
+                    if n_full:
+                        self.prefix.insert(
+                            adapter, prompt, ids[:n_full]
+                        )
                 self.cache.allocator.free(ids)
         with self.tracer.start_remote(
                 pf_span.ctx, "handoff_send", rid=rid) as send_span:
@@ -382,6 +494,8 @@ class PrefillRunner:
     def close(self, consume_grace_s: float = 5.0) -> None:
         self._inbox.shutdown()
         self._out.close()
+        if self.prefix is not None:
+            self.prefix.drop_all()
         if self._trace_dir is not None and self.tracer.events():
             import os
 

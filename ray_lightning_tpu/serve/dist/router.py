@@ -76,7 +76,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from ray_lightning_tpu.serve.dist.handoff import (
@@ -199,8 +199,16 @@ class Router:
             "replica_deaths": 0, "worker_deaths": 0,
             "replica_drains": 0, "worker_drains": 0,
             "prefill_respawns": 0, "prefill_respawns_denied": 0,
-            "adapter_loads_sent": 0,
+            "adapter_loads_sent": 0, "prefix_affinity_hits": 0,
         }
+        # Prefix-affinity map: (adapter, leading-token) key -> the
+        # replica that last served a prompt with that prefix, so
+        # shared-prefix traffic lands where the resident chain lives
+        # (the replica-side PrefixIndex turns the affinity into claimed
+        # blocks).  Bounded LRU — placement metadata, never
+        # correctness: a stale or evicted entry just means one cold
+        # prefill.  guarded by self._lock
+        self._prefix_sticky: "OrderedDict[Any, str]" = OrderedDict()
         # Multi-tenant LoRA registry: name -> {"rank", "data"} (the
         # encode_adapter blob, encoded ONCE at registration) — the
         # source the router hot-loads members from on demand.
@@ -615,6 +623,27 @@ class Router:
         gauges = m.snapshot.get("gauges", {}) if m.snapshot else {}
         return float(gauges.get("blocks_free", 0.0))
 
+    # Leading tokens hashed into the affinity key: enough to
+    # distinguish system-prompt/template families, cheap enough to
+    # compute per route.
+    _PREFIX_KEY_TOKENS = 64
+    _PREFIX_STICKY_CAP = 4096
+
+    # rlt: holds self._lock
+    def _prefix_key(self, req: Dict[str, Any]) -> Optional[Any]:
+        prompt = req.get("prompt")
+        if not isinstance(prompt, list) or not prompt:
+            return None
+        return (req.get("adapter"),
+                hash(tuple(prompt[: self._PREFIX_KEY_TOKENS])))
+
+    # rlt: holds self._lock
+    def _note_prefix_sticky(self, key: Any, replica_id: str) -> None:
+        self._prefix_sticky[key] = replica_id
+        self._prefix_sticky.move_to_end(key)
+        while len(self._prefix_sticky) > self._PREFIX_STICKY_CAP:
+            self._prefix_sticky.popitem(last=False)
+
     # rlt: holds self._lock
     def _route(self, rid: str, track: _Track, now: float,
                exclude: Set[str] = frozenset(),
@@ -737,14 +766,38 @@ class Router:
         # draft cache and its queue position are warm.
         target = next((m for m in candidates if m.id == track.replica),
                       None)
+        pkey = self._prefix_key(req)
         if target is None:
+            # Prefix affinity: prefer the replica that last served this
+            # prompt family (its PrefixIndex holds the chain — the claim
+            # turns the placement into skipped prefill FLOPs), behind
+            # adapter residency (wrong-adapter placement costs a blob
+            # ship, worse than a cold prefill) and ahead of load
+            # balance (a cache hit is cheaper than an even spread).
+            # Affinity never QUEUES, though: once the warm replica's
+            # slots are full, waiting behind it costs more than a cold
+            # prefill on an idle one — drop the pull and let the
+            # least-loaded term place the request.
+            sticky = self._prefix_sticky.get(pkey) \
+                if pkey is not None else None
+            if sticky is not None:
+                sm = next((m for m in candidates if m.id == sticky),
+                          None)
+                if sm is None or (self._assigned(sm.id)
+                                  >= sm.caps.get("num_slots", 1)):
+                    sticky = None
             target = min(
                 candidates,
                 key=lambda m: (adapter is not None
                                and adapter not in m.adapters,
+                               sticky is not None and m.id != sticky,
                                self._assigned(m.id),
                                -self._blocks_free(m), m.id),
             )
+            if sticky is not None and target.id == sticky:
+                self.counters["prefix_affinity_hits"] += 1
+        if pkey is not None:
+            self._note_prefix_sticky(pkey, target.id)
         track.replica = target.id
         workers = [w for w in self._workers.values()
                    if w.alive and w.inbox is not None]
@@ -1124,7 +1177,8 @@ class Router:
                 }
                 for key in ("slots_active", "num_slots", "queue_depth",
                             "blocks_free", "num_blocks",
-                            "spec_acceptance_rate"):
+                            "spec_acceptance_rate",
+                            "prefix_cache_hit_rate"):
                     if key in gauges:
                         entry[key] = float(gauges[key])
                 if m.recompiles is not None:

@@ -97,6 +97,20 @@ class ServeConfig:
     # Requires draft_module/draft_params at engine build; per-request
     # ``spec=`` overrides downward (0 = plain target decode).
     spec_k: int = 0
+    # Prefix-aware KV reuse (kv_cache.PrefixIndex): resident prompt
+    # chains stay in the pool after their requests finish, and a new
+    # request's prefill skips every whole block it shares with one —
+    # the shared prefix is claimed by refcount bumps (zero device
+    # work), only the uncovered suffix is computed.  False keeps the
+    # engine byte-identical to pre-cache rounds.
+    prefix_cache: bool = False
+    # Chunked prefill width (tokens, a multiple of block_size): prompts
+    # whose uncovered suffix exceeds it are prefilled one fixed-width
+    # chunk per engine step, interleaved with decode ticks, so a long
+    # prompt never head-of-line-blocks the resident decode slots — and
+    # prompts past the largest prefill bucket become admissible (up to
+    # max_model_len).  None = whole-prompt bucketed prefill only.
+    prefill_chunk: Optional[int] = None
     # Sampling seed for temperature>0 requests.
     seed: int = 0
     # Background-thread idle sleep between polls when no work exists.
@@ -143,6 +157,18 @@ class ServeHandle:
                 f"request {self.rid} {self.request.done_reason}"
             )
         return list(self.request.generated)
+
+
+@dataclass
+class _PrefillJob:
+    """One chunked prefill in flight (engine-internal): the request,
+    its private block-table row (the scheduler row stays trashed until
+    the last chunk lands), and the first prompt position not yet
+    written."""
+
+    req: Any
+    row: Any
+    next_pos: int
 
 
 class ServeEngine:
@@ -274,6 +300,50 @@ class ServeEngine:
             blocks_per_seq, buckets, max_queue=cfg.max_queue,
             max_queue_per_adapter=cfg.max_queue_per_adapter,
         )
+        # Prefix-aware KV reuse + chunked prefill (docs/SERVING.md
+        # "Prefix caching & chunked prefill").  All host-side wiring:
+        # the claim hands the scheduler refcount-bumped block ids, the
+        # reclaim hook lets pool pressure evict resident chains before
+        # any running request is preempted, and chunk_width routes
+        # long-suffix admissions to exact block coverage.
+        self._chunk = None
+        if cfg.prefill_chunk is not None:
+            self._chunk = int(cfg.prefill_chunk)
+            if self._chunk < cfg.block_size \
+                    or self._chunk % cfg.block_size:
+                raise ValueError(
+                    f"prefill_chunk {cfg.prefill_chunk} must be a "
+                    f"positive multiple of block_size {cfg.block_size}"
+                )
+            if self._chunk > self.max_model_len:
+                raise ValueError(
+                    f"prefill_chunk {cfg.prefill_chunk} exceeds "
+                    f"max_model_len {self.max_model_len}"
+                )
+            self.scheduler.chunk_width = self._chunk
+        self.prefix_cache = None
+        if cfg.prefix_cache:
+            from ray_lightning_tpu.serve.kv_cache import PrefixIndex
+
+            self.prefix_cache = PrefixIndex(
+                self.cache.allocator, cfg.block_size
+            )
+            self.scheduler.claim_fn = self._claim_prefix
+            self.scheduler.reclaim = self.prefix_cache.evict
+        # In-flight chunked prefills, keyed by slot.  While a job runs,
+        # the slot's scheduler row points at the trash block and its
+        # seq_len is 0 — the decode program treats it exactly like an
+        # inactive slot (writes trashed, sampled token ignored), so the
+        # job needs no change to the compiled decode graph.
+        self._chunk_jobs: Dict[int, "_PrefillJob"] = {}
+        # Adapter names whose cached chains must be dropped before the
+        # next admission poll: add/remove_adapter run on OTHER threads,
+        # and every PrefixIndex mutation belongs to the serve thread —
+        # so they queue the invalidation here (under self._lock) and
+        # step() drains it under the SAME lock hold as poll(), which
+        # orders the drop strictly before any claim against the new
+        # factors.
+        self._prefix_drops: List[str] = []
         self.stats = ServeStats()
         self._pool = self.cache.init_pool()
         self._draft_pool = None
@@ -419,6 +489,40 @@ class ServeEngine:
         )
         self._first_fn = jax.jit(_first)
 
+        def _chunk_prefill(params, pool, table_row, start, tokens, limit,
+                           sample_idx, temp, seed, top_k, ad, ad_ids):
+            # One prompt chunk through the verify program at W=1: the
+            # window writes k/v at positions start + [0, Tc) into the
+            # slot's blocks (write_limit trashes the padding tail) and
+            # attends under the same causal frontier the bucketed
+            # prefill enforces — so a prompt computed suffix-only over
+            # claimed prefix blocks, or chunk by chunk, fills the cache
+            # with the same values.  ``sample_idx`` picks the window
+            # position whose logits produce the first token (the final
+            # chunk passes prompt_len - 1 - start; earlier chunks pass
+            # 0 and ignore the token) with the request's position-keyed
+            # stream — bitwise the tail of _prefill.
+            logits, pool = paged_verify_step(
+                cfg, params, pool, table_row, start, tokens, limit,
+                compute_dtype=c, adapters=ad, adapter_ids=ad_ids,
+                lora_impl=lora_impl,
+            )
+            pick = jax.lax.dynamic_index_in_dim(
+                logits[0], sample_idx, axis=0, keepdims=False
+            )
+            keys = make_slot_keys(
+                base_key, seed[None], (start[0] + sample_idx)[None]
+            )
+            tok = sample_tokens(
+                pick[None], keys, temp[None], top_k[None]
+            )[0]
+            return tok, pool
+
+        # Compiled per chunk width: the fixed prefill_chunk width for
+        # jobs plus one per bucket used by inline suffix computes — a
+        # bounded set, warmed on first use like the prefill buckets.
+        self._chunk_fn = jax.jit(_chunk_prefill, donate_argnums=donate)
+
         if self.draft_module is None:
             return
         dcfg, dc = self.draft_module.config, self._draft_c
@@ -465,8 +569,20 @@ class ServeEngine:
             )
             return sampled.reshape(W, T), pool
 
+        def _draft_chunk(dparams, dpool, table_row, start, tokens, limit):
+            # The draft-pool mirror of _chunk_prefill: same window, same
+            # blocks (the draft cache shares the slot block tables), so
+            # a claimed/chunked admission leaves the draft frontier
+            # exactly where a bucketed _draft_prefill would have.
+            _, dpool = paged_verify_step(
+                dcfg, dparams, dpool, table_row, start, tokens, limit,
+                compute_dtype=dc,
+            )
+            return dpool
+
         self._draft_prefill_fn = jax.jit(_draft_prefill, donate_argnums=donate)
         self._draft_step_fn = jax.jit(_draft_step, donate_argnums=donate)
+        self._draft_chunk_fn = jax.jit(_draft_chunk, donate_argnums=donate)
         self._verify_fn = jax.jit(_verify, donate_argnums=donate)
         self._spec_width = K + 1
 
@@ -552,11 +668,12 @@ class ServeEngine:
                 f"({max_new_tokens}) exceeds max_model_len "
                 f"({self.max_model_len})"
             )
-        if len(prompt) > self.max_prompt_len:
+        if len(prompt) > self.max_prompt_len and self._chunk is None:
             raise ValueError(
                 f"prompt ({len(prompt)}) exceeds the largest prefill "
                 f"bucket ({self.max_prompt_len}); raise max_model_len "
-                f"to a multiple of block_size or pass prefill_buckets"
+                f"to a multiple of block_size, pass prefill_buckets, "
+                f"or enable chunked prefill (ServeConfig.prefill_chunk)"
             )
         if any(not 0 <= t < self.cfg.vocab_size for t in prompt):
             raise ValueError("prompt token outside the vocab")
@@ -630,6 +747,13 @@ class ServeEngine:
 
         self._drain_inbox()
         with self._lock:
+            if self.prefix_cache is not None and self._prefix_drops:
+                # Invalidate replaced/removed tenants' chains BEFORE
+                # admitting: adapter-keyed KV must never be claimed
+                # against different factors than wrote it.
+                for name in self._prefix_drops:
+                    self.prefix_cache.drop(name)
+                self._prefix_drops.clear()
             admissions, expired = self.scheduler.poll()
         worked = bool(admissions) or bool(expired)
         for req in expired:
@@ -648,24 +772,40 @@ class ServeEngine:
                                     preemptions=req.preemptions),
                 )
                 self.stats.note_phase("queue_wait", wait)
-            ids = np.asarray(  # rlt: noqa[RLT002] host block list, no device value
-                self.scheduler._blocks[slot][: bucket
-                                             // self.config.block_size],
-                np.int32,
-            )
-            ids = jnp.asarray(ids)
-            handoff = getattr(req, "_handoff", None)
-            padded = None
-            if handoff is None or self.draft_module is not None:
-                # The padded prompt feeds the local prefill and/or the
-                # draft prefill; a KV import on a draft-less engine —
-                # the disaggregated steady state — needs neither, so
-                # skip the bucket-sized host→device copy entirely.
-                padded_np = np.zeros((bucket,), np.int32)
-                padded_np[: req.prompt_len] = req.prompt
-                padded = jnp.asarray(padded_np)
-            t_ph = time.time() if ctx is not None else 0.0
-            if handoff is not None:
+            if bucket == 0:
+                # Prefix-claimed and/or chunked admission (exact block
+                # coverage, no bucket padding): the uncovered suffix
+                # runs through the fixed-width chunk program — inline
+                # when it fits one dispatch, one chunk per step
+                # (interleaved with decode ticks) otherwise.
+                suffix_len = req.prompt_len - req.claimed_tokens
+                if self._chunk is not None and suffix_len > self._chunk:
+                    self._start_chunk_job(slot, req)
+                    continue
+                handoff = None
+                self.stats.bump("prefills")
+                t_ph = time.time() if ctx is not None else 0.0
+                first = self._suffix_prefill(slot, req)
+            else:
+                ids = np.asarray(  # rlt: noqa[RLT002] host block list, no device value
+                    self.scheduler._blocks[slot][: bucket
+                                                 // self.config.block_size],
+                    np.int32,
+                )
+                ids = jnp.asarray(ids)
+                handoff = getattr(req, "_handoff", None)
+                padded = None
+                if handoff is None or self.draft_module is not None:
+                    # The padded prompt feeds the local prefill and/or
+                    # the draft prefill; a KV import on a draft-less
+                    # engine — the disaggregated steady state — needs
+                    # neither, so skip the bucket-sized host→device
+                    # copy entirely.
+                    padded_np = np.zeros((bucket,), np.int32)
+                    padded_np[: req.prompt_len] = req.prompt
+                    padded = jnp.asarray(padded_np)
+                t_ph = time.time() if ctx is not None else 0.0
+            if bucket != 0 and handoff is not None:
                 # A prefill worker already ran this prompt: scatter its
                 # exported blocks into OUR allocator's blocks and
                 # sample the first token from the shipped logits —
@@ -684,7 +824,7 @@ class ServeEngine:
                     np.float32(req.temperature),
                     np.int32(req.sample_seed), np.int32(req.top_k or 0),
                 )
-            else:
+            elif bucket != 0:
                 self.stats.bump("prefills")
                 ad = None if self.adapters is None \
                     else self.adapters.buffers
@@ -698,7 +838,7 @@ class ServeEngine:
                     np.int32(req.top_k or 0),
                     ad, ad_id,
                 )
-            if self.draft_module is not None:
+            if bucket != 0 and self.draft_module is not None:
                 # The draft cache tracks every admission (one bucketed
                 # draft-prefill program per bucket) so any later tick
                 # can speculate for this slot.
@@ -730,8 +870,17 @@ class ServeEngine:
             if req.adapter is not None:
                 self.stats.note_adapter(req.adapter, tokens=1)
             self._cur_tokens[slot] = first
+            if self.prefix_cache is not None:
+                self._prefix_insert(slot, req)
             if done:
                 self._complete(slot)
+
+        # One chunk for every in-flight chunked prefill BEFORE the
+        # decode tick: both dispatches queue on the device each step,
+        # so resident slots keep emitting one token per step while a
+        # long prompt fills in chunk by chunk (the no-stall contract).
+        if self._chunk_jobs:
+            worked = self._chunk_tick() or worked
 
         # Per-slot speculative widths for THIS tick: the engine K,
         # capped per request (spec= knob) and by the tokens it has left
@@ -750,7 +899,8 @@ class ServeEngine:
         # request its progress (two spec slots preempting each other's
         # windows would ping-pong without forward progress).
         active = [
-            s for s, r in enumerate(self.scheduler.slots) if r is not None
+            s for s, r in enumerate(self.scheduler.slots)
+            if r is not None and s not in self._chunk_jobs
         ]
         for slot in list(active):
             if self.scheduler.slots[slot] is None:
@@ -778,7 +928,8 @@ class ServeEngine:
             widths[slot] = w
 
         active = [
-            s for s, r in enumerate(self.scheduler.slots) if r is not None
+            s for s, r in enumerate(self.scheduler.slots)
+            if r is not None and s not in self._chunk_jobs
         ]
         if active:
             worked = True
@@ -796,7 +947,7 @@ class ServeEngine:
         if self.spec_k == 0:
             return widths
         for slot, req in enumerate(self.scheduler.slots):
-            if req is None:
+            if req is None or slot in self._chunk_jobs:
                 continue
             k = self.spec_k if req.spec is None else min(
                 req.spec, self.spec_k
@@ -833,6 +984,169 @@ class ServeEngine:
         if not np.any(self.scheduler.top_ks > 0):
             return None
         return jnp.asarray(self.scheduler.top_ks)
+
+    # -- prefix cache + chunked prefill -------------------------------------
+    def _claim_prefix(self, req) -> List[int]:
+        """Scheduler claim hook: refcount-claim the resident blocks
+        covering the longest whole-block shared prefix of ``req``'s
+        prompt.  The cap ``(prompt_len - 1) // Bs`` keeps the FINAL
+        prompt token's block always computed locally — its forward
+        produces the first-token logits, and every later write (decode,
+        verify window, chunk) lands strictly PAST the claimed frontier,
+        which is why claimed blocks never need copy-on-write in nominal
+        serving (``Scheduler.cow_slot`` stays a defensive escape
+        hatch).  Handoff admissions never claim: the wire payload
+        covers the whole prompt and must scatter into private blocks."""
+        if getattr(req, "_handoff", None) is not None:
+            return []
+        cap = (req.prompt_len - 1) // self.config.block_size
+        return self.prefix_cache.claim(req.adapter, req.prompt, cap)
+
+    def _suffix_prefill(self, slot: int, req) -> Any:
+        """Prefill the uncovered suffix of a claimed (or
+        short-chunkable) admission in ONE chunk-program dispatch and
+        return the (device) first token.  The window width is the
+        smallest prefill bucket covering the suffix — re-using the
+        bucketed shape set — or the fixed chunk width for suffixes past
+        the largest bucket, so the executable set stays bounded."""
+        import jax.numpy as jnp
+
+        sched = self.scheduler
+        start = req.claimed_tokens
+        suffix = req.prompt_len - start
+        width = next(
+            (b for b in sched.buckets if b >= suffix), self._chunk
+        )
+        window = np.zeros((1, width), np.int32)
+        window[0, :suffix] = req.prompt[start:]
+        table_row = jnp.asarray(sched.block_tables[slot: slot + 1])
+        start_arr = jnp.asarray(np.full((1,), start, np.int32))
+        limit = jnp.asarray(np.full((1,), req.prompt_len, np.int32))
+        tokens = jnp.asarray(window)
+        ad = None if self.adapters is None else self.adapters.buffers
+        ad_ids = None if self.adapters is None else jnp.asarray(
+            [req._adapter_slot], jnp.int32
+        )
+        tok, self._pool = self._chunk_fn(
+            self.params, self._pool, table_row, start_arr, tokens,
+            limit, np.int32(suffix - 1), np.float32(req.temperature),
+            np.int32(req.sample_seed), np.int32(req.top_k or 0),
+            ad, ad_ids,
+        )
+        if self.draft_module is not None:
+            self._draft_pool = self._draft_chunk_fn(
+                self.draft_params, self._draft_pool, table_row,
+                start_arr, tokens, limit,
+            )
+        self.stats.bump("prefill_chunks")
+        return tok
+
+    def _start_chunk_job(self, slot: int, req) -> None:
+        """Begin a chunked prefill: park the slot OUT of the decode set
+        (scheduler row trashed, seq_len 0 — the compiled decode program
+        treats it exactly like an inactive slot) and remember its real
+        block-table row privately.  One chunk advances per engine step,
+        interleaved with decode ticks, so resident decode slots keep
+        emitting while a 32k prompt fills in."""
+        from ray_lightning_tpu.serve.kv_cache import TRASH_BLOCK
+
+        sched = self.scheduler
+        row = sched.block_tables[slot].copy()
+        sched.block_tables[slot, :] = TRASH_BLOCK
+        sched.seq_lens[slot] = 0
+        sched.draft_lens[slot] = 0
+        self.stats.bump("prefills")
+        self._chunk_jobs[slot] = _PrefillJob(
+            req=req, row=row, next_pos=req.claimed_tokens
+        )
+
+    def _chunk_tick(self) -> bool:
+        """Advance every in-flight chunked prefill by exactly ONE chunk
+        (the no-stall contract: a long prompt costs resident decode
+        slots one chunk dispatch per step, never the whole prefill).
+        The final chunk samples the first token (bitwise the tail of
+        the bucketed prefill), restores the slot's scheduler row, and
+        hands the request to the ordinary decode path."""
+        import jax.numpy as jnp
+
+        if not self._chunk_jobs:
+            return False
+        sched = self.scheduler
+        worked = False
+        for slot, job in list(self._chunk_jobs.items()):
+            if sched.slots[slot] is not job.req:
+                # The request was preempted (or force-finished) out
+                # from under the job: its blocks are already freed and
+                # a requeued re-admission restarts cleanly, so the
+                # stale job is simply dropped.
+                del self._chunk_jobs[slot]
+                continue
+            req = job.req
+            start = job.next_pos
+            width = self._chunk
+            end = min(start + width, req.prompt_len)
+            last = end == req.prompt_len
+            window = np.zeros((1, width), np.int32)
+            window[0, : end - start] = req.prompt[start:end]
+            table_row = jnp.asarray(job.row[None, :])
+            start_arr = jnp.asarray(np.full((1,), start, np.int32))
+            limit = jnp.asarray(np.full((1,), end, np.int32))
+            sample_idx = np.int32(
+                (req.prompt_len - 1 - start) if last else 0
+            )
+            tokens = jnp.asarray(window)
+            ad = None if self.adapters is None else self.adapters.buffers
+            ad_ids = None if self.adapters is None else jnp.asarray(
+                [req._adapter_slot], jnp.int32
+            )
+            tok, self._pool = self._chunk_fn(
+                self.params, self._pool, table_row, start_arr, tokens,
+                limit, sample_idx, np.float32(req.temperature),
+                np.int32(req.sample_seed), np.int32(req.top_k or 0),
+                ad, ad_ids,
+            )
+            if self.draft_module is not None:
+                self._draft_pool = self._draft_chunk_fn(
+                    self.draft_params, self._draft_pool, table_row,
+                    start_arr, tokens, limit,
+                )
+            self.stats.bump("prefill_chunks")
+            job.next_pos = end
+            worked = True
+            if not last:
+                continue
+            # Final chunk landed: the private row goes live and the
+            # slot joins the fixed-width decode set next tick.
+            del self._chunk_jobs[slot]
+            first = int(tok)  # rlt: noqa[RLT002] deliberate TTFT sync at admission
+            sched.block_tables[slot, :] = job.row
+            sched.seq_lens[slot] = req.prompt_len
+            sched.draft_lens[slot] = req.prompt_len
+            t_first = time.monotonic()
+            self.stats.note_first_token(t_first - req.arrival_t)
+            done = sched.append_token(slot, first, now=t_first)
+            self.stats.bump("tokens_out")
+            if req.adapter is not None:
+                self.stats.note_adapter(req.adapter, tokens=1)
+            self._cur_tokens[slot] = first
+            if self.prefix_cache is not None:
+                self._prefix_insert(slot, req)
+            if done:
+                self._complete(slot)
+        return worked
+
+    def _prefix_insert(self, slot: int, req) -> None:
+        """Publish the slot's whole-block prompt prefix into the
+        cache.  Claimed blocks just re-match during the walk (nothing
+        re-stored); freshly computed full blocks are retained by the
+        index, so they survive the request's release and the NEXT
+        prompt sharing them claims instead of recomputing."""
+        n = req.prompt_len // self.config.block_size
+        if n == 0:
+            return
+        self.prefix_cache.insert(
+            req.adapter, req.prompt, self.scheduler._blocks[slot][:n]
+        )
 
     def _decode_tick(self, active: List[int]) -> None:
         """One token for every active slot — the non-speculative path
@@ -1012,6 +1326,19 @@ class ServeEngine:
         raise RuntimeError(f"still busy after {max_steps} serve steps")
 
     def _complete(self, slot: int) -> None:
+        if self.prefix_cache is not None:
+            # Keep the FINISHED chain resident too — prompt plus every
+            # generated token whose KV was actually written (the final
+            # sampled token never was: seq_lens stops one short of it).
+            # A follow-up turn that extends this conversation claims
+            # the whole chain instead of re-prefilling it.
+            req = self.scheduler.slots[slot]
+            toks = req.prompt + req.generated[:-1]
+            n = len(toks) // self.config.block_size
+            if n:
+                self.prefix_cache.insert(
+                    req.adapter, toks, self.scheduler._blocks[slot][:n]
+                )
         req = self.scheduler.finish(slot)
         e2e = req.finished_t - req.arrival_t
         self.stats.note_completed(e2e)
@@ -1060,6 +1387,11 @@ class ServeEngine:
                     f"their model mid-stream; drain the tenant first"
                 )
             slot = self.adapters.add(name, adapter)
+            if self.prefix_cache is not None:
+                # Adapter-keyed chains carry adapter-specific KV: a
+                # replace means the resident chain no longer matches
+                # the factors a future claim would decode through.
+                self._prefix_drops.append(name)
         self.stats.bump("adapter_loads")
         return slot
 
@@ -1079,6 +1411,8 @@ class ServeEngine:
                     f"requests — drain the tenant before removing it"
                 )
             self.adapters.remove(name)
+            if self.prefix_cache is not None:
+                self._prefix_drops.append(name)
         self.stats.bump("adapter_unloads")
 
     def adapter_names(self) -> List[str]:
@@ -1152,6 +1486,8 @@ class ServeEngine:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
+        if self.prefix_cache is not None:
+            self.prefix_cache.drop_all()
         if self._inbox is not None:
             self._inbox.shutdown()
             self._inbox = None
@@ -1421,6 +1757,20 @@ class ServeEngine:
             # keeps this near 1 under uniform per-tenant load).
             gauges["lora_fairness_spread"] = (
                 min(counts) / max(counts) if len(counts) > 1 else 1.0
+            )
+        if self.prefix_cache is not None:
+            ps = self.prefix_cache.stats()
+            hit_rate = (ps["hits"] / ps["lookups"]) if ps["lookups"] \
+                else 0.0
+            gauges["prefix_cache_hit_rate"] = hit_rate
+            gauges["prefix_cached_blocks"] = ps["cached_blocks"]
+            self.stats.set_prefix(
+                hit_rate=hit_rate, lookups=ps["lookups"],
+                hits=ps["hits"],
+                blocks_claimed=ps["blocks_claimed"],
+                blocks_inserted=ps["blocks_inserted"],
+                blocks_evicted=ps["blocks_evicted"],
+                cached_blocks=ps["cached_blocks"],
             )
         if self.spec_k > 0:
             counters = self.stats.counters

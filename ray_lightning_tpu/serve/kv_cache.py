@@ -58,6 +58,7 @@ from ray_lightning_tpu.ops.lora import apply_lora
 __all__ = [
     "BlockAllocator",
     "PagedKVCache",
+    "PrefixIndex",
     "paged_prefill",
     "paged_decode_step",
     "paged_verify_step",
@@ -66,6 +67,7 @@ __all__ = [
     "extend_block_coverage",
     "truncate_to",
     "import_blocks",
+    "copy_blocks",
 ]
 
 # Physical block 0 is never allocated: it is the write target for
@@ -75,12 +77,22 @@ TRASH_BLOCK = 0
 
 
 class BlockAllocator:
-    """Host-side free list over the physical block pool.
+    """Host-side free list over the physical block pool, with per-block
+    reference counts.
 
     jax-free and O(1) per op.  Double-free and foreign-id frees raise —
     a scheduler bug that silently re-issued a live block would corrupt
     another request's cache, the one failure mode a serving cache must
     never shrug off.
+
+    Refcounts are the sharing substrate of the prefix cache: a freshly
+    allocated block carries one reference (its owning chain);
+    :meth:`retain` hands the SAME physical block to another holder
+    (another request's block table, or the resident
+    :class:`PrefixIndex`), and :meth:`free` becomes decrement-release —
+    the block returns to the free list only when its LAST holder drops
+    it.  Every holder frees through the same call, so no caller needs
+    to know whether it was the last one.
     """
 
     def __init__(self, num_blocks: int):
@@ -93,7 +105,7 @@ class BlockAllocator:
         # LIFO free list: recently-freed blocks are re-issued first
         # (their pool pages are the warmest).
         self._free: List[int] = list(range(num_blocks - 1, TRASH_BLOCK, -1))
-        self._live: set = set()
+        self._refs: Dict[int, int] = {}
 
     @property
     def free_blocks(self) -> int:
@@ -101,7 +113,16 @@ class BlockAllocator:
 
     @property
     def live_blocks(self) -> int:
-        return len(self._live)
+        return len(self._refs)
+
+    def refcount(self, b: int) -> int:
+        """Holders of physical block ``b`` (0 = not live)."""
+        return self._refs.get(b, 0)
+
+    def is_shared(self, b: int) -> bool:
+        """True when more than one holder references ``b`` — the block
+        is read-only to every holder until copy-on-write or release."""
+        return self._refs.get(b, 0) > 1
 
     def alloc(self, n: int) -> Optional[List[int]]:
         """``n`` physical block ids, or ``None`` (all-or-nothing) when
@@ -111,18 +132,34 @@ class BlockAllocator:
         if n > len(self._free):
             return None
         ids = [self._free.pop() for _ in range(n)]
-        self._live.update(ids)
+        for b in ids:
+            self._refs[b] = 1
         return ids
+
+    def retain(self, ids) -> None:
+        """Bump the refcount of live blocks ``ids`` — the claim half of
+        prefix sharing (zero device work: the new holder just points
+        its block table at the same physical blocks)."""
+        for b in ids:
+            if b not in self._refs:
+                raise RuntimeError(
+                    f"retain of block {b} which is not live — a chain "
+                    f"cannot share blocks nobody owns"
+                )
+        for b in ids:
+            self._refs[b] += 1
 
     def free(self, ids) -> None:
         for b in ids:
-            if b not in self._live:
+            if b not in self._refs:
                 raise RuntimeError(
                     f"free of block {b} which is not live (double-free "
                     f"or foreign id) — scheduler bookkeeping bug"
                 )
-            self._live.discard(b)
-            self._free.append(b)
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                del self._refs[b]
+                self._free.append(b)
 
 
 def extend_block_coverage(
@@ -256,6 +293,271 @@ def import_blocks(
         )
         for key in ("k", "v")
     }
+
+
+def copy_blocks(
+    pool: Dict[str, jax.Array],
+    src_ids: jax.Array,
+    dst_ids: jax.Array,
+) -> Dict[str, jax.Array]:
+    """Copy the k/v content of ``src_ids`` into ``dst_ids`` — the
+    copy-on-write primitive of the shared-block discipline (jittable;
+    one fixed-width program per COW fan-out, compiled like the import
+    set).
+
+    A holder about to WRITE into a block whose refcount is > 1 must not
+    (the other holders' caches would change under them): it allocates
+    fresh blocks, copies the shared content here, swaps its block-table
+    entries to the copies, and drops its references on the originals.
+    The admission-time claim cap (the last prompt token is always
+    recomputed, so every decode/verify/suffix write lands strictly past
+    the shared frontier) means the serving plane never hits this in
+    nominal flow — COW is the safety net that keeps the invariant
+    locally checkable rather than globally assumed.
+    """
+    return {
+        key: pool[key].at[:, dst_ids].set(pool[key][:, src_ids])
+        for key in ("k", "v")
+    }
+
+
+class _ChainNode:
+    """One radix-tree edge: a run of whole blocks with no branch."""
+
+    __slots__ = ("keys", "ids", "children", "parent", "stamp")
+
+    def __init__(self, keys, ids, parent, stamp):
+        self.keys: List[Tuple[int, ...]] = keys   # per-block token tuples
+        self.ids: List[int] = ids                 # physical block ids
+        self.children: Dict[Tuple[int, ...], "_ChainNode"] = {}
+        self.parent: Optional["_ChainNode"] = parent
+        self.stamp = stamp
+
+
+class PrefixIndex:
+    """Radix tree of resident KV block chains, keyed by prompt tokens.
+
+    The prefix cache of the serving plane: after a prompt is prefilled,
+    its FULL blocks (every block whose ``block_size`` tokens were all
+    written — the partial tail block keeps growing under decode and is
+    never indexed) are inserted as a chain, and the index RETAINS a
+    reference on each, so the chain stays resident after the request
+    finishes.  A later request claims its longest whole-block shared
+    prefix with :meth:`claim` — refcount bumps only, zero device work —
+    and prefills just the uncovered suffix.
+
+    Granularity is the block, deliberately: a physical block either
+    holds exactly the claimed tokens' KV or it is not claimed, so
+    sharing never needs sub-block copies, and the radix edges are runs
+    of ``(tokens-per-block,)`` tuples compared whole.  Chains are keyed
+    per ``key`` (the adapter name, or ``None`` for the base model),
+    because adapter-bearing prefill writes adapter-specific KV — one
+    tenant's chain must never satisfy another's lookup.
+
+    Eviction (:meth:`evict`) walks least-recently-used LEAF edges and
+    releases blocks tail-first, and ONLY blocks whose refcount is 1 —
+    a block some live chain still holds is never evicted out from
+    under it (releasing it would not free memory anyway; the holder's
+    reference keeps it live).  Interior edges are pinned by their
+    children: chain integrity means a prefix block never leaves before
+    the blocks extending it.
+    """
+
+    def __init__(self, allocator: BlockAllocator, block_size: int):
+        self.allocator = allocator
+        self.block_size = block_size
+        self._roots: Dict[Any, _ChainNode] = {}
+        self._clock = 0
+        self.cached_blocks = 0
+        self.lookups = 0
+        self.hits = 0
+        self.blocks_claimed = 0
+        self.blocks_inserted = 0
+        self.blocks_evicted = 0
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _block_keys(self, tokens) -> List[Tuple[int, ...]]:
+        Bs = self.block_size
+        n = len(tokens) // Bs
+        return [tuple(int(t) for t in tokens[i * Bs:(i + 1) * Bs])
+                for i in range(n)]
+
+    def _match(self, key: Any, blocks: List[Tuple[int, ...]]) -> List[int]:
+        root = self._roots.get(key)
+        out: List[int] = []
+        if root is None:
+            return out
+        node, i, stamp = root, 0, self._tick()
+        while i < len(blocks):
+            child = node.children.get(blocks[i])
+            if child is None:
+                break
+            j = 0
+            while (j < len(child.keys) and i < len(blocks)
+                   and child.keys[j] == blocks[i]):
+                out.append(child.ids[j])
+                i += 1
+                j += 1
+            child.stamp = stamp
+            if j < len(child.keys):
+                break
+            node = child
+        return out
+
+    def claim(self, key: Any, tokens, max_blocks: int) -> List[int]:
+        """Longest resident whole-block prefix of ``tokens`` under
+        ``key``, capped at ``max_blocks``, with a reference RETAINED on
+        every returned block (the caller owns one free() per id, same
+        as an alloc).  ``max_blocks`` is the caller's write-safety cap:
+        the engine passes ``(prompt_len - 1) // block_size`` so the
+        final prompt token is always recomputed (it produces the
+        first-token logits) and every subsequent write lands strictly
+        past the shared blocks."""
+        self.lookups += 1
+        if max_blocks <= 0:
+            return []
+        ids = self._match(key, self._block_keys(tokens))[:max_blocks]
+        if not ids:
+            return []
+        self.allocator.retain(ids)
+        self.hits += 1
+        self.blocks_claimed += len(ids)
+        return ids
+
+    def insert(self, key: Any, tokens, block_ids) -> int:
+        """Register ``tokens``'s full blocks (held in ``block_ids``, the
+        owning chain's physical blocks in logical order) as a resident
+        chain under ``key``.  Blocks already covered by an existing
+        chain are skipped (the walk matches them by token content);
+        newly stored blocks are RETAINED by the index.  Returns the
+        number of blocks newly inserted."""
+        blocks = self._block_keys(tokens)
+        if len(block_ids) < len(blocks):
+            raise ValueError(
+                f"insert: {len(blocks)} full blocks of tokens but only "
+                f"{len(block_ids)} block ids"
+            )
+        if not blocks:
+            return 0
+        root = self._roots.get(key)
+        if root is None:
+            root = self._roots[key] = _ChainNode([], [], None, 0)
+        node, i, stamp, added = root, 0, self._tick(), 0
+        while i < len(blocks):
+            child = node.children.get(blocks[i])
+            if child is None:
+                keys = blocks[i:]
+                ids = [int(b) for b in block_ids[i:len(blocks)]]
+                self.allocator.retain(ids)
+                new = _ChainNode(keys, ids, node, stamp)
+                node.children[keys[0]] = new
+                added += len(ids)
+                break
+            j = 0
+            while (j < len(child.keys) and i < len(blocks)
+                   and child.keys[j] == blocks[i]):
+                i += 1
+                j += 1
+            child.stamp = stamp
+            if j == len(child.keys):
+                node = child
+                continue
+            if i == len(blocks):
+                break  # strict prefix of an existing edge: fully covered
+            # Diverged mid-edge: split the edge at j, then loop — the
+            # next iteration hangs the new suffix under the split point.
+            tail = _ChainNode(child.keys[j:], child.ids[j:], child,
+                              child.stamp)
+            tail.children = child.children
+            for grand in tail.children.values():
+                grand.parent = tail
+            child.keys = child.keys[:j]
+            child.ids = child.ids[:j]
+            child.children = {tail.keys[0]: tail}
+            node = child
+        self.cached_blocks += added
+        self.blocks_inserted += added
+        return added
+
+    def _leaves(self):
+        out = []
+        for root in self._roots.values():
+            stack = list(root.children.values())
+            while stack:
+                n = stack.pop()
+                if n.children:
+                    stack.extend(n.children.values())
+                else:
+                    out.append(n)
+        return out
+
+    def evict(self, n_blocks: int) -> int:
+        """Release up to ``n_blocks`` resident blocks, LRU leaves first,
+        tail-first within a leaf, skipping any block a live chain still
+        holds (refcount > 1).  Returns the number of blocks actually
+        returned to the free list."""
+        freed = 0
+        visited: set = set()
+        while freed < n_blocks:
+            leaf = None
+            for cand in self._leaves():
+                if id(cand) in visited:
+                    continue
+                if leaf is None or cand.stamp < leaf.stamp:
+                    leaf = cand
+            if leaf is None:
+                break
+            visited.add(id(leaf))
+            while leaf.keys and freed < n_blocks:
+                b = leaf.ids[-1]
+                if self.allocator.refcount(b) > 1:
+                    break  # a live chain holds it: pinned
+                leaf.keys.pop()
+                leaf.ids.pop()
+                self.allocator.free([b])
+                self.cached_blocks -= 1
+                self.blocks_evicted += 1
+                freed += 1
+            if not leaf.keys and leaf.parent is not None:
+                leaf.parent.children = {
+                    k: v for k, v in leaf.parent.children.items()
+                    if v is not leaf
+                }
+        return freed
+
+    def drop(self, key: Any) -> int:
+        """Release every chain under ``key`` (adapter replaced/removed:
+        its KV is stale the moment the factors change).  Blocks shared
+        with in-flight chains stay live until those chains drop them."""
+        root = self._roots.pop(key, None)
+        if root is None:
+            return 0
+        dropped = 0
+        stack = list(root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            self.allocator.free(n.ids)
+            dropped += len(n.ids)
+        self.cached_blocks -= dropped
+        return dropped
+
+    def drop_all(self) -> int:
+        """Release every resident chain (engine stop)."""
+        return sum(self.drop(k) for k in list(self._roots))
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "lookups": self.lookups,
+            "hits": self.hits,
+            "blocks_claimed": self.blocks_claimed,
+            "blocks_inserted": self.blocks_inserted,
+            "blocks_evicted": self.blocks_evicted,
+            "cached_blocks": self.cached_blocks,
+        }
 
 
 def paged_prefill(

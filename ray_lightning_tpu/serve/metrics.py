@@ -102,6 +102,10 @@ class ServeStats:
         # snapshots byte-identical to pre-LoRA rounds.  The bench's
         # fairness spread and the rlt_top tenant pane read these.
         self._adapters: Dict[str, Dict[str, int]] = {}
+        # Prefix-cache block — lazily set by set_prefix, so engines
+        # without the cache keep snapshots byte-identical to pre-cache
+        # rounds (same contract as phases/adapters above).
+        self._prefix: Optional[Dict[str, float]] = None
         self.gauges: Dict[str, float] = {}
 
     def bump(self, name: str, n: int = 1) -> None:
@@ -182,6 +186,13 @@ class ServeStats:
         with self._lock:
             self.gauges.update(gauges)
 
+    def set_prefix(self, **fields: float) -> None:
+        """Replace the prefix-cache block (engine-fed each gauge
+        refresh from ``PrefixIndex.stats()``; schema:
+        ``telemetry/schema.py`` ``prefix`` block)."""
+        with self._lock:
+            self._prefix = dict(fields)
+
     # -- consumption ---------------------------------------------------------
     def snapshot(self) -> Dict[str, object]:
         with self._lock:
@@ -211,4 +222,6 @@ class ServeStats:
                     name: dict(entry)
                     for name, entry in self._adapters.items()
                 }
+            if self._prefix is not None:  # prefix-cache engines only
+                out["prefix"] = dict(self._prefix)
             return out

@@ -108,6 +108,11 @@ class Request:
     # NULL/base slot).  Stable across preemption requeues — the pool
     # refuses to remove an adapter any queued/active request holds.
     _adapter_slot: int = 0
+    # Prompt tokens covered by a prefix-cache claim at the CURRENT
+    # admission (0 = no shared prefix).  Re-derived on every admission:
+    # a preempted request re-claims on requeue admission, and the cache
+    # may have evicted (or grown) its chain in between.
+    claimed_tokens: int = 0
 
     @property
     def prompt_len(self) -> int:
@@ -225,6 +230,20 @@ class Scheduler:
         self.adapter_slots = np.zeros((num_slots,), np.int32)
         self._admit_counter = 0
         self._submit_counter = 0
+        # Prefix-cache / chunked-prefill hooks, engine-wired after
+        # construction (all None/off = the pre-cache scheduler,
+        # behaviour byte-identical).  ``claim_fn(req)`` returns
+        # RETAINED shared-prefix block ids for a request at admission;
+        # ``reclaim(n)`` asks the resident cache to evict up to ``n``
+        # blocks when the pool runs dry (tried BEFORE preemption — a
+        # resident chain is always cheaper to drop than a running
+        # request); ``chunk_width`` admits prompts whose uncovered
+        # suffix exceeds it with EXACT block coverage instead of a
+        # prefill bucket (the suffix runs through the fixed-width chunk
+        # program, which needs no bucket-shaped block set).
+        self.claim_fn: Optional[Callable[[Request], List[int]]] = None
+        self.reclaim: Optional[Callable[[int], int]] = None
+        self.chunk_width: Optional[int] = None
         # Fairness state: the adapter key granted the LAST slot —
         # deficit-round-robin with a unit quantum (request costs are
         # uniform at admission: one slot, one bucket) cycles grants
@@ -327,10 +346,33 @@ class Scheduler:
                 break
             pick = self._next_grant_index()
             req = self.queue[pick]
-            bucket = self.bucket_for(req.prompt_len)
-            ids = self.allocator.alloc(bucket // self.block_size)
+            claimed: List[int] = []
+            if self.claim_fn is not None:
+                claimed = self.claim_fn(req)
+            c_tokens = len(claimed) * self.block_size
+            chunked = (self.chunk_width is not None
+                       and getattr(req, "_handoff", None) is None
+                       and (req.prompt_len - c_tokens > self.chunk_width
+                            or req.prompt_len > self.buckets[-1]))
+            if claimed or chunked:
+                # Claimed and/or chunked admissions take exact coverage
+                # (ceil(prompt/Bs) blocks, bucket sentinel 0): the
+                # uncovered suffix runs through the engine's fixed-width
+                # chunk program, so no bucket-shaped padding blocks are
+                # needed — and prompts past the largest bucket admit.
+                bucket = 0
+                need = (-(-req.prompt_len // self.block_size)
+                        - len(claimed))
+            else:
+                bucket = self.bucket_for(req.prompt_len)
+                need = bucket // self.block_size
+            ids = self._alloc(need)
             if ids is None:
+                if claimed:
+                    self.allocator.free(claimed)  # drop the claim refs
                 break  # pool dry: wait for evictions, keep grant order
+            ids = claimed + ids
+            req.claimed_tokens = c_tokens
             del self.queue[pick]
             if not req.preemptions:
                 # Only ROTATION grants advance the fairness pointer: a
@@ -434,6 +476,17 @@ class Scheduler:
         pos = int(self.seq_lens[slot]) if upto_pos is None else int(upto_pos)
         return pos // self.block_size >= len(self._blocks[slot])
 
+    def _alloc(self, n: int) -> Optional[List[int]]:
+        """:meth:`BlockAllocator.alloc` with one reclaim retry: when the
+        pool is dry and a prefix cache is wired, ask it to evict enough
+        resident (idle) blocks first — dropping a cached chain is
+        always cheaper than preempting a running request."""
+        ids = self.allocator.alloc(n)
+        if ids is None and self.reclaim is not None:
+            self.reclaim(n - self.allocator.free_blocks)
+            ids = self.allocator.alloc(n)
+        return ids
+
     def grow(self, slot: int) -> bool:
         """Allocate the next block for ``slot``.  False = pool dry."""
         if len(self._blocks[slot]) >= self.max_blocks_per_seq:
@@ -441,7 +494,7 @@ class Scheduler:
                 f"slot {slot} exceeded max_blocks_per_seq "
                 f"{self.max_blocks_per_seq} — engine admission bound bug"
             )
-        ids = self.allocator.alloc(1)
+        ids = self._alloc(1)
         if ids is None:
             return False
         self._blocks[slot].extend(ids)
@@ -491,10 +544,49 @@ class Scheduler:
                 f"slot {slot} coverage request past max_blocks_per_seq "
                 f"{self.max_blocks_per_seq} — engine width-cap bug"
             )
-        return extend_block_coverage(
+        ok = extend_block_coverage(
             self.allocator, self._blocks[slot], self.block_tables[slot],
             upto_pos, self.block_size,
         )
+        if not ok and self.reclaim is not None:
+            need = (upto_pos // self.block_size) + 1 \
+                - len(self._blocks[slot])
+            self.reclaim(need - self.allocator.free_blocks)
+            ok = extend_block_coverage(
+                self.allocator, self._blocks[slot],
+                self.block_tables[slot], upto_pos, self.block_size,
+            )
+        return ok
+
+    def cow_slot(self, slot: int, upto_block: int
+                 ) -> Optional[Tuple[List[int], List[int]]]:
+        """Copy-on-write bookkeeping for ``slot``: every SHARED block
+        (refcount > 1) among its first ``upto_block`` blocks is swapped
+        for a freshly allocated private one — table entries and the
+        slot's block list point at the copies, references on the
+        originals are dropped.  Returns ``(src_ids, dst_ids)`` for the
+        engine's ``copy_blocks`` program (empty lists = nothing
+        shared), or ``None`` when the pool cannot cover the copies
+        (nothing mutated: all-or-nothing, like every alloc here).
+
+        The admission claim cap keeps nominal serving from ever needing
+        this (writes land strictly past the shared frontier) — it is
+        the escape hatch for any path that must WRITE below it.
+        """
+        blocks = self._blocks[slot]
+        shared = [i for i in range(min(upto_block, len(blocks)))
+                  if self.allocator.is_shared(blocks[i])]
+        if not shared:
+            return [], []
+        fresh = self._alloc(len(shared))
+        if fresh is None:
+            return None
+        src = [blocks[i] for i in shared]
+        for i, dst in zip(shared, fresh):
+            blocks[i] = dst
+            self.block_tables[slot, i] = dst
+        self.allocator.free(src)
+        return src, fresh
 
     def preempt_youngest(self, protect: Optional[int] = None
                          ) -> Optional[Request]:

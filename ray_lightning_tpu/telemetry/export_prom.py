@@ -121,6 +121,9 @@ def _render_router(router: Dict[str, Any]) -> list:
          "blocks_free"),
         ("serve_replica_spec_acceptance_rate",
          "accepted / drafted on this replica", "spec_acceptance_rate"),
+        ("serve_replica_prefix_cache_hit_rate",
+         "prefix-cache hit rate on this replica",
+         "prefix_cache_hit_rate"),
         ("serve_replica_recompiles",
          "compile events observed in the replica process",
          "recompiles"),
@@ -320,6 +323,47 @@ def _render_serve(serve: Dict[str, Any]) -> list:
                 f'{{adapter="{_esc(name)}"}} '
                 f"{adapters[name].get('completed', 0)}"
             )
+    # Prefix-aware KV reuse (engines with a prefix cache): the block
+    # accounting families plus the derived hit-rate/residency gauges.
+    prefix = serve.get("prefix")
+    if prefix:
+        lines.append(f"# TYPE {_PREFIX}_serve_prefix_requests counter")
+        lines.append(
+            f"# HELP {_PREFIX}_serve_prefix_requests prefix-cache "
+            f"lookups and whole-block hits at admission"
+        )
+        for kind in ("lookup", "hit"):
+            lines.append(
+                f'{_PREFIX}_serve_prefix_requests_total'
+                f'{{kind="{_esc(kind)}"}} '
+                f"{prefix.get(kind + 's', 0)}"
+            )
+        lines.append(f"# TYPE {_PREFIX}_serve_prefix_blocks counter")
+        lines.append(
+            f"# HELP {_PREFIX}_serve_prefix_blocks KV blocks through "
+            f"the prefix cache by event (claimed = prefill skipped)"
+        )
+        for kind in ("claimed", "inserted", "evicted"):
+            lines.append(
+                f'{_PREFIX}_serve_prefix_blocks_total'
+                f'{{kind="{_esc(kind)}"}} '
+                f"{prefix.get('blocks_' + kind, 0)}"
+            )
+        for name, help_ in (
+            ("hit_rate",
+             "admissions claiming at least one resident block"),
+            ("cached_blocks", "KV blocks resident in the prefix cache"),
+        ):
+            if name in prefix:
+                lines.append(
+                    f"# TYPE {_PREFIX}_serve_prefix_{name} gauge"
+                )
+                lines.append(
+                    f"# HELP {_PREFIX}_serve_prefix_{name} {help_}"
+                )
+                lines.append(
+                    f"{_PREFIX}_serve_prefix_{name} {prefix[name]}"
+                )
     latency = serve.get("latency", {})
     for family, summary in sorted(latency.items()):
         metric = f"serve_{family}_latency_ms"

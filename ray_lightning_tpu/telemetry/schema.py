@@ -43,6 +43,8 @@ __all__ = [
     "validate_router_snapshot",
     "validate_bench_serve",
     "validate_bench_spec_decode",
+    "validate_bench_prefix_cache",
+    "validate_bench_chunked_prefill",
     "validate_bench_serve_disagg",
     "validate_bench_multi_lora",
     "validate_mpmd_stage_item",
@@ -461,6 +463,18 @@ _SERVE_SNAPSHOT_REQUIRED = {
 _SERVE_SNAPSHOT_OPTIONAL = {
     "phases": dict,
     "adapters": dict,
+    # Prefix-cache engines only (ServeStats.set_prefix, fed from
+    # PrefixIndex.stats each gauge refresh).
+    "prefix": dict,
+}
+_SERVE_PREFIX_REQUIRED = {
+    "hit_rate": (int, float),
+    "lookups": int,
+    "hits": int,
+    "blocks_claimed": int,
+    "blocks_inserted": int,
+    "blocks_evicted": int,
+    "cached_blocks": int,
 }
 _SERVE_ADAPTER_ENTRY_FIELDS = {
     "tokens_out": int,
@@ -508,6 +522,21 @@ def validate_serve_snapshot(doc: Any,
         problems.append(
             f"{where}: lora_fairness_spread {spread} outside [0, 1]"
         )
+    if "prefix" in doc:
+        prefix_problems = _check_fields(
+            doc["prefix"], _SERVE_PREFIX_REQUIRED, {}, f"{where}.prefix"
+        )
+        if not prefix_problems:
+            hr = doc["prefix"]["hit_rate"]
+            if not 0.0 <= hr <= 1.0:
+                prefix_problems.append(
+                    f"{where}.prefix: hit_rate {hr} outside [0, 1]"
+                )
+            if doc["prefix"]["hits"] > doc["prefix"]["lookups"]:
+                prefix_problems.append(
+                    f"{where}.prefix: hits > lookups"
+                )
+        problems += prefix_problems
     for name, entry in doc.get("adapters", {}).items():
         problems += _check_fields(
             entry, _SERVE_ADAPTER_ENTRY_FIELDS, {},
@@ -640,6 +669,7 @@ _ROUTER_REPLICA_OPTIONAL = {
     "blocks_free": (int, float),
     "num_blocks": (int, float),
     "spec_acceptance_rate": (int, float),
+    "prefix_cache_hit_rate": (int, float),
     "recompiles": int,
     "adapters": int,       # loaded LoRA tenants (pool-capable members)
 }
@@ -674,6 +704,11 @@ def _validate_router_member(entry: Any, where: str, count_key: str,
     if isinstance(rate, (int, float)) and not 0.0 <= rate <= 1.0:
         problems.append(
             f"{where}: spec_acceptance_rate {rate} outside [0, 1]"
+        )
+    hit = entry.get("prefix_cache_hit_rate")
+    if isinstance(hit, (int, float)) and not 0.0 <= hit <= 1.0:
+        problems.append(
+            f"{where}: prefix_cache_hit_rate {hit} outside [0, 1]"
         )
     return problems
 
@@ -828,6 +863,101 @@ def validate_bench_spec_decode(block: Any,
                 "outside [0, 1]"
             )
         problems += arm_problems
+    return problems
+
+
+# The bench_serve.py prefix-cache A/B block: the cached arm serves a
+# shared-prefix workload mix against its cache-off baseline.  Both
+# arms must pin recompiles_steady_state (sharing is operand-only by
+# construction — a recompile would mean the claim leaked into a
+# shape), and the parity flag asserts the cached arm's tokens are
+# bitwise the baseline's.
+_BENCH_PREFIX_REQUIRED = {
+    "prefix_share": (int, float),       # fraction of prompt in the shared prefix
+    "requests": int,
+    "hit_rate": (int, float),
+    "blocks_claimed": int,
+    "ttft_p50_ms": (int, float),                # cached arm
+    "baseline_ttft_p50_ms": (int, float),       # cache-off arm
+    "ttft_speedup": (int, float),               # the >= 1.5x headline
+    "tokens_per_sec": (int, float),
+    "baseline_tokens_per_sec": (int, float),
+    "recompiles_steady_state": int,
+    "baseline_recompiles_steady_state": int,
+}
+_BENCH_PREFIX_OPTIONAL = {
+    "token_parity": bool,       # cached tokens == baseline tokens
+    "blocks_inserted": int,
+    "cached_blocks": int,
+    "prefill_chunks": int,
+    "max_new_tokens": int,
+}
+
+
+def validate_bench_prefix_cache(block: Any,
+                                where: str = "prefix_cache") -> List[str]:
+    """Validate the ``prefix_cache`` block of a bench artifact (absent
+    on pre-cache rounds)."""
+    problems = _check_fields(
+        block, _BENCH_PREFIX_REQUIRED, _BENCH_PREFIX_OPTIONAL, where
+    )
+    if problems:
+        return problems
+    if not 0.0 <= block["hit_rate"] <= 1.0:
+        problems.append(
+            f"{where}: hit_rate {block['hit_rate']} outside [0, 1]"
+        )
+    if not 0.0 <= block["prefix_share"] <= 1.0:
+        problems.append(
+            f"{where}: prefix_share {block['prefix_share']} "
+            "outside [0, 1]"
+        )
+    for key in ("recompiles_steady_state",
+                "baseline_recompiles_steady_state"):
+        if block[key] < 0:
+            problems.append(f"{where}: negative {key}")
+    if block["requests"] < 1:
+        problems.append(f"{where}: requests < 1")
+    return problems
+
+
+# The bench_long_context.py serving-side chunked-prefill block: a long
+# prompt admitted against resident decode traffic, with the no-stall
+# contract surfaced as the max per-step emission gap of the resident
+# slots (1 = a token landed every step; the acceptance bound).
+_BENCH_CHUNKED_REQUIRED = {
+    "prompt_len": int,
+    "chunk_width": int,
+    "chunks": int,
+    "resident_max_stall_ticks": int,
+    "recompiles_steady_state": int,
+}
+_BENCH_CHUNKED_OPTIONAL = {
+    "ttft_ms": (int, float, type(None)),
+    "resident_requests": int,
+    "tokens_per_sec": (int, float, type(None)),
+}
+
+
+def validate_bench_chunked_prefill(block: Any,
+                                   where: str = "chunked_prefill"
+                                   ) -> List[str]:
+    """Validate the ``chunked_prefill`` block of a bench artifact."""
+    problems = _check_fields(
+        block, _BENCH_CHUNKED_REQUIRED, _BENCH_CHUNKED_OPTIONAL, where
+    )
+    if problems:
+        return problems
+    if block["chunk_width"] < 1:
+        problems.append(f"{where}: chunk_width < 1")
+    if block["chunks"] < 1:
+        problems.append(f"{where}: chunks < 1")
+    if block["prompt_len"] < 1:
+        problems.append(f"{where}: prompt_len < 1")
+    if block["resident_max_stall_ticks"] < 0:
+        problems.append(f"{where}: negative resident_max_stall_ticks")
+    if block["recompiles_steady_state"] < 0:
+        problems.append(f"{where}: negative recompiles_steady_state")
     return problems
 
 

@@ -155,7 +155,15 @@ def _install_listener() -> None:
 
 
 def compile_event_count() -> int:
-    """Process-lifetime XLA backend compiles observed so far."""
+    """Process-lifetime XLA backend compiles observed so far.
+
+    Installs the jax.monitoring listener on first call: every consumer
+    of this counter measures DELTAS (``before = compile_event_count()``
+    … ``assert compile_event_count() - before == 0``), and without the
+    eager install a process that never built a :class:`StepStats` —
+    a standalone serve test, a bench entry point — would pin
+    "zero recompiles" against a counter that was never counting."""
+    _install_listener()
     return _COMPILES[0]
 
 

@@ -219,6 +219,35 @@ class TestRouterPolicy:
         finally:
             rig.close()
 
+    def test_prefix_affinity_prefers_warm_replica(self):
+        rig = _RouterRig(n_replicas=2)
+        try:
+            rig.submit("a0", prompt_len=9)
+            rig.submit("a1", prompt_len=9)  # same prompt family
+            r0 = _drain(rig.replicas["r0"][1])
+            r1 = _drain(rig.replicas["r1"][1])
+            # The second request follows the chain to the replica that
+            # served the first, even though the other replica is idle.
+            assert {item["rid"] for item in r0} == {"a0", "a1"}
+            assert r1 == []
+            assert rig.router.counters["prefix_affinity_hits"] == 1
+        finally:
+            rig.close()
+
+    def test_prefix_affinity_yields_when_warm_replica_full(self):
+        rig = _RouterRig(n_replicas=2)  # num_slots=2 per replica
+        try:
+            for i in range(3):
+                rig.submit(f"f{i}", prompt_len=9)
+            r0 = _drain(rig.replicas["r0"][1])
+            r1 = _drain(rig.replicas["r1"][1])
+            # Affinity never queues: once the warm replica's slots are
+            # full the third same-prefix request places by load.
+            assert {item["rid"] for item in r0} == {"f0", "f1"}
+            assert [item["rid"] for item in r1] == ["f2"]
+        finally:
+            rig.close()
+
     def test_capacity_rejection_typed(self):
         rig = _RouterRig(n_replicas=1,
                          caps={"num_slots": 1, "max_queue": 1,

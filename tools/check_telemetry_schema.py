@@ -31,9 +31,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from ray_lightning_tpu.telemetry.schema import (  # noqa: E402
     validate_bench_fault,
     validate_bench_host_overhead,
+    validate_bench_chunked_prefill,
     validate_bench_mpmd,
     validate_bench_multi_lora,
     validate_bench_opt_state,
+    validate_bench_prefix_cache,
     validate_bench_residual_policy,
     validate_bench_serve,
     validate_bench_serve_disagg,
@@ -505,6 +507,105 @@ def _self_test_serve() -> list:
     problems += _self_test_spec_decode(stats)
     problems += _self_test_serve_disagg()
     problems += _self_test_multi_lora()
+    problems += _self_test_prefix_cache()
+    return problems
+
+
+def _self_test_prefix_cache() -> list:
+    """Prefix-cache / chunked-prefill producers vs their schema: a REAL
+    ServeStats snapshot carrying the engine's set_prefix block, the
+    bench prefix_cache and chunked_prefill blocks, and the router
+    replica hit-rate gauge — plus negatives (hit_rate outside [0, 1],
+    hits > lookups, a bench block missing its baseline recompile pin,
+    a chunked block with zero chunks)."""
+    from ray_lightning_tpu.serve.metrics import ServeStats
+
+    stats = ServeStats()
+    stats.bump("prefills")
+    stats.bump("prefill_chunks", 3)
+    stats.set_gauges(queue_depth=0, prefix_cache_hit_rate=0.5,
+                     prefix_cached_blocks=6)
+    stats.set_prefix(hit_rate=0.5, lookups=4, hits=2, blocks_claimed=4,
+                     blocks_inserted=8, blocks_evicted=0,
+                     cached_blocks=6)
+    snap = stats.snapshot()
+    problems = validate_serve_snapshot(snap, "self-test prefix snapshot")
+    bad = json_roundtrip(snap)
+    bad["prefix"]["hit_rate"] = 1.5
+    if not validate_serve_snapshot(bad):
+        problems.append(
+            "self-test prefix snapshot: validator accepted "
+            "hit_rate > 1"
+        )
+    bad = json_roundtrip(snap)
+    bad["prefix"]["hits"] = bad["prefix"]["lookups"] + 1
+    if not validate_serve_snapshot(bad):
+        problems.append(
+            "self-test prefix snapshot: validator accepted "
+            "hits > lookups"
+        )
+    bad = json_roundtrip(snap)
+    del bad["prefix"]["cached_blocks"]
+    if not validate_serve_snapshot(bad):
+        problems.append(
+            "self-test prefix snapshot: validator accepted a prefix "
+            "block missing its occupancy counter"
+        )
+
+    block = {
+        "prefix_share": 0.6, "requests": 16, "hit_rate": 0.44,
+        "blocks_claimed": 24, "ttft_p50_ms": 12.0,
+        "baseline_ttft_p50_ms": 30.0, "ttft_speedup": 2.5,
+        "tokens_per_sec": 240.0, "baseline_tokens_per_sec": 200.0,
+        "recompiles_steady_state": 0,
+        "baseline_recompiles_steady_state": 0,
+        "token_parity": True, "blocks_inserted": 40,
+        "cached_blocks": 36, "prefill_chunks": 16,
+        "max_new_tokens": 8,
+    }
+    problems += validate_bench_prefix_cache(
+        block, "self-test bench prefix_cache"
+    )
+    if not validate_bench_prefix_cache(
+        {k: v for k, v in block.items()
+         if k != "baseline_recompiles_steady_state"}
+    ):
+        problems.append(
+            "self-test prefix_cache: validator accepted a block "
+            "missing the baseline recompile pin"
+        )
+    if not validate_bench_prefix_cache({**block, "hit_rate": -0.1}):
+        problems.append(
+            "self-test prefix_cache: validator accepted a negative "
+            "hit_rate"
+        )
+    if not validate_bench_prefix_cache({**block, "prefix_share": 1.2}):
+        problems.append(
+            "self-test prefix_cache: validator accepted "
+            "prefix_share > 1"
+        )
+
+    chunked = {
+        "prompt_len": 4096, "chunk_width": 512, "chunks": 8,
+        "resident_max_stall_ticks": 1, "recompiles_steady_state": 0,
+        "ttft_ms": 180.0, "resident_requests": 2,
+        "tokens_per_sec": None,
+    }
+    problems += validate_bench_chunked_prefill(
+        chunked, "self-test bench chunked_prefill"
+    )
+    if not validate_bench_chunked_prefill({**chunked, "chunks": 0}):
+        problems.append(
+            "self-test chunked_prefill: validator accepted zero chunks"
+        )
+    if not validate_bench_chunked_prefill(
+        {k: v for k, v in chunked.items()
+         if k != "resident_max_stall_ticks"}
+    ):
+        problems.append(
+            "self-test chunked_prefill: validator accepted a block "
+            "missing the no-stall pin"
+        )
     return problems
 
 
@@ -680,7 +781,8 @@ def _self_test_serve_disagg() -> list:
                       "gauges": {"slots_active": 1, "num_slots": 8,
                                  "blocks_free": 20, "num_blocks": 33,
                                  "queue_depth": 0,
-                                 "spec_acceptance_rate": 0.9}},
+                                 "spec_acceptance_rate": 0.9,
+                                 "prefix_cache_hit_rate": 0.4}},
             recompiles=12,
             adapters=["tenant0", "tenant1"],
         ))
@@ -696,6 +798,13 @@ def _self_test_serve_disagg() -> list:
             problems.append(
                 "self-test router snapshot: validator accepted a "
                 "negative inflight"
+            )
+        bad = json_roundtrip(snap)
+        bad["replicas"][0]["prefix_cache_hit_rate"] = 1.5
+        if not validate_router_snapshot(bad):
+            problems.append(
+                "self-test router snapshot: validator accepted a "
+                "replica prefix hit rate > 1"
             )
     finally:
         router.stop()
@@ -899,6 +1008,18 @@ def scan_bench_files() -> list:
         if disagg is not None:  # pre-disaggregation rounds lack it
             problems += validate_bench_serve_disagg(
                 disagg, f"{name}:serve_disagg"
+            )
+        prefix = (doc.get("prefix_cache")
+                  or (serve or {}).get("prefix_cache"))
+        if prefix is not None:  # pre-prefix-cache rounds lack it
+            problems += validate_bench_prefix_cache(
+                prefix, f"{name}:prefix_cache"
+            )
+        chunked = (doc.get("chunked_prefill")
+                   or (serve or {}).get("chunked_prefill"))
+        if chunked is not None:  # pre-chunked-prefill rounds lack it
+            problems += validate_bench_chunked_prefill(
+                chunked, f"{name}:chunked_prefill"
             )
         trace = doc.get("trace") or (serve or {}).get("trace")
         if trace is not None:  # pre-tracing rounds lack it

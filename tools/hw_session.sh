@@ -106,4 +106,26 @@ for n in 2 4; do
     2>&1 | tee "tools/hw_logs/${stamp}_bench_serve_disagg_r${n}.log"
 done
 
+log "serve A/B: prefix-cache hit-rate sweep (prefix_cache block)"
+# Phase 8 runs the cached-vs-cold TTFT A/B on a shared-prefix mix with
+# token parity and both recompile counters pinned 0.  The shared-prefix
+# share of the mix scans the hit-rate axis: the TTFT win should rise
+# with the share (claimed blocks skip real TPU prefill flops here, not
+# just CPU dispatch), and the 0-share arm bounds the index overhead.
+for share in 25 50 90; do
+  RLT_PREFIX_SHARE=$share RLT_DISAGG_REPLICAS=0 timeout 1800 \
+    python bench_serve.py \
+    2>&1 | tee "tools/hw_logs/${stamp}_bench_serve_prefix_s${share}.log"
+done
+
+log "serve A/B: chunked prefill width sweep (chunked_prefill block)"
+# Long-prompt admission vs resident decode traffic at real sequence
+# lengths: the no-stall bound (resident_max_stall_ticks <= 1) must
+# hold at every width, and the width trades TTFT of the long prompt
+# against per-tick decode latency — the sweep finds the knee.
+for w in 512 1024 2048; do
+  RLT_PREFILL_CHUNK=$w timeout 1800 python bench_long_context.py \
+    2>&1 | tee "tools/hw_logs/${stamp}_bench_longctx_chunk_w${w}.log"
+done
+
 log "done — logs in tools/hw_logs/${stamp}_*.log"

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Pinned repro for the concurrent-trials dispatch wedge.
+
+``tests/test_tune.py::test_concurrent_trials_with_real_fits`` wedges
+~2/3 of runs on a loaded 2-core container — two LocalStrategy fits in
+concurrent trial threads starve each other's jax dispatch (scheduler
+starvation, NOT interpreter state: round 13 measured it in FRESH
+subprocesses; round 11's whole-suite-state theory is retired).  The
+test is slow-marked out of tier-1 (round 16) so the 870s budget stops
+paying ~360s of worst-case timeouts; THIS script keeps the flake
+measurable on demand:
+
+    python tools/repro_tune_wedge.py              # 10 attempts, 180s cap
+    python tools/repro_tune_wedge.py -n 30 -t 60  # tighter sweep
+
+Each attempt runs the test body in a fresh interpreter with a fresh
+tmp dir (exactly the quarantine harness) and is scored pass / wedge
+(timeout) / fail (nonzero exit — NOT the known flake, investigate).
+Exit code: 0 if every attempt passed, 2 if any wedged, 1 on real
+failures.  Run it when touching tuning/strategy threading, or to
+re-measure the wedge rate on new hardware before un-quarantining.
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_TEST = os.path.join(_REPO, "tests", "test_tune.py")
+
+_SCRIPT = (
+    "import importlib.util, sys\n"
+    "spec = importlib.util.spec_from_file_location('t', sys.argv[1])\n"
+    "mod = importlib.util.module_from_spec(spec)\n"
+    "spec.loader.exec_module(mod)\n"
+    "mod._concurrent_real_fits_body(sys.argv[2])\n"
+)
+
+
+def one_attempt(timeout_s: float, workdir: str):
+    """Returns ('pass'|'wedge'|'fail', seconds, detail)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _SCRIPT, _TEST, workdir],
+            capture_output=True, text=True, timeout=timeout_s,
+            env=env, cwd=_REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return "wedge", time.monotonic() - t0, f"timeout {timeout_s}s"
+    dt = time.monotonic() - t0
+    if proc.returncode != 0:
+        return "fail", dt, (f"rc={proc.returncode}\n"
+                            f"{proc.stdout}\n{proc.stderr}")
+    return "pass", dt, ""
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("-n", "--attempts", type=int, default=10)
+    ap.add_argument("-t", "--timeout", type=float, default=180.0,
+                    help="per-attempt wall cap in seconds (the "
+                    "quarantine harness used 180)")
+    args = ap.parse_args()
+
+    counts = {"pass": 0, "wedge": 0, "fail": 0}
+    for i in range(1, args.attempts + 1):
+        with tempfile.TemporaryDirectory(prefix="tune_wedge_") as d:
+            verdict, dt, detail = one_attempt(args.timeout, d)
+        counts[verdict] += 1
+        print(f"attempt {i:2d}/{args.attempts}: {verdict:5s} "
+              f"({dt:6.1f}s)" + (f"  {detail.splitlines()[0]}"
+                                 if detail else ""), flush=True)
+        if verdict == "fail":
+            print(detail, file=sys.stderr)
+    n = args.attempts
+    print(f"\nwedge rate: {counts['wedge']}/{n} "
+          f"({100.0 * counts['wedge'] / n:.0f}%)  "
+          f"pass {counts['pass']}  fail {counts['fail']}")
+    if counts["fail"]:
+        return 1
+    return 2 if counts["wedge"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -819,6 +819,13 @@ _HOT_JIT = {
         # ONE scatter program is built at AdapterPool.__init__).
         "ServeEngine._lora_operands", "ServeEngine.add_adapter",
         "ServeEngine._load_adapter_item",
+        # Prefix-cache / chunked-prefill hot paths: claims are pure
+        # refcount bumps and chunk ticks replay ONE pre-built program
+        # per step — a fresh jit on any of these would recompile per
+        # admission.
+        "ServeEngine._claim_prefix", "ServeEngine._suffix_prefill",
+        "ServeEngine._start_chunk_job", "ServeEngine._chunk_tick",
+        "ServeEngine._prefix_insert",
     }),
     f"{_PKG}/serve/lora.py": frozenset({
         "AdapterPool.add", "AdapterPool.remove", "AdapterPool.slot_of",
@@ -846,6 +853,11 @@ _HOT_SYNC = {
     f"{_PKG}/serve/engine.py": frozenset({
         "ServeEngine.step", "ServeEngine._decode_tick",
         "ServeEngine._spec_tick", "ServeEngine._lora_operands",
+        # Chunk ticks interleave with decode: a host sync per chunk
+        # (beyond the final-chunk TTFT sync, which carries a noqa)
+        # would serialize the stream the no-stall contract protects.
+        "ServeEngine._claim_prefix", "ServeEngine._suffix_prefill",
+        "ServeEngine._chunk_tick",
     }),
     f"{_PKG}/mpmd/stage.py": frozenset({
         "StageRunner._run_opt_step",

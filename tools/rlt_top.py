@@ -135,9 +135,31 @@ def _render_serve(serve: Dict[str, Any]) -> list:
                 for family, s in sorted(latency.items())
             )
         )
+    lines += _render_prefix(serve)
     lines += _render_lora(serve)
     lines += _render_phases(serve)
     return lines
+
+
+def _render_prefix(serve: Dict[str, Any]) -> list:
+    """The prefix-cache pane (engines with prefix-aware KV reuse):
+    hit rate, resident blocks, and the claimed-vs-inserted block
+    flow — how much prefill the cache is actually saving."""
+    p = serve.get("prefix")
+    if not p:
+        return []
+    c = serve.get("counters", {})
+    chunks = ""
+    if c.get("prefill_chunks"):
+        chunks = f"  chunks {c['prefill_chunks']}"
+    return [
+        f"prefix:  hit {p.get('hit_rate', 0.0):.2f} "
+        f"({p.get('hits', 0)}/{p.get('lookups', 0)})"
+        f"  cached {p.get('cached_blocks', 0)}blk"
+        f"  claimed {p.get('blocks_claimed', 0)}"
+        f"  inserted {p.get('blocks_inserted', 0)}"
+        f"  evicted {p.get('blocks_evicted', 0)}" + chunks,
+    ]
 
 
 def _render_lora(serve: Dict[str, Any]) -> list:
