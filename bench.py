@@ -383,6 +383,66 @@ def _heartbeat_overhead_pct(repeats: int = 3) -> float:
     return 100.0 * (beating - silent) / silent if silent else 0.0
 
 
+def _ledger_overhead_pct(repeats: int = 3) -> float:
+    """Measured per-step cost of the program-ledger dispatch wrapper
+    (telemetry/program_ledger.py) vs the same cheap-tier fit with the
+    ledger killed (``RLT_PROGRAM_LEDGER=0`` builds bare ``jax.jit``).
+    The steady-state path is one MRU try/except per dispatch (~0.2us
+    micro-benchmarked), so this records a noise-floor bound, not a
+    measurable cost.  Best-of-N per arm, like the heartbeat probe."""
+    def _arm(value: str) -> float:
+        prev = os.environ.get("RLT_PROGRAM_LEDGER")
+        os.environ["RLT_PROGRAM_LEDGER"] = value
+        try:
+            return min(
+                _bench_boring_fit("cheap") for _ in range(repeats)
+            )
+        finally:
+            if prev is None:
+                os.environ.pop("RLT_PROGRAM_LEDGER", None)
+            else:
+                os.environ["RLT_PROGRAM_LEDGER"] = prev
+
+    bare = _arm("0")
+    ledgered = _arm("1")
+    return 100.0 * (ledgered - bare) / bare if bare else 0.0
+
+
+def _bench_programs_block(snap: dict, tel_report: dict,
+                          ledger_overhead_pct) -> dict:
+    """The schema-gated ``programs`` block (telemetry/schema.py::
+    validate_bench_programs): the headline fit's compiled-executable
+    inventory — taken right after the fit, before the probe fits
+    pollute the process-global ledger — plus the measured wrapper
+    overhead and the HBM/roofline accounting for the train step."""
+    from ray_lightning_tpu.telemetry import program_ledger
+
+    rows = [
+        {k: v for k, v in row.items()}
+        for row in snap.get("programs", [])
+        if row["site"].startswith(("train/", "eval/"))
+    ]
+    block: dict = {
+        "n_programs": len(rows),
+        "compile_time_total_s": round(
+            float(snap.get("compile_time_total_s", 0.0)), 3
+        ),
+        "recompile_events": len(snap.get("recompiles", [])),
+        "ledger_overhead_pct": ledger_overhead_pct,
+        "rows": rows,
+        "hbm": program_ledger.hbm_report(snap),
+    }
+    roof = program_ledger.roofline("train/step", snap=snap)
+    if roof is not None:
+        block["roofline"] = roof
+    basis = (tel_report.get("meta") or {}).get("mfu_basis")
+    if basis:
+        block["mfu_basis"] = basis
+    if snap.get("dropped"):
+        block["dropped"] = snap["dropped"]
+    return block
+
+
 def _bench_fault_block() -> dict:
     """Recovery-cost probes for the schema-gated ``fault`` block
     (docs/FAULT_TOLERANCE.md): ``drain_checkpoint_s`` (step-granular
@@ -745,6 +805,12 @@ def main() -> None:
     fit_tps, fit_spread, tel_report, monitor_events, fit_trainer = (
         _bench_fit(make_module(), cfg, batch_size, megastep="off")
     )
+    # Ledger snapshot NOW: the probe fits below add their own programs
+    # (and shape-change recompile events) to the process-global ledger;
+    # the artifact's programs block must describe the headline fit.
+    from ray_lightning_tpu.telemetry import program_ledger as _ledger
+
+    headline_programs = _ledger.snapshot()
     try:
         host_overhead = _bench_host_overhead(
             make_module, cfg, batch_size, fit_tps, raw_tps, fit_trainer
@@ -763,6 +829,18 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 - same discipline
         sys.stderr.write(f"heartbeat overhead probe skipped: {e}\n")
         hb_overhead_pct = None
+    try:
+        ledger_overhead_pct = round(_ledger_overhead_pct(), 3)
+    except Exception as e:  # noqa: BLE001 - same discipline
+        sys.stderr.write(f"ledger overhead probe skipped: {e}\n")
+        ledger_overhead_pct = None
+    try:
+        programs_block = _bench_programs_block(
+            headline_programs, tel_report, ledger_overhead_pct
+        )
+    except Exception as e:  # noqa: BLE001 - same discipline
+        sys.stderr.write(f"programs block skipped: {e}\n")
+        programs_block = None
     try:
         fault_block = _bench_fault_block()
     except Exception as e:  # noqa: BLE001 - same discipline
@@ -843,6 +921,11 @@ def main() -> None:
                 "counters": tel_report.get("counters", {}),
             },
         },
+        # Compiled-executable observatory (schema-gated): the headline
+        # fit's program inventory with compile/cost/memory accounting,
+        # recompile-forensics count, and the measured dispatch-wrapper
+        # overhead (docs/OBSERVABILITY.md "Program ledger").
+        "programs": programs_block,
         # Recovery cost in the perf trajectory (schema-gated like the
         # telemetry block): injected-crash recovery wall time, drain-
         # checkpoint write time, observed backoff delay.

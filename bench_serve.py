@@ -969,7 +969,45 @@ def main() -> None:
         prefix_block = _prefix_cache_block(module, params, serve_cfg,
                                            cfg)
 
+    # Compiled-program observatory: by this point every serve plane ran
+    # (bucketed prefills, decode, chunked prefill, draft + K+1 verify,
+    # LoRA scatter), so the process ledger must hold each steady-state
+    # program WITH its cost/memory accounting — the coverage gate below
+    # turns a silently-unregistered site into a bench failure.
+    from ray_lightning_tpu.telemetry import program_ledger
+    from ray_lightning_tpu.telemetry.schema import validate_bench_programs
+
+    ledger_snap = program_ledger.snapshot()
+    serve_rows = [r for r in ledger_snap["programs"]
+                  if r["site"].startswith("serve/")]
+    programs_block = {
+        "n_programs": len(serve_rows),
+        "compile_time_total_s": round(
+            float(ledger_snap["compile_time_total_s"]), 3
+        ),
+        "recompile_events": len(ledger_snap["recompiles"]),
+        # The dispatch-overhead A/B rides bench.py's boring-fit arms;
+        # this producer records coverage, not the micro-cost.
+        "ledger_overhead_pct": None,
+        "rows": serve_rows,
+        "hbm": program_ledger.hbm_report(ledger_snap),
+    }
+
     problems = validate_bench_serve(serve_block)
+    problems += validate_bench_programs(programs_block)
+    for site in ("serve/prefill", "serve/decode", "serve/verify",
+                 "serve/lora_scatter"):
+        rows = [r for r in serve_rows if r["site"] == site]
+        if not rows:
+            problems.append(
+                f"programs: steady-state serve program {site} missing "
+                "from the ledger"
+            )
+        elif not any("flops" in r and "argument_bytes" in r
+                     for r in rows):
+            problems.append(
+                f"programs: {site} registered without cost+memory rows"
+            )
     problems += validate_bench_spec_decode(spec_block)
     problems += validate_bench_trace(trace_block)
     problems += validate_bench_multi_lora(multi_lora_block)
@@ -1053,6 +1091,7 @@ def main() -> None:
         "spec_decode": spec_block,
         "trace": trace_block,
         "multi_lora": multi_lora_block,
+        "programs": programs_block,
     }
     if disagg_block is not None:
         out["serve_disagg"] = disagg_block

@@ -33,6 +33,7 @@ from ray_lightning_tpu.fault.drain import PreemptedError
 from ray_lightning_tpu.parallel import sharding as shardlib
 from ray_lightning_tpu.parallel import step_fns
 from ray_lightning_tpu.telemetry import Telemetry
+from ray_lightning_tpu.telemetry import program_ledger
 from ray_lightning_tpu.utils.state_stream import (
     load_state_stream,
     state_stream_from_file,
@@ -2092,6 +2093,27 @@ def _run_fit_inner(
                                 ),
                                 k=n, sampled=sampled, compiled=first_use,
                             )
+                        if first_use and n == 1:
+                            # Roofline cross-check, once per program:
+                            # feed the XLA cost_analysis FLOPs the
+                            # ledger captured for the program that just
+                            # compiled back into StepStats — MFU flips
+                            # to a measured basis, and the drift guard
+                            # flags a stale analytic accounting (>10%
+                            # disagreement).  Fused megasteps are
+                            # excluded: XLA costs the scanned body
+                            # trip-count-agnostically, which would
+                            # poison a per-example basis.
+                            flops = (
+                                program_ledger.ledger()
+                                .site_flops_latest("train/step")
+                            )
+                            if flops:
+                                tel_stats.configure_measured_flops(
+                                    flops / max(
+                                        int(shape[0]) if shape else 1, 1
+                                    )
+                                )
                     if tracer.enabled:
                         tracer.record(
                             "data_wait", t_mark, t_ready - t_mark

@@ -147,6 +147,8 @@ class StageRunner:
         import jax
         import jax.numpy as jnp
 
+        from ray_lightning_tpu.telemetry.program_ledger import ledgered_jit
+
         spec = self.spec
         self._fwd: List[Any] = []
         self._bwd: List[Any] = []
@@ -174,46 +176,48 @@ class StageRunner:
                 return spec.loss_fn(params, x, batch)
 
             if first and last:
-                fwd = jax.jit(loss_single)
+                fwd = ledgered_jit(loss_single, site=f"mpmd/fwd_s{g}")
 
                 def bwd(params, batch, _f=loss_single):
                     return jax.grad(lambda p: _f(p, batch)[0])(params)
 
-                bwd = jax.jit(bwd)
+                bwd = ledgered_jit(bwd, site=f"mpmd/bwd_s{g}")
             elif first:
-                fwd = jax.jit(fwd_first)
+                fwd = ledgered_jit(fwd_first, site=f"mpmd/fwd_s{g}")
 
                 def bwd(params, batch, dy, _f=fwd_first):
                     _, vjp = jax.vjp(lambda p: _f(p, batch), params)
                     (dp,) = vjp(dy)
                     return dp
 
-                bwd = jax.jit(bwd)
+                bwd = ledgered_jit(bwd, site=f"mpmd/bwd_s{g}")
             elif last:
-                fwd = jax.jit(loss_last)
+                fwd = ledgered_jit(loss_last, site=f"mpmd/fwd_s{g}")
 
                 def bwd(params, x, batch, _f=loss_last):
                     return jax.grad(
                         lambda p, xx: _f(p, xx, batch)[0], argnums=(0, 1)
                     )(params, x)
 
-                bwd = jax.jit(bwd)
+                bwd = ledgered_jit(bwd, site=f"mpmd/bwd_s{g}")
             else:
-                fwd = jax.jit(fwd_mid)
+                fwd = ledgered_jit(fwd_mid, site=f"mpmd/fwd_s{g}")
 
                 def bwd(params, x, dy, _f=fwd_mid):
                     _, vjp = jax.vjp(_f, params, x)
                     return vjp(dy)  # (dparams, dx)
 
-                bwd = jax.jit(bwd)
+                bwd = ledgered_jit(bwd, site=f"mpmd/bwd_s{g}")
             self._fwd.append(fwd)
             self._bwd.append(bwd)
 
-        self._acc_add = jax.jit(
-            lambda acc, g: jax.tree_util.tree_map(jnp.add, acc, g)
+        self._acc_add = ledgered_jit(
+            lambda acc, g: jax.tree_util.tree_map(jnp.add, acc, g),
+            site="mpmd/acc_add", arg_names=("acc", "grads"),
         )
-        self._zeros_like = jax.jit(
-            lambda p: jax.tree_util.tree_map(jnp.zeros_like, p)
+        self._zeros_like = ledgered_jit(
+            lambda p: jax.tree_util.tree_map(jnp.zeros_like, p),
+            site="mpmd/zeros_like", arg_names=("params",),
         )
         n = float(self.n_micro)
         tx = self.tx
@@ -227,7 +231,9 @@ class StageRunner:
             }
             return state.apply_gradients(grads, tx)
 
-        self._apply = jax.jit(apply_update, donate_argnums=(0,))
+        self._apply = ledgered_jit(
+            apply_update, site="mpmd/apply_update", donate_argnums=(0,)
+        )
         self._compiled = True
 
     # -- placement -----------------------------------------------------------

@@ -53,6 +53,8 @@ KNOBS: Tuple[EnvKnob, ...] = (
     EnvKnob("RLT_TELEMETRY_PEAK", True, "device peak-memory probe"),
     EnvKnob("RLT_HEARTBEAT_S", True, "live-plane beat cadence (0=off)"),
     EnvKnob("RLT_FLIGHT_RECORDER", True, "crash-bundle output gate"),
+    EnvKnob("RLT_PROGRAM_LEDGER", True,
+            "program-ledger kill switch (0/off = bare jax.jit)"),
     EnvKnob("RLT_LOG_RING", True, "forwarded-log ring size"),
     # -- chaos plane (fault/inject.py, worker-side) ----------------------
     EnvKnob("RLT_FAULT", True, "deterministic fault grammar"),

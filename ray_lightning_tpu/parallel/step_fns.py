@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_lightning_tpu.core.module import TpuModule, TrainState
+from ray_lightning_tpu.telemetry.program_ledger import ledgered_jit
 from . import sharding as shardlib
 
 __all__ = [
@@ -211,8 +212,9 @@ def build_train_step(
     if mesh is None:
         # Single-device path (driver-local smoke tests, ≙ non-distributed
         # Lightning fit).
-        return jax.jit(
-            _single_device_raw_step(module, tx), donate_argnums=0
+        return ledgered_jit(
+            _single_device_raw_step(module, tx), site="train/step",
+            arg_names=("state", "batch", "rng"), donate_argnums=0,
         )
 
     if mode == "gspmd":
@@ -226,8 +228,9 @@ def build_train_step(
 
         # in/out shardings: state keeps its (possibly ZeRO-sharded) layout,
         # batch arrives data-sharded, rng + metrics replicated.
-        step = jax.jit(
-            raw_step,
+        step = ledgered_jit(
+            raw_step, site="train/step",
+            arg_names=("state", "batch", "rng"),
             in_shardings=(state_shardings, batch_sh, repl),
             out_shardings=(state_shardings, repl),
             donate_argnums=0,
@@ -238,7 +241,10 @@ def build_train_step(
         sharded = _shard_map_raw_step(
             module, tx, mesh, zero_stage, state_shardings
         )
-        return jax.jit(sharded, donate_argnums=0)
+        return ledgered_jit(
+            sharded, site="train/step",
+            arg_names=("state", "batch", "rng"), donate_argnums=0,
+        )
 
     raise ValueError(f"Unknown step mode {mode!r} (expected gspmd|shard_map)")
 
@@ -315,15 +321,19 @@ def make_multi_step(
             last[key] = stacked[-1]
         return state, {"sum": sums, "cnt": cnts, "last": last}
 
+    megastep_names = ("state", "kbatch", "base_rng", "start")
     if mesh is None or mode == "shard_map":
-        return jax.jit(multi, donate_argnums=0)
+        return ledgered_jit(
+            multi, site=f"train/megastep_k{k}", arg_names=megastep_names,
+            donate_argnums=0,
+        )
 
     repl = shardlib.replicated(mesh)
     if state_shardings is None:
         state_shardings = repl
     kbatch_sh = shardlib.stacked_batch_sharding(mesh)
-    return jax.jit(
-        multi,
+    return ledgered_jit(
+        multi, site=f"train/megastep_k{k}", arg_names=megastep_names,
         in_shardings=(state_shardings, kbatch_sh, repl, repl),
         out_shardings=(state_shardings, repl),
         donate_argnums=0,
@@ -343,7 +353,10 @@ def build_eval_step(
     )
 
     if mesh is None:
-        return jax.jit(lambda params, batch: dict(step_method(params, batch)))
+        return ledgered_jit(
+            lambda params, batch: dict(step_method(params, batch)),
+            site=f"eval/{kind}", arg_names=("params", "batch"),
+        )
 
     if mode == "shard_map":
         from ray_lightning_tpu.utils.jax_compat import shard_map
@@ -364,7 +377,7 @@ def build_eval_step(
             logs = dict(step_method(params, batch))
             return jax.lax.pmean(logs, axis_name=data_axis)
 
-        return jax.jit(
+        return ledgered_jit(
             shard_map(
                 per_device,
                 mesh=mesh,
@@ -373,15 +386,17 @@ def build_eval_step(
                 # Outputs are pmean'd — replicated by construction; the
                 # inference-based checker can't always prove it.
                 check_vma=False,
-            )
+            ),
+            site=f"eval/{kind}", arg_names=("params", "batch"),
         )
 
     repl = shardlib.replicated(mesh)
     batch_sh = shardlib.batch_sharding(mesh)
     in_sh = (params_shardings if params_shardings is not None else repl,
              batch_sh)
-    return jax.jit(
+    return ledgered_jit(
         lambda params, batch: dict(step_method(params, batch)),
+        site=f"eval/{kind}", arg_names=("params", "batch"),
         in_shardings=in_sh,
         out_shardings=repl,
     )
@@ -398,11 +413,15 @@ def build_predict_step(
     its own slice (addressable shards) for driver-side concatenation.
     """
     if mesh is None:
-        return jax.jit(module.predict_step)
+        return ledgered_jit(
+            module.predict_step, site="eval/predict",
+            arg_names=("params", "batch"),
+        )
     repl = shardlib.replicated(mesh)
     batch_sh = shardlib.batch_sharding(mesh)
-    return jax.jit(
-        module.predict_step,
+    return ledgered_jit(
+        module.predict_step, site="eval/predict",
+        arg_names=("params", "batch"),
         in_shardings=(params_shardings if params_shardings is not None
                       else repl, batch_sh),
         out_shardings=batch_sh,

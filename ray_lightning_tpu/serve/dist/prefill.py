@@ -120,8 +120,10 @@ class PrefillRunner:
                                  adapters=ad, adapter_id=ad_id,
                                  lora_impl=lora_impl)
 
+        from ray_lightning_tpu.telemetry.program_ledger import ledgered_jit
+
         # One executable per bucket length, like the engine's set.
-        self._prefill_fn = jax.jit(_prefill)
+        self._prefill_fn = ledgered_jit(_prefill, site="serve/dist_prefill")
 
         def _suffix(params, pool, table_row, start, tokens, limit,
                     sample_idx, ad, ad_ids):
@@ -144,7 +146,7 @@ class PrefillRunner:
 
         # One executable per suffix bucket width (the same bounded set
         # the bucketed prefill compiles over).
-        self._suffix_fn = jax.jit(_suffix)
+        self._suffix_fn = ledgered_jit(_suffix, site="serve/dist_suffix")
         # Prefix-aware KV reuse on the worker: a dispatch whose prompt
         # shares a resident whole-block prefix claims those blocks by
         # refcount and computes ONLY the suffix — the export still

@@ -425,6 +425,7 @@ class ServeEngine:
             import_blocks, make_slot_keys, paged_decode_step,
             paged_prefill, paged_verify_step, sample_tokens,
         )
+        from ray_lightning_tpu.telemetry.program_ledger import ledgered_jit
 
         cfg, c = self.cfg, self._c
         base_key = jax.random.PRNGKey(self.config.seed)
@@ -476,18 +477,24 @@ class ServeEngine:
                 logits[None], keys, temp[None], top_k[None]
             )[0]
 
-        self._decode_fn = jax.jit(_decode, donate_argnums=donate)
+        self._decode_fn = ledgered_jit(
+            _decode, site="serve/decode", donate_argnums=donate
+        )
         # One python callable; XLA compiles one executable per bucket
-        # length (tokens/block_ids shapes) — the bucketed prefill set.
-        self._prefill_fn = jax.jit(_prefill, donate_argnums=donate)
+        # length (tokens/block_ids shapes) — the bucketed prefill set
+        # lands in the program ledger as one site with a variant per
+        # bucket.
+        self._prefill_fn = ledgered_jit(
+            _prefill, site="serve/prefill", donate_argnums=donate
+        )
         # Disaggregated KV import: one executable per bucket block
         # count (block_ids shape), mirroring the prefill set — fleet
         # warmup compiles them all, steady state never recompiles.
-        self._import_fn = jax.jit(
-            import_blocks,
+        self._import_fn = ledgered_jit(
+            import_blocks, site="serve/kv_import",
             donate_argnums=(0,) if jax.default_backend() == "tpu" else (),
         )
-        self._first_fn = jax.jit(_first)
+        self._first_fn = ledgered_jit(_first, site="serve/first_token")
 
         def _chunk_prefill(params, pool, table_row, start, tokens, limit,
                            sample_idx, temp, seed, top_k, ad, ad_ids):
@@ -521,7 +528,10 @@ class ServeEngine:
         # Compiled per chunk width: the fixed prefill_chunk width for
         # jobs plus one per bucket used by inline suffix computes — a
         # bounded set, warmed on first use like the prefill buckets.
-        self._chunk_fn = jax.jit(_chunk_prefill, donate_argnums=donate)
+        self._chunk_fn = ledgered_jit(
+            _chunk_prefill, site="serve/chunk_prefill",
+            donate_argnums=donate,
+        )
 
         if self.draft_module is None:
             return
@@ -580,10 +590,19 @@ class ServeEngine:
             )
             return dpool
 
-        self._draft_prefill_fn = jax.jit(_draft_prefill, donate_argnums=donate)
-        self._draft_step_fn = jax.jit(_draft_step, donate_argnums=donate)
-        self._draft_chunk_fn = jax.jit(_draft_chunk, donate_argnums=donate)
-        self._verify_fn = jax.jit(_verify, donate_argnums=donate)
+        self._draft_prefill_fn = ledgered_jit(
+            _draft_prefill, site="serve/draft_prefill",
+            donate_argnums=donate,
+        )
+        self._draft_step_fn = ledgered_jit(
+            _draft_step, site="serve/draft_step", donate_argnums=donate
+        )
+        self._draft_chunk_fn = ledgered_jit(
+            _draft_chunk, site="serve/draft_chunk", donate_argnums=donate
+        )
+        self._verify_fn = ledgered_jit(
+            _verify, site="serve/verify", donate_argnums=donate
+        )
         self._spec_width = K + 1
 
     # -- submission ----------------------------------------------------------
@@ -1496,6 +1515,10 @@ class ServeEngine:
             self._reply_handles.clear()
         for h in reply_handles:
             h.close()
+        # Final unthrottled export: a recompile or counter bump landing
+        # inside the last export_every_s window must still reach the
+        # prom file / serve-live.json before teardown.
+        self._maybe_export(force=True)
         if self._exporter is not None:
             self._exporter.close()
         if self._trace_dir is not None and self.tracer.events():
@@ -1792,16 +1815,21 @@ class ServeEngine:
         ``telemetry/schema.py::validate_serve_snapshot``)."""
         return self.stats.snapshot()
 
-    def _maybe_export(self) -> None:
+    def _maybe_export(self, force: bool = False) -> None:
         if self._exporter is None and self._live_path is None:
             return
         now = time.monotonic()
-        if now - self._last_export < self.config.export_every_s:
+        if not force and now - self._last_export < self.config.export_every_s:
             return
         self._last_export = now
         snap = self.snapshot()
+        # The program ledger rides every export: rlt_program_* gauges
+        # on the prom side, the programs pane on the rlt_top side.
+        from ray_lightning_tpu.telemetry import program_ledger
+
+        programs = program_ledger.snapshot()
         if self._exporter is not None:
-            self._exporter.update({"serve": snap})
+            self._exporter.update({"serve": snap, "programs": programs})
         if self._live_path is not None:
             import json
             import os
@@ -1809,7 +1837,8 @@ class ServeEngine:
             tmp = self._live_path + ".tmp"
             try:
                 with open(tmp, "w") as f:
-                    json.dump({"ts": snap["ts"], "serve": snap}, f)
+                    json.dump({"ts": snap["ts"], "serve": snap,
+                               "programs": programs}, f)
                 os.replace(tmp, self._live_path)
             except OSError:
                 pass  # a full disk must not take the serve loop down

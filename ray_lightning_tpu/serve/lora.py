@@ -160,7 +160,9 @@ class AdapterPool:
                 for k in buffers
             }
 
-        self._scatter_fn = jax.jit(_scatter)
+        from ray_lightning_tpu.telemetry.program_ledger import ledgered_jit
+
+        self._scatter_fn = ledgered_jit(_scatter, site="serve/lora_scatter")
         self._slots: Dict[str, int] = {}      # guarded by self._lock
         # LIFO free list, mirroring BlockAllocator: recently freed
         # slots re-issue first.

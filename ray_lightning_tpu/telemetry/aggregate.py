@@ -96,6 +96,12 @@ def merge_snapshots(snapshots: List[Dict[str, Any]]) -> Dict[str, Any]:
     meta = snaps[0].get("meta") or {}
     if meta:
         report["meta"] = dict(meta)
+    # Non-numeric step_stats fields fall out of the min/max/mean merge
+    # above; the MFU basis ("analytic" vs "measured") is the one a
+    # report reader needs to interpret the mfu view, so it rides meta.
+    basis = (snaps[0].get("step_stats") or {}).get("mfu_basis")
+    if basis:
+        report.setdefault("meta", {})["mfu_basis"] = basis
     return report
 
 

@@ -95,8 +95,83 @@ def render_openmetrics(snapshot: Dict[str, Any],
     mpmd = snapshot.get("mpmd")
     if mpmd:
         lines.extend(_render_mpmd(mpmd))
+    programs = snapshot.get("programs")
+    if programs:
+        lines.extend(_render_programs(programs))
     lines.append("# EOF")
     return "\n".join(lines) + "\n"
+
+
+def _render_programs(programs: Dict[str, Any]) -> list:
+    """The program ledger's section (``program_ledger.snapshot()``
+    shape — ``telemetry/schema.py::validate_program_snapshot``):
+    per-executable compile/cost/memory gauges labelled by dispatch
+    site and variant, plus recompile-forensics counters by delta
+    kind."""
+    lines = []
+    rows = programs.get("programs", [])
+    per_program = [
+        ("program_compile_seconds", "XLA compile wall time",
+         "compile_s"),
+        ("program_calls", "dispatches through this executable",
+         "ncalls"),
+        ("program_flops", "XLA cost-analysis FLOPs per dispatch",
+         "flops"),
+        ("program_bytes_accessed",
+         "XLA cost-analysis HBM bytes touched per dispatch",
+         "bytes_accessed"),
+        ("program_argument_bytes", "executable argument bytes",
+         "argument_bytes"),
+        ("program_output_bytes", "executable output bytes",
+         "output_bytes"),
+        ("program_temp_bytes", "executable scratch (temp) bytes",
+         "temp_bytes"),
+    ]
+    for metric, help_, key in per_program:
+        samples = [
+            ({"site": row.get("site"), "variant": row.get("variant")},
+             row[key])
+            for row in rows
+            if isinstance(row.get(key), (int, float))
+        ]
+        if not samples:
+            continue
+        lines.append(f"# TYPE {_PREFIX}_{metric} gauge")
+        lines.append(f"# HELP {_PREFIX}_{metric} {help_}")
+        for labels, value in samples:
+            label_s = ",".join(
+                f'{k}="{_esc(v)}"' for k, v in sorted(labels.items())
+            )
+            lines.append(f"{_PREFIX}_{metric}{{{label_s}}} {value}")
+    total_s = programs.get("compile_time_total_s")
+    if isinstance(total_s, (int, float)):
+        lines.append(
+            f"# TYPE {_PREFIX}_program_compile_time_total_seconds gauge"
+        )
+        lines.append(
+            f"# HELP {_PREFIX}_program_compile_time_total_seconds "
+            f"process-lifetime wall seconds inside XLA compiles"
+        )
+        lines.append(
+            f"{_PREFIX}_program_compile_time_total_seconds {total_s}"
+        )
+    recompiles: Dict[tuple, int] = {}
+    for event in programs.get("recompiles", []):
+        key = (event.get("site", "?"), event.get("kind", "?"))
+        recompiles[key] = recompiles.get(key, 0) + 1
+    if recompiles:
+        lines.append(f"# TYPE {_PREFIX}_program_recompiles counter")
+        lines.append(
+            f"# HELP {_PREFIX}_program_recompiles recompile events by "
+            f"site and delta kind (shape/dtype/structure/donation/"
+            f"static)"
+        )
+        for (site, kind), n in sorted(recompiles.items()):
+            lines.append(
+                f'{_PREFIX}_program_recompiles_total'
+                f'{{kind="{_esc(kind)}",site="{_esc(site)}"}} {n}'
+            )
+    return lines
 
 
 def _render_router(router: Dict[str, Any]) -> list:

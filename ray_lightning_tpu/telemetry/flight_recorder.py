@@ -224,6 +224,14 @@ class FlightRecorder:
                 doc["counters"] = counters
         if self._log_handler is not None:
             doc["logs"] = self._log_handler.records()
+        # Program ledger: the compiled-executable inventory plus the
+        # recompile-forensics ring — a crash that followed a surprise
+        # recompile names the offending argument right in the bundle.
+        from .program_ledger import snapshot as _ledger_snapshot
+
+        programs = _ledger_snapshot()
+        if programs.get("programs") or programs.get("recompiles"):
+            doc["programs"] = programs
         from .heartbeat import device_memory_stats
 
         mem = device_memory_stats()

@@ -92,6 +92,16 @@ def make_beat(rank: int, seq: int, ctx: Any,
             for key in ("step_time_ms", "data_wait_ms", "examples_per_sec"):
                 if key in headline:
                     beat[key] = round(float(headline[key]), 3)
+            # Wall seconds the process has spent inside XLA compiles:
+            # a rank wedged "compiling" reads as exactly that on the
+            # driver instead of as frozen progress.
+            total_s = getattr(stats, "_compile_s_at_start", None)
+            if total_s is not None:
+                from .step_stats import compile_time_total_s
+
+                beat["compile_total_s"] = round(
+                    compile_time_total_s(), 3
+                )
         tracer = getattr(telemetry, "tracer", None)
         open_span = getattr(tracer, "open_span", None)
         if open_span:

@@ -51,6 +51,10 @@ __all__ = [
     "validate_mpmd_xfer",
     "validate_mpmd_snapshot",
     "validate_bench_mpmd",
+    "validate_program_row",
+    "validate_recompile_record",
+    "validate_program_snapshot",
+    "validate_bench_programs",
     "FLIGHT_BUNDLE_SCHEMA_ID",
 ]
 
@@ -221,6 +225,7 @@ _HEARTBEAT_OPTIONAL = {
     "host_load": (int, float),   # 1-minute load average
     "done": bool,                # final beat before the publisher stops
     "trace": dict,               # optional trace-context envelope
+    "compile_total_s": (int, float),  # process XLA compile seconds so far
 }
 
 # Event: structured monitor/worker occurrences (stall, stack_dump,
@@ -287,6 +292,8 @@ _BUNDLE_OPTIONAL = {
     "stacks": str,        # all-thread py stacks at crash time
     "callback_metrics": dict,  # metrics at crash time (async log fetch
                                # flushed first — latest boundary landed)
+    "programs": dict,     # program-ledger snapshot (what was compiled,
+                          # what recompiled, and why — crash forensics)
 }
 
 
@@ -360,6 +367,154 @@ def validate_flight_bundle(doc: Any, where: str = "bundle") -> List[str]:
         )
     for i, span in enumerate(doc.get("spans", [])):
         problems += validate_span(span, f"{where}.spans[{i}]")
+    if "programs" in doc:
+        problems += validate_program_snapshot(
+            doc["programs"], f"{where}.programs"
+        )
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# Program ledger (telemetry/program_ledger.py): the compiled-executable
+# observatory — per-program cost/memory rows, recompile forensics, and
+# the bench ``programs`` block
+# ---------------------------------------------------------------------------
+
+# One compiled executable: identity + the XLA accounting captured at
+# first dispatch.  ``signature`` is the compact abstract-argument
+# rendering the recompile diff is computed over; accounting keys are
+# best-effort (a backend without cost_analysis still gets a row).
+_PROGRAM_ROW_REQUIRED = {
+    "site": str,          # stable call-site name, e.g. "serve/decode"
+    "variant": int,       # 0 = first compile at the site
+    "ncalls": int,
+    "compile_s": (int, float),   # measured lower()+compile() wall
+    "signature": str,
+}
+_PROGRAM_ROW_OPTIONAL = {
+    "backend": str,
+    "donated": str,                    # donate_argnums rendering
+    "flops": (int, float),             # cost_analysis
+    "bytes_accessed": (int, float),    # cost_analysis
+    "argument_bytes": int,             # memory_analysis
+    "output_bytes": int,
+    "temp_bytes": int,
+    "alias_bytes": int,
+    "generated_code_bytes": int,
+}
+
+#: The delta kinds a recompile attribution may carry.
+RECOMPILE_KINDS = ("shape", "dtype", "structure", "donation", "static")
+
+# A recompile attribution: which site, which argument, what changed.
+_RECOMPILE_REQUIRED = {
+    "type": str,          # always "recompile"
+    "site": str,
+    "kind": str,          # one of RECOMPILE_KINDS
+    "argument": str,      # offending argument (leaf path included)
+    "ts": (int, float),
+}
+_RECOMPILE_OPTIONAL = {
+    "old": str,
+    "new": str,
+    "variant": int,       # the variant index the recompile created
+    "rank": int,
+}
+
+# The full observatory snapshot (flight bundles, rlt_top, serve-live).
+_PROGRAM_SNAPSHOT_REQUIRED = {
+    "programs": list,
+    "recompiles": list,
+    "compile_time_total_s": (int, float),
+}
+_PROGRAM_SNAPSHOT_OPTIONAL = {
+    "dropped": int,       # rows past the ring cap
+}
+
+# The bench ``programs`` block: ledger coverage + the dispatch-overhead
+# A/B (``ledger_overhead_pct`` nullable — the probe is best-effort).
+_BENCH_PROGRAMS_REQUIRED = {
+    "n_programs": int,
+    "compile_time_total_s": (int, float),
+    "recompile_events": int,
+    "ledger_overhead_pct": (int, float, type(None)),
+}
+_BENCH_PROGRAMS_OPTIONAL = {
+    "rows": list,         # program rows (validate_program_row each)
+    "hbm": dict,          # program_ledger.hbm_report()
+    "roofline": dict,     # program_ledger.roofline(...)
+    "mfu_basis": str,     # "analytic" | "measured"
+    "dropped": int,
+}
+
+
+def validate_program_row(row: Any, where: str = "program") -> List[str]:
+    problems = _check_fields(
+        row, _PROGRAM_ROW_REQUIRED, _PROGRAM_ROW_OPTIONAL, where
+    )
+    if not problems:
+        if not row["site"]:
+            problems.append(f"{where}: empty site")
+        for key in ("variant", "ncalls", "compile_s"):
+            if row[key] < 0:
+                problems.append(f"{where}: negative {key} {row[key]}")
+    return problems
+
+
+def validate_recompile_record(rec: Any,
+                              where: str = "recompile") -> List[str]:
+    problems = _validate_typed(
+        rec, "recompile", _RECOMPILE_REQUIRED, _RECOMPILE_OPTIONAL, where
+    )
+    if not problems:
+        if rec["kind"] not in RECOMPILE_KINDS:
+            problems.append(
+                f"{where}: kind {rec['kind']!r} not in "
+                f"{RECOMPILE_KINDS}"
+            )
+        if not rec["argument"]:
+            problems.append(f"{where}: empty argument attribution")
+        if not rec["site"]:
+            problems.append(f"{where}: empty site")
+    return problems
+
+
+def validate_program_snapshot(snap: Any,
+                              where: str = "programs") -> List[str]:
+    problems = _check_fields(
+        snap, _PROGRAM_SNAPSHOT_REQUIRED, _PROGRAM_SNAPSHOT_OPTIONAL, where
+    )
+    if problems:
+        return problems
+    for i, row in enumerate(snap["programs"]):
+        problems += validate_program_row(row, f"{where}.programs[{i}]")
+    for i, rec in enumerate(snap["recompiles"]):
+        problems += validate_recompile_record(
+            rec, f"{where}.recompiles[{i}]"
+        )
+    if snap["compile_time_total_s"] < 0:
+        problems.append(f"{where}: negative compile_time_total_s")
+    return problems
+
+
+def validate_bench_programs(block: Any,
+                            where: str = "programs") -> List[str]:
+    """Validate the ``programs`` block of a ``BENCH_*.json`` artifact
+    (absent on pre-ledger rounds)."""
+    problems = _check_fields(
+        block, _BENCH_PROGRAMS_REQUIRED, _BENCH_PROGRAMS_OPTIONAL, where
+    )
+    if problems:
+        return problems
+    if block["n_programs"] < 0:
+        problems.append(f"{where}: negative n_programs")
+    if block["recompile_events"] < 0:
+        problems.append(f"{where}: negative recompile_events")
+    basis = block.get("mfu_basis")
+    if basis is not None and basis not in ("analytic", "measured"):
+        problems.append(f"{where}: invalid mfu_basis {basis!r}")
+    for i, row in enumerate(block.get("rows", [])):
+        problems += validate_program_row(row, f"{where}.rows[{i}]")
     return problems
 
 

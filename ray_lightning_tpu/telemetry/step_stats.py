@@ -39,6 +39,7 @@ __all__ = [
     "flops_for_module",
     "peak_flops_per_chip",
     "compile_event_count",
+    "compile_time_total_s",
 ]
 
 
@@ -137,6 +138,7 @@ def peak_flops_per_chip() -> Optional[float]:
 # per-listener deregistration (clear_event_listeners drops EVERYTHING),
 # so a listener per StepStats would accumulate across tuner-sweep fits.
 _COMPILES = [0]
+_COMPILE_S = [0.0]
 _LISTENER = [False]
 _COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
@@ -149,6 +151,7 @@ def _install_listener() -> None:
     def _on_duration(event: str, duration: float, **kw) -> None:
         if event == _COMPILE_EVENT:
             _COMPILES[0] += 1
+            _COMPILE_S[0] += float(duration)
 
     jax.monitoring.register_event_duration_secs_listener(_on_duration)
     _LISTENER[0] = True
@@ -165,6 +168,16 @@ def compile_event_count() -> int:
     "zero recompiles" against a counter that was never counting."""
     _install_listener()
     return _COMPILES[0]
+
+
+def compile_time_total_s() -> float:
+    """Process-lifetime seconds spent inside XLA backend compiles (the
+    duration side of the same jax.monitoring event
+    :func:`compile_event_count` counts).  Heartbeats and the StepStats
+    report surface it so a fleet whose wall time is going to the
+    compiler says so instead of reading as slow steps."""
+    _install_listener()
+    return _COMPILE_S[0]
 
 
 # ---------------------------------------------------------------------------
@@ -232,10 +245,14 @@ class StepStats:
         self.sample_every = sample_every
         self.flops_per_example = flops_per_example
         self.tokens_per_example = tokens_per_example
+        self.measured_flops_per_example: Optional[float] = None
+        self.mfu_basis = "analytic"
+        self._drift_warned = False
         self.peak_flops = peak_flops
         self.n_chips = max(int(n_chips), 1)
         _install_listener()
         self._compiles_at_start = compile_event_count()
+        self._compile_s_at_start = compile_time_total_s()
         self.compile_ms: Optional[float] = None
         self.steps = 0
         self.examples = 0
@@ -256,6 +273,34 @@ class StepStats:
             self.tokens_per_example = tpe
         if self.peak_flops is None:
             self.peak_flops = peak_flops_per_chip()
+
+    def configure_measured_flops(self, flops_per_example: float) -> None:
+        """Adopt the program ledger's XLA-measured FLOPs as the MFU
+        numerator (``mfu_basis`` flips to ``"measured"``).  The drift
+        guard fires once when the measured number disagrees with the
+        analytic ``model_flops_per_token`` accounting by more than 10%
+        — either the hand-written model drifted from the architecture,
+        or XLA is executing work the model does not charge (remat,
+        padding); both mean the published MFU needs a second look."""
+        if flops_per_example <= 0:
+            return
+        analytic = self.flops_per_example
+        if analytic and not self._drift_warned:
+            drift = abs(flops_per_example - analytic) / analytic
+            if drift > 0.10:
+                self._drift_warned = True
+                import logging
+
+                logging.getLogger(
+                    "ray_lightning_tpu.telemetry"
+                ).warning(
+                    "MFU drift: ledger-measured FLOPs/example %.3e vs "
+                    "analytic %.3e (%.1f%% apart) — MFU now reports on "
+                    "the measured basis",
+                    flops_per_example, analytic, 100.0 * drift,
+                )
+        self.measured_flops_per_example = float(flops_per_example)
+        self.mfu_basis = "measured"
 
     # -- per-step feed ------------------------------------------------------
     def should_sample(self) -> bool:
@@ -376,15 +421,17 @@ class StepStats:
         return out
 
     def mfu(self) -> Optional[float]:
-        """Analytic-FLOPs model FLOPs utilisation vs the chip's dense
-        peak, ``None`` when either side is unknown."""
-        if not (self.flops_per_example and self.peak_flops):
+        """Model-FLOPs utilisation vs the chip's dense peak, ``None``
+        when either side is unknown.  The numerator is the ledger's
+        XLA-measured FLOPs when :meth:`configure_measured_flops` ran
+        (``mfu_basis == "measured"``), the analytic model otherwise."""
+        fpe = self.measured_flops_per_example or self.flops_per_example
+        if not (fpe and self.peak_flops):
             return None
         tp = self.throughput().get("examples_per_sec")
         if not tp:
             return None
-        return (tp * self.flops_per_example
-                / (self.peak_flops * self.n_chips))
+        return tp * fpe / (self.peak_flops * self.n_chips)
 
     def memory_stats(self) -> Dict[str, float]:
         """Device memory stats where the backend exposes them."""
@@ -434,6 +481,13 @@ class StepStats:
             out["tokens"] = self.tokens
         if self.compile_ms is not None:
             out["compile_ms"] = self.compile_ms
+        # XLA-reported compile seconds for THIS fit (jax.monitoring
+        # durations, satellite of the program ledger): compile_ms above
+        # is the step-0 wall, this is the compiler's own accounting —
+        # including mid-fit lazy programs that never dominate a step.
+        compile_s = compile_time_total_s() - self._compile_s_at_start
+        if compile_s > 0:
+            out["compile_total_s"] = round(compile_s, 6)
         for name, agg in (("step", self._step),
                           ("data_wait", self._data_wait),
                           ("dispatch", self._dispatch),
@@ -444,6 +498,7 @@ class StepStats:
         m = self.mfu()
         if m is not None:
             out["mfu"] = m
+            out["mfu_basis"] = self.mfu_basis
         mem = self.memory_stats()
         if mem:
             out["memory"] = mem

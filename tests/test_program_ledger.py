@@ -1,0 +1,366 @@
+"""Program ledger tests (ISSUE 17): executable registration with cost
+and memory accounting, recompile forensics with argument-level
+attribution, dispatch behavior (MRU fast path, variant reuse, tracer
+fallback), the MFU drift guard, the kill switch, and the derived
+HBM/roofline reports.
+
+All tests use a private :class:`ProgramLedger` registry so they neither
+see nor pollute the process-global ledger other suites dispatch into.
+"""
+
+import logging
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ray_lightning_tpu.telemetry.program_ledger import (
+    LedgeredFunction,
+    ProgramLedger,
+    hbm_report,
+    ledger,
+    ledgered_jit,
+    roofline,
+)
+from ray_lightning_tpu.telemetry.schema import (
+    validate_program_snapshot,
+    validate_recompile_record,
+)
+from ray_lightning_tpu.telemetry.step_stats import (
+    StepStats,
+    compile_event_count,
+)
+
+
+def _double(x):
+    return x * 2.0 + 1.0
+
+
+def _tree_sum(state):
+    return sum(jnp.sum(v) for v in state.values())
+
+
+# ---------------------------------------------------------------------------
+# Registration: identity, cost, memory
+# ---------------------------------------------------------------------------
+
+class TestRegistration:
+    def test_first_dispatch_registers_program(self):
+        reg = ProgramLedger()
+        fn = LedgeredFunction(_double, "test/double", registry=reg)
+        out = fn(jnp.ones((8,), jnp.float32))
+        assert float(out[0]) == 3.0
+        snap = reg.snapshot()
+        assert len(snap["programs"]) == 1
+        row = snap["programs"][0]
+        assert row["site"] == "test/double"
+        assert row["variant"] == 0
+        assert row["ncalls"] == 1
+        assert row["compile_s"] > 0.0
+        assert "f32[8]" in row["signature"]
+        assert snap["recompiles"] == []
+
+    def test_cost_and_memory_rows(self):
+        # The acceptance bar: every registered program carries
+        # cost_analysis FLOPs and memory_analysis byte accounting
+        # (present on the CPU backend this suite runs on).
+        reg = ProgramLedger()
+        fn = LedgeredFunction(_double, "test/cost", registry=reg)
+        fn(jnp.ones((16, 4), jnp.float32))
+        row = reg.snapshot()["programs"][0]
+        assert row["flops"] > 0
+        assert row["argument_bytes"] > 0
+        assert row["output_bytes"] > 0
+        assert "temp_bytes" in row
+
+    def test_snapshot_is_schema_valid(self):
+        reg = ProgramLedger()
+        fn = LedgeredFunction(_double, "test/schema", registry=reg)
+        fn(jnp.ones((4,), jnp.float32))
+        fn(jnp.ones((8,), jnp.float32))  # one recompile on the ring
+        assert validate_program_snapshot(reg.snapshot()) == []
+
+    def test_compile_time_total_and_event_count(self):
+        reg = ProgramLedger()
+        fn = LedgeredFunction(_double, "test/compile", registry=reg)
+        before = compile_event_count()
+        fn(jnp.ones((32,), jnp.float32))
+        assert compile_event_count() >= before + 1
+        assert reg.compile_time_total_s() > 0.0
+        assert reg.snapshot()["compile_time_total_s"] > 0.0
+
+    def test_donation_recorded(self):
+        reg = ProgramLedger()
+        fn = LedgeredFunction(_double, "test/donate", registry=reg,
+                              donate_argnums=0)
+        fn(jnp.ones((8,), jnp.float32))
+        row = reg.snapshot()["programs"][0]
+        assert row["donated"] == "(0,)"
+
+
+# ---------------------------------------------------------------------------
+# Dispatch: MRU fast path, variant reuse, tracer fallback
+# ---------------------------------------------------------------------------
+
+class TestDispatch:
+    def test_repeat_calls_one_variant(self):
+        reg = ProgramLedger()
+        fn = LedgeredFunction(_double, "test/mru", registry=reg)
+        x = jnp.ones((8,), jnp.float32)
+        for _ in range(5):
+            fn(x)
+        assert fn.variants == 1
+        snap = reg.snapshot()
+        assert len(snap["programs"]) == 1
+        assert snap["programs"][0]["ncalls"] == 5
+        assert snap["recompiles"] == []
+
+    def test_alternating_shapes_compile_once_each(self):
+        # Bucketed dispatch: two shapes alternate.  Each compiles once;
+        # flipping between existing variants is a cache hit, not a
+        # recompile — exactly one forensics event total.
+        reg = ProgramLedger()
+        fn = LedgeredFunction(_double, "test/buckets", registry=reg)
+        a = jnp.ones((8,), jnp.float32)
+        b = jnp.ones((16,), jnp.float32)
+        for _ in range(3):
+            fn(a)
+            fn(b)
+        assert fn.variants == 2
+        assert len(reg.snapshot()["recompiles"]) == 1
+
+    def test_tracer_fallback_inlines(self):
+        # Invoked under an enclosing trace, the wrapper must fall back
+        # to the plain jit (a Compiled cannot take tracers) and must
+        # NOT mint a ledger entry for the inlined call.
+        reg = ProgramLedger()
+        fn = LedgeredFunction(_double, "test/traced", registry=reg)
+
+        @jax.jit
+        def outer(x):
+            return fn(x) + 1.0
+
+        out = outer(jnp.ones((4,), jnp.float32))
+        assert float(out[0]) == 4.0
+        assert fn.variants == 0
+        assert reg.snapshot()["programs"] == []
+
+    def test_static_argnums_variant_per_value(self):
+        reg = ProgramLedger()
+        fn = LedgeredFunction(lambda n, x: x * n, "test/static",
+                              registry=reg, static_argnums=0,
+                              arg_names=("n", "x"))
+        x = jnp.ones((4,), jnp.float32)
+        assert float(fn(2, x)[0]) == 2.0
+        assert float(fn(3, x)[0]) == 3.0
+        assert float(fn(2, x)[0]) == 2.0   # reuses the first variant
+        assert fn.variants == 2
+        recs = reg.snapshot()["recompiles"]
+        assert len(recs) == 1
+        assert recs[0]["kind"] == "static"
+
+
+# ---------------------------------------------------------------------------
+# Recompile forensics: attribution names the offending argument
+# ---------------------------------------------------------------------------
+
+class TestRecompileForensics:
+    def _events(self, reg):
+        recs = reg.snapshot()["recompiles"]
+        for rec in recs:
+            assert validate_recompile_record(rec) == []
+        return recs
+
+    def test_shape_change_attribution(self):
+        reg = ProgramLedger()
+        fn = LedgeredFunction(_double, "test/shape", registry=reg)
+        fn(jnp.ones((8,), jnp.float32))
+        fn(jnp.ones((16,), jnp.float32))
+        (rec,) = self._events(reg)
+        assert rec["kind"] == "shape"
+        assert rec["argument"] == "x"
+        assert "f32[8]" in rec["old"]
+        assert "f32[16]" in rec["new"]
+        assert rec["variant"] == 1
+
+    def test_dtype_change_attribution(self):
+        reg = ProgramLedger()
+        fn = LedgeredFunction(_double, "test/dtype", registry=reg)
+        fn(jnp.ones((8,), jnp.float32))
+        fn(jnp.ones((8,), jnp.int32))
+        (rec,) = self._events(reg)
+        assert rec["kind"] == "dtype"
+        assert rec["argument"] == "x"
+
+    def test_structure_change_attribution(self):
+        reg = ProgramLedger()
+        fn = LedgeredFunction(_tree_sum, "test/tree", registry=reg)
+        fn({"a": jnp.ones((4,), jnp.float32)})
+        fn({"a": jnp.ones((4,), jnp.float32),
+            "b": jnp.ones((4,), jnp.float32)})
+        (rec,) = self._events(reg)
+        assert rec["kind"] == "structure"
+        assert rec["argument"] == "state"
+
+    def test_leaf_level_attribution_in_pytree(self):
+        # A shape change on ONE leaf of a pytree names that leaf, not
+        # just the whole argument — the forensics must say which param.
+        reg = ProgramLedger()
+        fn = LedgeredFunction(_tree_sum, "test/leaf", registry=reg)
+        fn({"w": jnp.ones((4, 4), jnp.float32),
+            "b": jnp.ones((4,), jnp.float32)})
+        fn({"w": jnp.ones((8, 4), jnp.float32),
+            "b": jnp.ones((4,), jnp.float32)})
+        (rec,) = self._events(reg)
+        assert rec["kind"] == "shape"
+        assert "w" in rec["argument"]
+        assert "b" not in rec["argument"]
+
+    def test_recompile_warns_and_fans_out(self, caplog):
+        reg = ProgramLedger()
+        captured = []
+        reg.add_emitter(captured.append)
+        try:
+            fn = LedgeredFunction(_double, "test/emit", registry=reg)
+            fn(jnp.ones((8,), jnp.float32))
+            with caplog.at_level(
+                logging.WARNING,
+                logger="ray_lightning_tpu.program_ledger",
+            ):
+                fn(jnp.ones((16,), jnp.float32))
+        finally:
+            reg.remove_emitter(captured.append)
+        assert any("recompile at test/emit" in r.getMessage()
+                   for r in caplog.records)
+        assert len(captured) == 1
+        assert captured[0]["type"] == "recompile"
+        assert captured[0]["site"] == "test/emit"
+
+
+# ---------------------------------------------------------------------------
+# Kill switch + global registration path
+# ---------------------------------------------------------------------------
+
+class TestWiring:
+    def test_kill_switch_returns_bare_jit(self, monkeypatch):
+        monkeypatch.setenv("RLT_PROGRAM_LEDGER", "0")
+        fn = ledgered_jit(_double, site="test/killed")
+        assert not isinstance(fn, LedgeredFunction)
+        assert float(fn(jnp.ones((4,), jnp.float32))[0]) == 3.0
+
+    def test_ledgered_jit_registers_globally(self):
+        fn = ledgered_jit(_double, site="test/global_site")
+        assert isinstance(fn, LedgeredFunction)
+        fn(jnp.ones((8,), jnp.float32))
+        sites = {r["site"] for r in ledger().snapshot()["programs"]}
+        assert "test/global_site" in sites
+
+    def test_site_flops_prefers_most_called(self):
+        reg = ProgramLedger()
+        fn = LedgeredFunction(_double, "test/flops", registry=reg)
+        a = jnp.ones((8,), jnp.float32)
+        b = jnp.ones((64,), jnp.float32)
+        fn(a)
+        for _ in range(3):
+            fn(b)
+        flops = reg.site_flops("test/flops")
+        rows = {r["variant"]: r for r in reg.snapshot()["programs"]}
+        assert flops == rows[1]["flops"]  # the (64,) variant dominates
+
+    def test_reset_clears_observatory_not_variants(self):
+        reg = ProgramLedger()
+        fn = LedgeredFunction(_double, "test/reset", registry=reg)
+        x = jnp.ones((8,), jnp.float32)
+        fn(x)
+        reg.reset()
+        assert reg.snapshot()["programs"] == []
+        fn(x)  # live variant survives: no recompile, no new record
+        assert fn.variants == 1
+        assert reg.snapshot()["programs"] == []
+
+
+# ---------------------------------------------------------------------------
+# Derived reports: HBM budget + roofline
+# ---------------------------------------------------------------------------
+
+class TestReports:
+    def test_hbm_report_peaks(self):
+        reg = ProgramLedger()
+        small = LedgeredFunction(_double, "test/small", registry=reg)
+        big = LedgeredFunction(_double, "test/big", registry=reg)
+        small(jnp.ones((8,), jnp.float32))
+        big(jnp.ones((4096,), jnp.float32))
+        report = hbm_report(reg.snapshot())
+        assert set(report["sites"]) == {"test/small", "test/big"}
+        assert (report["peak_argument_bytes"]
+                == report["sites"]["test/big"]["argument_bytes"])
+
+    def test_roofline_placement(self):
+        reg = ProgramLedger()
+        fn = LedgeredFunction(_double, "test/roof", registry=reg)
+        fn(jnp.ones((128,), jnp.float32))
+        roof = roofline("test/roof", peak_flops=1e12,
+                        peak_bytes_per_s=1e11, snap=reg.snapshot())
+        assert roof is not None
+        assert roof["flops"] > 0
+        assert roof["arithmetic_intensity"] == pytest.approx(
+            roof["flops"] / roof["bytes_accessed"]
+        )
+        assert roof["ridge_intensity"] == pytest.approx(10.0)
+        assert roof["bound"] in ("compute", "memory")
+
+    def test_roofline_unknown_site_is_none(self):
+        assert roofline("test/nope", snap={"programs": []}) is None
+
+    def test_site_flops_latest_tracks_most_recent_compile(self):
+        # The loop's measured-MFU basis must read the program that just
+        # compiled; most-called would leak an earlier fit's variant in
+        # a long-lived process.
+        reg = ProgramLedger()
+        fn = LedgeredFunction(_double, "test/latest", registry=reg)
+        for _ in range(5):
+            fn(jnp.ones((8,), jnp.float32))    # most-called variant
+        fn(jnp.ones((1024,), jnp.float32))     # most recent compile
+        most_called = reg.site_flops("test/latest")
+        latest = reg.site_flops_latest("test/latest")
+        assert most_called is not None and latest is not None
+        assert latest > most_called
+        assert reg.site_flops_latest("test/nope") is None
+
+
+# ---------------------------------------------------------------------------
+# MFU drift guard (ledger-measured vs analytic FLOPs)
+# ---------------------------------------------------------------------------
+
+class TestMfuDriftGuard:
+    def test_drift_beyond_10pct_warns(self, caplog):
+        stats = StepStats(flops_per_example=100.0, peak_flops=1e12)
+        with caplog.at_level(
+            logging.WARNING,
+            logger="ray_lightning_tpu.telemetry",
+        ):
+            stats.configure_measured_flops(150.0)
+        assert stats.mfu_basis == "measured"
+        assert any("MFU drift" in r.getMessage() for r in caplog.records)
+
+    def test_small_drift_is_silent(self, caplog):
+        stats = StepStats(flops_per_example=100.0, peak_flops=1e12)
+        with caplog.at_level(
+            logging.WARNING,
+            logger="ray_lightning_tpu.telemetry",
+        ):
+            stats.configure_measured_flops(105.0)
+        assert stats.mfu_basis == "measured"
+        assert not any("MFU drift" in r.getMessage()
+                       for r in caplog.records)
+
+    def test_summary_carries_basis(self):
+        stats = StepStats(flops_per_example=100.0, peak_flops=1e12)
+        # step 0 is booked as compile; steady-state steps feed the
+        # throughput the MFU (and with it, mfu_basis) hangs off.
+        for _ in range(4):
+            stats.record_step(0.01, 0.0, 0.001, examples=8)
+        assert stats.summary().get("mfu_basis") == "analytic"
+        stats.configure_measured_flops(101.0)
+        assert stats.summary().get("mfu_basis") == "measured"

@@ -303,12 +303,16 @@ def test_fit_records_headline_metrics(tmp_path):
 
 def test_gpt_fit_records_tokens_and_mfu(tmp_path, monkeypatch):
     """Acceptance: the GPT family additionally gets tokens/sec and an
-    analytic MFU (peak pinned via the env override on CPU)."""
+    MFU (peak pinned via the env override on CPU).  With the program
+    ledger live the numerator flips to XLA's measured cost_analysis
+    FLOPs (basis "measured"); the consistency check follows the basis
+    the report declares."""
     from ray_lightning_tpu.models.gpt import (
         GPT,
         GPTConfig,
         SyntheticLMDataModule,
     )
+    from ray_lightning_tpu.telemetry import program_ledger
 
     monkeypatch.setenv("RLT_TELEMETRY_PEAK", "1e12")
     cfg = GPTConfig.tiny()
@@ -321,9 +325,19 @@ def test_gpt_fit_records_tokens_and_mfu(tmp_path, monkeypatch):
     cm = trainer.callback_metrics
     assert cm["tokens_per_sec"] > 0
     assert "mfu" in cm and 0 < cm["mfu"]
-    # MFU consistency with the shared analytic accounting.
-    expected = (cm["examples_per_sec"]
-                * model_flops_per_token(cfg) * cfg.seq_len / 1e12)
+    # MFU consistency with the accounting basis the report declares:
+    # measured = this fit's train/step cost_analysis FLOPs per example,
+    # analytic = the shared per-token model.
+    meta = (trainer.telemetry_report or {}).get("meta") or {}
+    if meta.get("mfu_basis") == "measured":
+        site_flops = program_ledger.ledger().site_flops_latest(
+            "train/step"
+        )
+        assert site_flops is not None
+        flops_per_example = site_flops / 8  # batch_size above
+    else:
+        flops_per_example = model_flops_per_token(cfg) * cfg.seq_len
+    expected = cm["examples_per_sec"] * flops_per_example / 1e12
     n_chips = jax.local_device_count()
     assert cm["mfu"] == pytest.approx(expected / n_chips, rel=1e-6)
 

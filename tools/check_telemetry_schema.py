@@ -36,6 +36,7 @@ from ray_lightning_tpu.telemetry.schema import (  # noqa: E402
     validate_bench_multi_lora,
     validate_bench_opt_state,
     validate_bench_prefix_cache,
+    validate_bench_programs,
     validate_bench_residual_policy,
     validate_bench_serve,
     validate_bench_serve_disagg,
@@ -46,6 +47,8 @@ from ray_lightning_tpu.telemetry.schema import (  # noqa: E402
     validate_flight_bundle,
     validate_mpmd_snapshot,
     validate_mpmd_xfer,
+    validate_program_snapshot,
+    validate_recompile_record,
     validate_router_snapshot,
     validate_serve_kv_handoff,
     validate_serve_reply,
@@ -183,6 +186,144 @@ def _self_test_live_plane(tmp: str) -> list:
     problems += _self_test_serve()
     problems += _self_test_mpmd()
     problems += _self_test_trace()
+    problems += _self_test_programs()
+    return problems
+
+
+def _self_test_programs() -> list:
+    """Program-ledger producers vs their schema, jax-free: a REAL
+    ``ProgramLedger`` fed a record plus a ``diff_signatures``
+    attribution must snapshot schema-valid, with the attribution
+    naming the changed argument; then negatives (an unknown delta
+    kind, a missing attribution, a negative compile wall, an unknown
+    row key, a bench block without its overhead A/B) must FAIL."""
+    from ray_lightning_tpu.telemetry.program_ledger import (
+        ArgSig, ProgramLedger, ProgramRecord, Signature, diff_signatures,
+    )
+
+    problems = []
+    old = Signature(
+        args=(
+            ArgSig("state", "PyTreeDef({'p': *})",
+                   (("['p']", (8,), "float32"),)),
+            ArgSig("batch", "PyTreeDef(*)", (("", (4, 2), "float32"),)),
+        ),
+        statics=(), donate=(0,),
+    )
+    # shape delta on state['p']
+    new = old._replace(args=(
+        old.args[0]._replace(leaves=(("['p']", (16,), "float32"),)),
+        old.args[1],
+    ))
+    diff = diff_signatures(old, new)
+    if diff["kind"] != "shape" or diff["argument"] != "state['p']":
+        problems.append(
+            f"self-test programs: shape delta misattributed ({diff})"
+        )
+    # dtype delta on batch
+    diff = diff_signatures(old, old._replace(args=(
+        old.args[0],
+        old.args[1]._replace(leaves=(("", (4, 2), "bfloat16"),)),
+    )))
+    if diff["kind"] != "dtype" or diff["argument"] != "batch":
+        problems.append(
+            f"self-test programs: dtype delta misattributed ({diff})"
+        )
+    # structure delta (treedef change on state)
+    diff = diff_signatures(old, old._replace(args=(
+        old.args[0]._replace(treedef="PyTreeDef({'p': *, 'q': *})"),
+        old.args[1],
+    )))
+    if diff["kind"] != "structure" or diff["argument"] != "state":
+        problems.append(
+            f"self-test programs: structure delta misattributed ({diff})"
+        )
+    # donation delta
+    diff = diff_signatures(old, old._replace(donate=()))
+    if diff["kind"] != "donation":
+        problems.append(
+            f"self-test programs: donation delta misattributed ({diff})"
+        )
+
+    # A real ledger round-trip: record + recompile -> schema-valid snap.
+    reg = ProgramLedger()
+    reg.record_program(
+        ProgramRecord(site="train/step", variant=0,
+                      signature="state:f32[8]|batch:f32[4,2]",
+                      compile_s=0.25, backend="cpu", ncalls=3,
+                      flops=1.0e6, bytes_accessed=2.0e6,
+                      argument_bytes=64, output_bytes=32,
+                      temp_bytes=16),
+        old,
+    )
+    # The forensics warning is real-recompile UX; a self-test-induced
+    # "recompile at train/step" line in format.sh output is a false
+    # alarm for whoever reads the gate log.
+    import logging
+
+    ledger_log = logging.getLogger("ray_lightning_tpu.program_ledger")
+    ledger_log.disabled = True
+    try:
+        reg.record_recompile(
+            "train/step", diff_signatures(old, new), variant=1
+        )
+    finally:
+        ledger_log.disabled = False
+    snap = reg.snapshot()
+    problems += validate_program_snapshot(snap, "self-test programs snap")
+    rec = snap["recompiles"][0]
+    if rec["argument"] != "state['p']" or rec["kind"] != "shape":
+        problems.append(
+            "self-test programs: ledger recompile record lost the "
+            f"attribution ({rec})"
+        )
+
+    # Negatives: a drifted producer must not validate.
+    if not validate_recompile_record(
+        {**rec, "kind": "weather"}
+    ):
+        problems.append(
+            "self-test programs: validator accepted an unknown delta "
+            "kind"
+        )
+    if not validate_recompile_record({**rec, "argument": ""}):
+        problems.append(
+            "self-test programs: validator accepted an empty argument "
+            "attribution"
+        )
+    bad = json_roundtrip(snap)
+    bad["programs"][0]["compile_s"] = -1.0
+    if not validate_program_snapshot(bad):
+        problems.append(
+            "self-test programs: validator accepted a negative compile "
+            "wall"
+        )
+    bad = json_roundtrip(snap)
+    bad["programs"][0]["flavor"] = "vanilla"
+    if not validate_program_snapshot(bad):
+        problems.append(
+            "self-test programs: validator accepted an unknown row key"
+        )
+
+    block = {
+        "n_programs": 2, "compile_time_total_s": 1.5,
+        "recompile_events": 1, "ledger_overhead_pct": 0.02,
+        "rows": snap["programs"], "hbm": {"sites": {}},
+        "mfu_basis": "measured",
+    }
+    problems += validate_bench_programs(block, "self-test bench programs")
+    if not validate_bench_programs(
+        {k: v for k, v in block.items() if k != "ledger_overhead_pct"}
+    ):
+        problems.append(
+            "self-test bench programs: validator accepted a block "
+            "missing the overhead A/B"
+        )
+    if not validate_bench_programs({**block, "mfu_basis": "vibes"}):
+        problems.append(
+            "self-test bench programs: validator accepted an unknown "
+            "mfu basis"
+        )
     return problems
 
 
@@ -1042,6 +1183,11 @@ def scan_bench_files() -> list:
         if residual is not None:  # pre-HBM-diet rounds lack it
             problems += validate_bench_residual_policy(
                 residual, f"{name}:residual_policy"
+            )
+        programs = doc.get("programs")
+        if programs is not None:  # pre-ledger rounds lack it
+            problems += validate_bench_programs(
+                programs, f"{name}:programs"
             )
     return problems
 

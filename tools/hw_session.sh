@@ -128,4 +128,18 @@ for w in 512 1024 2048; do
     2>&1 | tee "tools/hw_logs/${stamp}_bench_longctx_chunk_w${w}.log"
 done
 
+log "program ledger: TPU cost/memory inventory + overhead A/B (programs block)"
+# On real chips the ledger's cost_analysis FLOPs and memory_analysis
+# HBM rows come from the TPU compiler (the numbers the roofline MFU
+# cross-check and hbm_report size against — CPU runs only validate
+# plumbing); bench.py's internal A/B re-times the cheap-tier headline
+# with RLT_PROGRAM_LEDGER=0 vs 1, and the dispatch overhead must stay
+# below noise against ~ms device steps.  The explicit off-arm run
+# gives the whole-session sanity check that the observatory never
+# shows up in the headline.
+timeout 1800 python bench.py \
+  2>&1 | tee "tools/hw_logs/${stamp}_bench_ledger_on.log"
+RLT_PROGRAM_LEDGER=0 timeout 1800 python bench.py \
+  2>&1 | tee "tools/hw_logs/${stamp}_bench_ledger_off.log"
+
 log "done — logs in tools/hw_logs/${stamp}_*.log"

@@ -166,6 +166,7 @@ def _fixture_config(src: str, relname: str) -> Config:
     hot_jit: Dict[str, frozenset] = {}
     hot_sync: Dict[str, frozenset] = {}
     wall, perf, envl = set(), set(), set()
+    ledger_paths: List[str] = []
     producers: Dict[str, Dict[str, str]] = {}
     schema_keys: Dict[str, Tuple[frozenset, frozenset]] = {}
     env_registry = {"RLT_KNOWN"}
@@ -205,6 +206,8 @@ def _fixture_config(src: str, relname: str) -> Config:
             schema_keys[prefix] = (req, opt)
         elif kind == "env-registry":
             env_registry.update(rest)
+        elif kind == "ledger-scope":
+            ledger_paths.append(relname)
         else:
             raise ValueError(f"unknown fixture directive {kind!r}")
     return Config(
@@ -214,6 +217,7 @@ def _fixture_config(src: str, relname: str) -> Config:
         trace_envelope_files=frozenset(envl),
         schema_producers=producers, schema_keys=schema_keys,
         env_registry=frozenset(env_registry),
+        ledger_paths=tuple(ledger_paths),
     )
 
 
@@ -268,7 +272,7 @@ def selftest() -> int:
         for p in problems:
             print(f"rlt_lint selftest: {p}", file=sys.stderr)
             failed = True
-    missing = {f"RLT{i:03d}" for i in range(8)} - rules_seen
+    missing = {f"RLT{i:03d}" for i in range(9)} - rules_seen
     if missing:
         print(
             f"rlt_lint selftest: no fixture exercises "

@@ -34,6 +34,7 @@ RULES = {
     "RLT005": "RLT_* env read missing from parallel/env_bus.py",
     "RLT006": "telemetry dict key not in the schema validator key set",
     "RLT007": "thread hygiene (daemon=, swallowed thread errors)",
+    "RLT008": "module/class-scope jit bypassing the program ledger",
 }
 
 
@@ -76,6 +77,9 @@ class Config:
     env_registry: FrozenSet[str] = frozenset()
     #: RLT005: files whose literal RLT_* strings are the registry itself.
     env_exempt_files: FrozenSet[str] = frozenset()
+    #: RLT008: path prefixes where import-time jit construction must
+    #: route through telemetry.program_ledger.ledgered_jit.
+    ledger_paths: Tuple[str, ...] = ()
 
 
 # Wall-timestamp dict keys exempt from the RLT004a time.time() ban:
@@ -175,6 +179,10 @@ class _FileChecker:
         self.thread_targets: Set[str] = set()
         # first line of the statement currently being visited
         self._stmt_line: Optional[int] = None
+        # RLT008 applies to this file at all (prefix-scoped)
+        self._ledger_scope = any(
+            path.startswith(p) for p in config.ledger_paths
+        )
         self._parse_comments()
 
     # -- comments ------------------------------------------------------------
@@ -431,6 +439,21 @@ class _FileChecker:
                             "function constructs a fresh jit object "
                             "per call — hoist it",
                         )
+            # RLT008 — a @jax.jit def at module/class scope builds an
+            # executable the program ledger never sees: no compile
+            # timing, no cost/memory rows, and its recompiles are
+            # invisible to the forensics ring.
+            if frame.node is None and self._ledger_scope:
+                for deco in node.decorator_list:
+                    if _decorator_name(deco) in _JIT_NAMES:
+                        self._flag(
+                            deco, "RLT008",
+                            "jit-decorated def at module/class scope "
+                            "bypasses the program ledger — wrap with "
+                            "telemetry.program_ledger.ledgered_jit("
+                            "fn, site=...) so the executable is "
+                            "inventoried and recompiles attributed",
+                        )
             new = self._enter_function(node, class_stack, frame)
             # RLT007b: swallowed errors inside thread targets.
             if node.name in self.thread_targets:
@@ -513,6 +536,28 @@ class _FileChecker:
         name = _dotted(node.func) or ""
         base = name.rsplit(".", 1)[-1]
         kwargs = {kw.arg for kw in node.keywords}
+
+        # RLT008 — jit construction at module/class scope (import
+        # time).  These are exactly the steady-state executables the
+        # program ledger exists to inventory; a bare jit here dispatches
+        # outside the ledger forever.  ``partial(jax.jit, ...)`` alone
+        # is a factory, not a program — only flag when a function is
+        # actually wrapped (direct call or the partial applied).
+        if frame.node is None and self._ledger_scope and node.args:
+            wrapped = name if name in _JIT_NAMES else None
+            if wrapped is None and isinstance(node.func, ast.Call):
+                inner = _decorator_name(node.func)
+                if inner in _JIT_NAMES:
+                    wrapped = inner
+            if wrapped is not None:
+                self._flag(
+                    node, "RLT008",
+                    f"bare {wrapped}() at module/class scope bypasses "
+                    f"the program ledger — route through "
+                    f"telemetry.program_ledger.ledgered_jit(fn, "
+                    f"site=...) so compile time, cost/memory and "
+                    f"recompile forensics are captured",
+                )
 
         # RLT001 — jit construction on a hot path.
         if frame.hot_jit and name in _JIT_NAMES:
@@ -921,4 +966,8 @@ def repo_config(repo_root: str) -> Config:
         env_exempt_files=frozenset({
             f"{_PKG}/parallel/env_bus.py",
         }),
+        # RLT008 — the whole package: every import-time executable must
+        # land in the program ledger (tools/bench drivers may build
+        # throwaway jits; the package's are the steady-state programs).
+        ledger_paths=(f"{_PKG}/",),
     )

@@ -1,0 +1,51 @@
+# rlt-fixture: ledger-scope
+"""RLT008 fixture: import-time jit construction in ledger-scoped files
+must route through telemetry.program_ledger.ledgered_jit."""
+from functools import partial
+
+import jax
+from jax.experimental.pjit import pjit
+
+from ray_lightning_tpu.telemetry.program_ledger import ledgered_jit
+
+
+def _step(x):
+    return x + 1
+
+
+step = jax.jit(_step)  # expect[RLT008]
+
+sharded = pjit(_step)  # expect[RLT008]
+
+donated = partial(jax.jit, donate_argnums=0)(_step)  # expect[RLT008]
+
+
+@jax.jit  # expect[RLT008]
+def decorated_step(x):
+    return x * 2
+
+
+@partial(jax.jit, static_argnums=0)  # expect[RLT008]
+def static_step(n, x):
+    return x * n
+
+
+class Holder:
+    # class attributes are still built at import time — same bypass
+    step = jax.jit(_step)  # expect[RLT008]
+
+
+# clean: routed through the ledger registration wrapper
+ledgered = ledgered_jit(_step, site="fixture/step")
+
+# clean: a partial alone is a factory, not a compiled program
+jit_donating = partial(jax.jit, donate_argnums=0)
+
+
+# clean: jit built inside a function body is RLT001's domain, not RLT008's
+def build_step():
+    return jax.jit(_step)
+
+
+# clean: reasoned escape hatch for deliberate out-of-ledger programs
+reference = jax.jit(_step)  # rlt: noqa[RLT008] reference impl, never dispatched in prod

@@ -288,6 +288,51 @@ def _render_mpmd(mpmd: Dict[str, Any]) -> list:
     return lines
 
 
+def _render_programs(programs: Dict[str, Any]) -> list:
+    """The compiled-executable pane (``program_ledger.snapshot()``):
+    one row per (site, variant) — dispatch counts, compile wall,
+    cost-analysis FLOPs/bytes, scratch footprint — plus the recompile-
+    forensics tail naming the argument that forced each recompile."""
+    rows = programs.get("programs", [])
+    if not rows:
+        return []
+    total_s = programs.get("compile_time_total_s", 0.0)
+    lines = [
+        "",
+        f"programs: {len(rows)} executable(s), "
+        f"compile {total_s:.2f}s total"
+        + (f"  ({programs['dropped']} dropped)"
+           if programs.get("dropped") else ""),
+        "site                      var    calls  comp_s     mflops"
+        "    arg_mb   tmp_mb",
+    ]
+    for row in sorted(rows, key=lambda r: (r.get("site", ""),
+                                           r.get("variant", 0))):
+        flops = row.get("flops")
+        arg_b = row.get("argument_bytes")
+        tmp_b = row.get("temp_bytes")
+        lines.append(
+            f"{str(row.get('site', '?'))[:25]:<25}"
+            + _fmt(row.get("variant"), 4)
+            + _fmt(row.get("ncalls"), 9)
+            + _fmt(row.get("compile_s"), 8)
+            + _fmt(None if flops is None else flops / 1e6, 11)
+            + _fmt(None if arg_b is None else arg_b / 1e6, 10)
+            + _fmt(None if tmp_b is None else tmp_b / 1e6, 9)
+        )
+    recompiles = programs.get("recompiles") or []
+    if recompiles:
+        lines += ["", "recent recompiles:"]
+        for ev in recompiles[-5:]:
+            lines.append(
+                f"  [{ev.get('kind', '?'):<9}] {ev.get('site', '?')}: "
+                f"{ev.get('argument', '?')}"
+                + (f" {ev['old']} -> {ev['new']}"
+                   if ev.get("old") and ev.get("new") else "")
+            )
+    return lines
+
+
 def render(snapshot: Optional[Dict[str, Any]], source: str) -> str:
     """One text frame (pure function — tested directly)."""
     stamp = time.strftime("%H:%M:%S")
@@ -297,8 +342,11 @@ def render(snapshot: Optional[Dict[str, Any]], source: str) -> str:
         return (f"rlt_top {stamp} — mpmd pipeline\n"
                 + "\n".join(_render_mpmd(snapshot["mpmd"])) + "\n")
     if "serve" in snapshot and "ranks" not in snapshot:
+        lines = _render_serve(snapshot["serve"])
+        if snapshot.get("programs"):
+            lines += _render_programs(snapshot["programs"])
         return (f"rlt_top {stamp} — serving engine\n"
-                + "\n".join(_render_serve(snapshot["serve"])) + "\n")
+                + "\n".join(lines) + "\n")
     if "router" in snapshot and "ranks" not in snapshot:
         return (f"rlt_top {stamp} — serve router "
                 f"({len(snapshot['router'].get('replicas', []))} "
@@ -331,6 +379,8 @@ def render(snapshot: Optional[Dict[str, Any]], source: str) -> str:
         lines += _render_router(snapshot["router"])
     if snapshot.get("mpmd"):
         lines += _render_mpmd(snapshot["mpmd"])
+    if snapshot.get("programs"):
+        lines += _render_programs(snapshot["programs"])
     events = snapshot.get("events") or []
     if events:
         lines += ["", "recent events:"]
