@@ -79,6 +79,18 @@ itself; acceptance is ``ttft_speedup`` ≥ 1.5x with bitwise token
 parity, a live hit-rate, and steady-state recompiles pinned at ZERO
 in both arms.  ``RLT_PREFIX_CACHE=0`` skips the phase.
 
+A ninth phase calibrates the **SLO & capacity plane** (the ``slo``
+block, ``validate_bench_slo``): a fresh plane-on engine serves a cold
+(0.5x capacity) Poisson arm from which the headroom oracle
+(``serve/capacity.py``) must PREDICT the saturation knee — per-slot
+service rate is load-invariant, so half load calibrates the ceiling —
+then a hot (1.5x) arm measures the real knee (±20% bar) and must trip
+the multi-window burn-rate alert (``telemetry/slo.py``) that the cold
+arm kept silent.  Steady-state recompiles stay pinned at ZERO with
+the plane on, and the plane's closed-loop overhead (ONE engine
+toggling the plane, median of adjacent alternating-order pairs) must
+sit under the 2% bar.  ``RLT_SLO=0`` skips the phase.
+
 A fifth phase benches **disaggregated serving** (the ``serve_disagg``
 block, ``validate_bench_serve_disagg``): a real actor fleet —
 ``RLT_DISAGG_REPLICAS`` (default 2) decode replicas +
@@ -115,7 +127,8 @@ from ray_lightning_tpu.telemetry import compile_event_count
 from ray_lightning_tpu.telemetry.schema import (
     validate_bench_multi_lora, validate_bench_prefix_cache,
     validate_bench_serve, validate_bench_serve_disagg,
-    validate_bench_spec_decode, validate_bench_trace,
+    validate_bench_slo, validate_bench_spec_decode,
+    validate_bench_trace,
 )
 
 PROMPT_LEN = 16
@@ -874,6 +887,145 @@ def _trace_block(module, params, serve_cfg, cfg) -> dict:
     }
 
 
+SLO_ARM_S = 10.0            # wall-clock per Poisson alert arm
+# Longer passes + more pairs than the tracing A/B: the plane's true
+# cost is a few per-export-tick dict folds, so per-pass wall noise —
+# not the effect — is what the median has to beat.
+SLO_AB_REQUESTS = 48
+SLO_AB_PAIRS = 8
+# Serving-horizon window pairs for the bench arms: the stock
+# minutes-scale defaults would dilute a 10 s overload arm into noise.
+SLO_BENCH_WINDOWS = ((1.0, 4.0, 6.0), (2.0, 8.0, 3.0))
+
+
+def _slo_block(module, params, serve_cfg: ServeConfig, cfg,
+               cont_rps: float) -> dict:
+    """Phase 9: SLO & capacity-oracle calibration (the ``slo`` block,
+    ``validate_bench_slo``).  A fresh plane-on engine serves a cold
+    (0.5x capacity) Poisson arm — from which the headroom oracle must
+    PREDICT the saturation knee before ever seeing overload — then a
+    hot (1.5x) arm measures the real knee and must trip the burn-rate
+    alert the cold arm kept silent.  The overhead A/B rides a second
+    engine toggling the plane between closed-loop passes (median of
+    adjacent alternating-order pairs — the tracing round's
+    methodology)."""
+    ts_interval = float(
+        os.environ.get("RLT_TS_INTERVAL_S", "0.25") or 0.25
+    )
+    slo_cfg = ServeConfig(
+        num_slots=serve_cfg.num_slots, block_size=serve_cfg.block_size,
+        capacity=True, slo=True, ts_interval_s=ts_interval,
+        export_every_s=ts_interval, slo_windows=SLO_BENCH_WINDOWS,
+        # The hot arm holds a standing backlog by design; the queue
+        # must absorb it rather than reject (rejections would shed the
+        # very overload the alert exists to see).
+        max_queue=4096,
+    )
+    eng = ServeEngine(module, params, slo_cfg)
+    oracle = eng.capacity_oracle
+    evaluator = eng.slo_evaluator
+    # Duration-sized arms: request counts scale with measured capacity
+    # so every machine sees ~SLO_ARM_S of sustained load — queue-wait
+    # growth under overload is a time-scale effect (backlog grows at
+    # 0.5x the service rate, so waits ramp ~0.5 s/s regardless of how
+    # fast the chip is), which is what keeps the stock 500 ms bound
+    # meaningful across hosts.
+    n_cold = max(16, int(0.5 * cont_rps * SLO_ARM_S))
+    n_hot = max(24, int(1.5 * cont_rps * SLO_ARM_S))
+    cold_prompts = _prompts(n_cold, cfg.vocab_size, seed=311)
+    hot_prompts = _prompts(n_hot, cfg.vocab_size, seed=312)
+    try:
+        for p in cold_prompts[:2]:
+            eng.generate(p, MAX_NEW)        # warm every program
+        before = compile_event_count()
+        eng.start()
+        try:
+            cold = _poisson_arm(eng, cold_prompts,
+                                rate_rps=max(0.5 * cont_rps, 0.5),
+                                seed=91)
+            alerts_cold = evaluator.alerts_total
+            # The oracle calls the knee from cold-arm data alone: the
+            # per-slot service rate is load-invariant (each decode tick
+            # costs the full width whether 2 or 8 slots are live), so
+            # half-load suffices to calibrate the ceiling.
+            predicted = oracle.predict_saturation_rps(
+                MAX_NEW, window_s=SLO_ARM_S
+            )
+            hot = _poisson_arm(eng, hot_prompts,
+                               rate_rps=max(1.5 * cont_rps, 0.75),
+                               seed=92)
+            alerts_hot = evaluator.alerts_total - alerts_cold
+            hot_cap = oracle.snapshot(window_s=SLO_ARM_S / 2)
+        finally:
+            eng.stop()
+        recompiles = int(compile_event_count() - before)
+        ts_points = len(oracle.store.points())
+    finally:
+        if eng._thread is not None:  # belt: stop() already joined
+            eng.stop()
+    measured = hot["requests_per_sec"]
+    err_pct = None
+    if predicted and measured:
+        err_pct = 100.0 * abs(predicted - measured) / measured
+
+    # -- overhead A/B: plane on vs off, ONE engine ------------------------
+    ab = ServeEngine(module, params, slo_cfg)
+    ab_prompts = _prompts(SLO_AB_REQUESTS, cfg.vocab_size, seed=313)
+    plane = (ab._capacity, ab._slo)
+
+    def set_plane(on: bool) -> None:
+        ab._capacity, ab._slo = plane if on else (None, None)
+
+    def closed_wall() -> float:
+        ab.stats = ServeStats()
+        handles = [ab.submit(p, MAX_NEW) for p in ab_prompts]
+        t0 = time.perf_counter()
+        ab.run_until_idle()
+        wall = time.perf_counter() - t0
+        assert all(h.done() for h in handles)
+        return wall
+
+    try:
+        for p in ab_prompts[:2]:
+            ab.generate(p, MAX_NEW)
+        closed_wall()                       # untimed shakeout
+        deltas = []
+        for pair in range(SLO_AB_PAIRS):
+            order = (False, True) if pair % 2 == 0 else (True, False)
+            walls = {}
+            for on in order:
+                set_plane(on)
+                walls[on] = closed_wall()
+            deltas.append(
+                100.0 * (walls[True] - walls[False]) / walls[False]
+            )
+        deltas.sort()
+        overhead_pct = deltas[len(deltas) // 2]
+    finally:
+        set_plane(True)
+        ab.stop()
+
+    return {
+        "predicted_saturation_rps": (
+            None if predicted is None else round(predicted, 3)
+        ),
+        "measured_saturation_rps": round(measured, 3),
+        "prediction_error_pct": (
+            None if err_pct is None else round(err_pct, 2)
+        ),
+        "alerts_hot": int(alerts_hot),
+        "alerts_cold": int(alerts_cold),
+        "recompiles_steady_state": recompiles,
+        "overhead_pct": round(overhead_pct, 3),
+        "capacity_tokens_per_s": hot_cap.get("capacity_tokens_per_s"),
+        "service_rate_per_slot": hot_cap.get("service_rate_per_slot"),
+        "hot_rps": hot["requests_per_sec"],
+        "cold_rps": cold["requests_per_sec"],
+        "hot_utilization": hot_cap.get("utilization"),
+        "ts_points": ts_points,
+    }
+
+
 def main() -> None:
     on_tpu = _detect_backend() == "tpu"
     if on_tpu:
@@ -968,6 +1120,13 @@ def main() -> None:
     if os.environ.get("RLT_PREFIX_CACHE", "1") != "0":
         prefix_block = _prefix_cache_block(module, params, serve_cfg,
                                            cfg)
+
+    # Phase 9: SLO & capacity-oracle calibration (predict the knee
+    # cold, measure it hot, alert only under overload).
+    slo_block = None
+    if os.environ.get("RLT_SLO", "1") != "0":
+        slo_block = _slo_block(module, params, serve_cfg, cfg,
+                               cont_rps)
 
     # Compiled-program observatory: by this point every serve plane ran
     # (bucketed prefills, decode, chunked prefill, draft + K+1 verify,
@@ -1074,6 +1233,39 @@ def main() -> None:
                 f"{disagg_block['chaos']['lost_requests']} request(s) "
                 "LOST across the replica kill — failover bar is zero"
             )
+    if slo_block is not None:
+        problems += validate_bench_slo(slo_block)
+        if (slo_block["prediction_error_pct"] is None
+                or slo_block["prediction_error_pct"] > 20.0):
+            problems.append(
+                "slo: oracle predicted "
+                f"{slo_block['predicted_saturation_rps']} req/s vs "
+                f"measured knee {slo_block['measured_saturation_rps']} "
+                f"({slo_block['prediction_error_pct']}% error) — "
+                "outside the ±20% calibration bar"
+            )
+        if slo_block["alerts_hot"] < 1:
+            problems.append(
+                "slo: the 1.5x overload arm fired no burn-rate alert"
+            )
+        if slo_block["alerts_cold"] != 0:
+            problems.append(
+                f"slo: {slo_block['alerts_cold']} alert(s) fired in "
+                "the 0.5x arm — the burn-rate pager is noisy at "
+                "half load"
+            )
+        if slo_block["recompiles_steady_state"] != 0:
+            problems.append(
+                "slo: recompiles_steady_state = "
+                f"{slo_block['recompiles_steady_state']} with the "
+                "plane on — the oracle must be host-side only"
+            )
+        if (slo_block["overhead_pct"] is not None
+                and slo_block["overhead_pct"] >= 2.0):
+            problems.append(
+                f"slo: plane overhead {slo_block['overhead_pct']}% at "
+                "or above the 2% bar"
+            )
     if problems:  # the gate that keeps this producer honest
         for p in problems:
             sys.stderr.write(f"bench_serve schema: {p}\n")
@@ -1097,6 +1289,8 @@ def main() -> None:
         out["serve_disagg"] = disagg_block
     if prefix_block is not None:
         out["prefix_cache"] = prefix_block
+    if slo_block is not None:
+        out["slo"] = slo_block
     print(json.dumps(out))
 
 

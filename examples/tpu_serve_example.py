@@ -21,6 +21,11 @@ Run (CPU):
     # --prefill-workers — the router hot-loads members on demand):
     JAX_PLATFORMS=cpu python examples/tpu_serve_example.py \
         --smoke-test --adapters 3
+    # SLO & capacity plane: burn-rate SLOs evaluated while serving,
+    # plus the headroom oracle's capacity / predicted-knee view
+    # (docs/OBSERVABILITY.md "SLO, burn rate & capacity"):
+    JAX_PLATFORMS=cpu python examples/tpu_serve_example.py \
+        --smoke-test --slo
 """
 
 from __future__ import annotations
@@ -32,6 +37,12 @@ import numpy as np
 from ray_lightning_tpu import LocalStrategy, Trainer
 from ray_lightning_tpu.models import GPT, GPTConfig, SyntheticLMDataModule
 from ray_lightning_tpu.serve import ServeClient, ServeConfig, ServeEngine
+
+
+def _fmt(v, digits=1):
+    """None-tolerant number formatting — a short demo run may not
+    feed the oracle enough bins for every derived metric."""
+    return "n/a" if v is None else f"{v:.{digits}f}"
 
 
 def main() -> None:
@@ -65,6 +76,13 @@ def main() -> None:
                         "shared blocks by refcount bumps and prefill "
                         "only their suffix (docs/SERVING.md § Prefix "
                         "caching)")
+    parser.add_argument("--slo", action="store_true",
+                        help="SLO & capacity plane: evaluate burn-rate "
+                        "SLOs while serving and print the headroom "
+                        "oracle's view — capacity, utilization and the "
+                        "predicted saturation knee "
+                        "(docs/OBSERVABILITY.md § SLO, burn rate & "
+                        "capacity)")
     parser.add_argument("--trace", action="store_true",
                         help="request-scoped distributed tracing: "
                         "every component exports span JSONL into the "
@@ -120,11 +138,18 @@ def main() -> None:
             adapters[f"tenant{i}"], _ = synthetic_lora_adapter(
                 trainer.params, lora_cfg, ki
             )
+    slo_kw = {}
+    if args.slo:
+        # Fine-grained bins + a fast export tick so even a short demo
+        # run gives the oracle enough data to call the knee.
+        slo_kw = dict(slo=True, capacity=True,
+                      ts_interval_s=0.25, export_every_s=0.25)
     serve_cfg = ServeConfig(num_slots=args.num_slots, block_size=16,
                             spec_k=args.spec,
                             max_adapters=args.adapters,
                             adapter_rank=4 if args.adapters else 0,
-                            prefix_cache=args.prefix_cache)
+                            prefix_cache=args.prefix_cache,
+                            **slo_kw)
     telemetry_dir = "rlt_logs/serve_example/telemetry"
     trace_dir = telemetry_dir if args.trace else None
     if trace_dir:
@@ -211,6 +236,16 @@ def main() -> None:
                 print(f"lora: loads sent="
                       f"{rsnap['counters']['adapter_loads_sent']}, "
                       f"adapters/replica={loaded}")
+            if args.slo:
+                # Per-replica capacity blocks ride the beats; the
+                # router folds them into the fleet view.
+                fc = rsnap.get("capacity") or {}
+                print(f"fleet capacity: "
+                      f"{fc.get('replicas_reporting', 0)} replica(s) "
+                      f"reporting, capacity="
+                      f"{_fmt(fc.get('capacity_tokens_per_s'))} tok/s, "
+                      f"headroom="
+                      f"{_fmt(fc.get('headroom_tokens_per_s'))} tok/s")
         else:
             snap = engine.snapshot()
             lat = snap["latency"]
@@ -239,6 +274,21 @@ def main() -> None:
                       f" tenant(s) over one resident base, fairness="
                       f"{snap['gauges']['lora_fairness_spread']:.2f}, "
                       f"tokens/tenant={per}")
+            if args.slo:
+                # The oracle watched the whole serve above through the
+                # export tick; ask it for the derived view — and for
+                # the knee it would predict at this request shape.
+                cap = engine.capacity_oracle.snapshot(window_s=60.0)
+                knee = engine.capacity_oracle.predict_saturation_rps(
+                    args.max_new_tokens, window_s=60.0)
+                burn = {name: round(s["burn_rate"], 2) for name, s in
+                        engine.slo_evaluator.snapshot().items()}
+                print(f"slo/capacity: capacity="
+                      f"{_fmt(cap['capacity_tokens_per_s'])} tok/s, "
+                      f"utilization={_fmt(cap['utilization'], 2)}, "
+                      f"predicted_knee={_fmt(knee)} req/s, "
+                      f"burn_rates={burn}, "
+                      f"alerts={len(engine.slo_alerts)}")
             assert snap["counters"]["completed"] == args.requests
         print("OK — watch live with: "
               "python tools/rlt_top.py rlt_logs/serve_example/telemetry")

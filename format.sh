@@ -127,6 +127,10 @@ fi
 # (tests/data/flight_bundle.json), and BENCH_*.json telemetry/fault/
 # host_overhead blocks (tools/check_telemetry_schema.py).
 python tools/check_telemetry_schema.py || fail=1
+# Cross-round regression diff self-check (tools/rlt_bench_diff.py):
+# the gated-key table + direction rules stay honest, so a drifted key
+# path can't silently drop a metric from the trajectory diff.
+python tools/rlt_bench_diff.py --selftest || fail=1
 
 # -- layer 5: chaos-plane smoke (zero extra deps, no subprocess fits) --------
 # Gates the fault-injection grammar + deterministic matching + the

@@ -97,6 +97,13 @@ KNOBS: Tuple[EnvKnob, ...] = (
     EnvKnob("RLT_DISAGG_PREFILL", False, "bench prefill workers"),
     EnvKnob("RLT_MAX_ADAPTERS", False, "bench multi-LoRA tenant count"),
     EnvKnob("RLT_DRYRUN_MPMD", False, "graft-entry mpmd flavor gate"),
+    # -- SLO & capacity plane (serve entry points + router) --------------
+    EnvKnob("RLT_SLO", False, "serve SLO burn-rate evaluator gate"),
+    EnvKnob("RLT_CAPACITY", False, "serve capacity/headroom oracle gate"),
+    EnvKnob("RLT_TS_INTERVAL_S", False, "time-series store bin width"),
+    EnvKnob("RLT_HEADROOM_ROUTING", False,
+            "router placement tie-break on reported headroom (resolved "
+            "once at router build; router is driver/agent-local)"),
 )
 
 

@@ -172,6 +172,7 @@ class Router:
         prom_port: Optional[int] = None,
         export_every_s: float = 1.0,
         poll_interval_s: float = 0.02,
+        headroom_routing: Optional[bool] = None,
     ):
         from ray_lightning_tpu.cluster.queue import DriverQueue
 
@@ -260,6 +261,24 @@ class Router:
 
             self._exporter = PromExporter(textfile=prom_file,
                                           port=prom_port)
+        # Headroom-aware placement tie-break (capacity plane): between
+        # equally-assigned candidates, prefer the replica whose
+        # headroom oracle reports the most tokens/s slack — measured
+        # throughput beats the raw free-block proxy once beats carry
+        # capacity blocks.  OFF by default; the flag (or
+        # RLT_HEADROOM_ROUTING=1) only REORDERS ties, it never admits
+        # or rejects, so routing stays correct if beats lack the block.
+        if headroom_routing is None:
+            import os
+
+            headroom_routing = \
+                os.environ.get("RLT_HEADROOM_ROUTING", "0") == "1"
+        self._headroom_routing = bool(headroom_routing)
+        # Fleet trend store, created lazily on the first beat carrying
+        # a capacity block: per-replica tokens_out counters + headroom
+        # gauges, the sensing input ROADMAP item 4's fleet scheduler
+        # reads.  None until a capacity-plane member reports.
+        self.timeseries = None                   # guarded by self._lock
 
     # -- fleet membership ----------------------------------------------------
     @property
@@ -414,6 +433,28 @@ class Router:
         m.last_beat = now
         if "snapshot" in item:
             m.snapshot = item["snapshot"]
+            cap = m.snapshot.get("capacity") \
+                if isinstance(m.snapshot, dict) else None
+            if isinstance(cap, dict):
+                if self.timeseries is None:
+                    from ray_lightning_tpu.telemetry.timeseries import (
+                        TimeSeriesStore,
+                    )
+
+                    self.timeseries = TimeSeriesStore(
+                        interval_s=1.0, capacity=600,
+                    )
+                counters = m.snapshot.get("counters", {})
+                self.timeseries.observe(
+                    f"{m.id}.tokens_out",
+                    counters.get("tokens_out", 0), kind="counter",
+                )
+                head = cap.get("headroom_tokens_per_s")
+                if isinstance(head, (int, float)):
+                    self.timeseries.observe(
+                        f"{m.id}.headroom_tokens_per_s", head,
+                        kind="gauge",
+                    )
         if "recompiles" in item:
             m.recompiles = int(item["recompiles"])
         if "adapters" in item:
@@ -623,6 +664,16 @@ class Router:
         gauges = m.snapshot.get("gauges", {}) if m.snapshot else {}
         return float(gauges.get("blocks_free", 0.0))
 
+    def _headroom(self, m: _Member) -> float:
+        """Oracle-reported tokens/s slack from the member's last beat
+        (0.0 when the member runs without the capacity plane)."""
+        cap = m.snapshot.get("capacity") if m.snapshot else None
+        if isinstance(cap, dict):
+            head = cap.get("headroom_tokens_per_s")
+            if isinstance(head, (int, float)):
+                return float(head)
+        return 0.0
+
     # Leading tokens hashed into the affinity key: enough to
     # distinguish system-prompt/template families, cheap enough to
     # compute per route.
@@ -786,14 +837,31 @@ class Router:
                 if sm is None or (self._assigned(sm.id)
                                   >= sm.caps.get("num_slots", 1)):
                     sticky = None
-            target = min(
-                candidates,
-                key=lambda m: (adapter is not None
-                               and adapter not in m.adapters,
-                               sticky is not None and m.id != sticky,
-                               self._assigned(m.id),
-                               -self._blocks_free(m), m.id),
-            )
+            if self._headroom_routing:
+                # Capacity-plane tie-break: oracle-measured tokens/s
+                # slack ranks ahead of the free-block proxy (members
+                # without a capacity block score 0 slack and fall
+                # through to the proxy unchanged).
+                target = min(
+                    candidates,
+                    key=lambda m: (adapter is not None
+                                   and adapter not in m.adapters,
+                                   sticky is not None
+                                   and m.id != sticky,
+                                   self._assigned(m.id),
+                                   -self._headroom(m),
+                                   -self._blocks_free(m), m.id),
+                )
+            else:
+                target = min(
+                    candidates,
+                    key=lambda m: (adapter is not None
+                                   and adapter not in m.adapters,
+                                   sticky is not None
+                                   and m.id != sticky,
+                                   self._assigned(m.id),
+                                   -self._blocks_free(m), m.id),
+                )
             if sticky is not None and target.id == sticky:
                 self.counters["prefix_affinity_hits"] += 1
         if pkey is not None:
@@ -1163,6 +1231,7 @@ class Router:
         now = time.monotonic()
         with self._lock:
             replicas = []
+            cap_blocks = []
             for m in self._replicas.values():
                 gauges = (m.snapshot.get("gauges", {})
                           if m.snapshot else {})
@@ -1185,6 +1254,14 @@ class Router:
                     entry["recompiles"] = m.recompiles
                 if m.caps.get("max_adapters", 0) > 0:
                     entry["adapters"] = len(m.adapters)
+                cap = (m.snapshot.get("capacity")
+                       if m.snapshot else None)
+                if isinstance(cap, dict):
+                    cap_blocks.append(cap)
+                    for key in ("headroom_tokens_per_s",
+                                "utilization", "kv_exhaustion_eta_s"):
+                        if key in cap:
+                            entry[key] = cap[key]
                 replicas.append(entry)
             workers = []
             for w in self._workers.values():
@@ -1200,12 +1277,21 @@ class Router:
                 if w.caps.get("max_adapters", 0) > 0:
                     wentry["adapters"] = len(w.adapters)
                 workers.append(wentry)
-            return {
+            out = {
                 "ts": time.time(),
                 "counters": dict(self.counters),
                 "replicas": replicas,
                 "workers": workers,
             }
+            if cap_blocks:
+                from ray_lightning_tpu.serve.capacity import (
+                    aggregate_fleet,
+                )
+
+                fleet = aggregate_fleet(cap_blocks)
+                if fleet is not None:
+                    out["capacity"] = fleet
+            return out
 
     def _maybe_export(self) -> None:
         if self._exporter is None and self._live_path is None:

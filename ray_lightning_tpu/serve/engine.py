@@ -117,6 +117,21 @@ class ServeConfig:
     idle_wait_s: float = 0.002
     # Live-export refresh cadence (prom textfile / serve-live.json).
     export_every_s: float = 1.0
+    # Fleet SLO & capacity plane (docs/OBSERVABILITY.md "SLO, burn
+    # rate & capacity"): the headroom oracle (serve/capacity.py) and
+    # the burn-rate evaluator (telemetry/slo.py) tick on the export
+    # cadence.  OFF by default — disabled engines keep snapshot() and
+    # serve-live.json byte-identical to pre-plane rounds.
+    capacity: bool = False
+    slo: bool = False
+    # Time-series bin width for the plane's store (RLT_TS_INTERVAL_S).
+    ts_interval_s: float = 1.0
+    # Queue-wait bound (ms) for the stock serve_queue_wait SLO.
+    slo_queue_wait_ms: float = 500.0
+    # Override the stock SLOs' (fast_s, slow_s, burn-bound) window
+    # pairs.  None = telemetry/slo.py defaults (minutes-scale);
+    # benches shrink them to their arm horizons.
+    slo_windows: Optional[Tuple[Tuple[float, float, float], ...]] = None
 
 
 class ServeHandle:
@@ -415,6 +430,43 @@ class ServeEngine:
 
             os.makedirs(telemetry_dir, exist_ok=True)
             self._live_path = f"{telemetry_dir}/serve-live.json"
+        # Fleet SLO & capacity plane: headroom oracle + burn-rate
+        # evaluator, ticked by _maybe_export on the export cadence —
+        # host-side dict folds only, zero new device work, so the
+        # recompile counter stays pinned with the plane on.
+        self._capacity = None
+        self._slo = None
+        self._slo_alerts: deque = deque(maxlen=256)
+        if cfg.capacity or cfg.slo:
+            from ray_lightning_tpu.serve.capacity import CapacityOracle
+
+            self._capacity = CapacityOracle(
+                interval_s=cfg.ts_interval_s, clock=time.time,
+            )
+            # Derived capacity snapshots (model fit + trends over
+            # every series) refresh at ~1 Hz no matter how fast the
+            # export tick runs; beats and exports reuse the cached
+            # result in between.
+            self._capacity_every_s = max(cfg.export_every_s, 1.0)
+            self._last_capacity = 0.0
+        if cfg.slo:
+            import dataclasses
+
+            from ray_lightning_tpu.telemetry.slo import (
+                SloEvaluator, default_serve_slos,
+            )
+
+            specs = default_serve_slos(cfg.slo_queue_wait_ms)
+            if cfg.slo_windows is not None:
+                windows = tuple(tuple(w) for w in cfg.slo_windows)
+                specs = tuple(
+                    dataclasses.replace(s, windows=windows)
+                    for s in specs
+                )
+            self._slo = SloEvaluator(
+                self._capacity.store, specs,
+                clock=time.time, emit=self._slo_alerts.append,
+            )
 
     # -- compiled programs ---------------------------------------------------
     def _build_programs(self) -> None:
@@ -779,6 +831,7 @@ class ServeEngine:
             self.stats.bump("expired")
             self._finish_handle(req)
         now = time.monotonic()
+        t_adm = now
         tr = self.tracer
         for slot, req, bucket in admissions:
             wait = now - req.arrival_t
@@ -867,6 +920,14 @@ class ServeEngine:
                 )
             first = int(first)  # rlt: noqa[RLT002] deliberate TTFT sync at admission
             t_first = time.monotonic()
+            # Per-admission wall in µs (host prep + prefill/import
+            # dispatch + the TTFT sync above).  Paired with the
+            # `admitted` counter it gives the capacity oracle the
+            # once-per-request admission cost its saturation model
+            # charges (serve/capacity.py).
+            self.stats.bump(  # rlt: noqa[RLT002] host float, no device value
+                "admit_us", int((t_first - t_adm) * 1e6))
+            t_adm = t_first
             if ctx is not None:
                 # The int() above synced the device, so this interval
                 # covers dispatch + device compute of the admission.
@@ -1200,6 +1261,11 @@ class ServeEngine:
         toks = np.asarray(toks)
         dt = time.monotonic() - t0
         self.stats.bump("decode_steps")
+        # Tick wall in µs — with decode_steps/tokens_out it gives the
+        # capacity oracle per-bin (busy slots, tick cost) pairs, the
+        # data its affine tick-cost fit needs (serve/capacity.py).
+        self.stats.bump(  # rlt: noqa[RLT002] host float, no device value
+            "decode_us", int(dt * 1e6))
         self.stats.note_token_latency(dt, n_tokens=len(active))
         for slot in active:
             self.scheduler.seq_lens[slot] += 1
@@ -1304,6 +1370,11 @@ class ServeEngine:
         sampled = np.asarray(sampled)  # (W, K+1)
         self.stats.bump("verify_steps")
         dt = time.monotonic() - t0
+        # Same busy-time accounting as the plain decode tick, so the
+        # capacity oracle's time budget stays honest on speculative
+        # engines too.
+        self.stats.bump(  # rlt: noqa[RLT002] host float, no device value
+            "decode_us", int(dt * 1e6))
 
         total_emitted = 0
         for slot in active:
@@ -1810,26 +1881,78 @@ class ServeEngine:
             )
         self.stats.set_gauges(**gauges)
 
+    @property
+    def capacity_oracle(self):
+        """The headroom oracle (``serve/capacity.py``) when the
+        capacity plane is on, else None."""
+        return self._capacity
+
+    @property
+    def slo_evaluator(self):
+        """The burn-rate evaluator (``telemetry/slo.py``) when the SLO
+        plane is on, else None."""
+        return self._slo
+
+    @property
+    def slo_alerts(self) -> List[dict]:
+        """Fired ``slo_alert`` events (bounded ring, newest last)."""
+        return list(self._slo_alerts)
+
     def snapshot(self) -> dict:
         """The live serve snapshot (schema:
-        ``telemetry/schema.py::validate_serve_snapshot``)."""
-        return self.stats.snapshot()
+        ``telemetry/schema.py::validate_serve_snapshot``).  On
+        capacity-plane engines the newest headroom-oracle block rides
+        the ``capacity`` key — beats built from this snapshot carry it
+        to the router for free."""
+        snap = self.stats.snapshot()
+        if self._capacity is not None and self._capacity.last is not None:
+            snap["capacity"] = dict(self._capacity.last)
+        return snap
 
     def _maybe_export(self, force: bool = False) -> None:
-        if self._exporter is None and self._live_path is None:
+        if self._exporter is None and self._live_path is None \
+                and self._capacity is None:
             return
         now = time.monotonic()
         if not force and now - self._last_export < self.config.export_every_s:
             return
         self._last_export = now
-        snap = self.snapshot()
-        # The program ledger rides every export: rlt_program_* gauges
-        # on the prom side, the programs pane on the rlt_top side.
+        if self._capacity is not None:
+            # The SLO/capacity plane ticks here, on the CHEAP stats
+            # slice (counters + gauges + recent queue-wait p50) — the
+            # full snapshot sorts four 4096-sample reservoirs, too
+            # heavy for a sub-second tick under the plane's <2%
+            # overhead budget.  Recompiles ride the compile-event
+            # counter, NOT a ledger snapshot (which walks every
+            # program's cost rows).
+            from ray_lightning_tpu.telemetry import compile_event_count
+
+            self._capacity.observe(
+                self.stats.capacity_view(),
+                recompiles=int(compile_event_count()),
+            )
+            if force or now - self._last_capacity >= self._capacity_every_s:
+                self._last_capacity = now
+                self._capacity.snapshot()  # caches on .last
+        if self._slo is not None:
+            fired = self._slo.evaluate()
+            if fired:
+                self.stats.bump("slo_alerts", len(fired))
+        if self._exporter is None and self._live_path is None:
+            return
+        snap = self.stats.snapshot()
+        if self._capacity is not None and self._capacity.last is not None:
+            snap["capacity"] = dict(self._capacity.last)
+        # The program ledger rides every real export: rlt_program_*
+        # gauges on the prom side, the programs pane on the rlt_top
+        # side.
         from ray_lightning_tpu.telemetry import program_ledger
 
-        programs = program_ledger.snapshot()
+        payload = {"serve": snap, "programs": program_ledger.snapshot()}
+        if self._slo is not None:
+            payload["slo"] = self._slo.snapshot()
         if self._exporter is not None:
-            self._exporter.update({"serve": snap, "programs": programs})
+            self._exporter.update(payload)
         if self._live_path is not None:
             import json
             import os
@@ -1837,8 +1960,7 @@ class ServeEngine:
             tmp = self._live_path + ".tmp"
             try:
                 with open(tmp, "w") as f:
-                    json.dump({"ts": snap["ts"], "serve": snap,
-                               "programs": programs}, f)
+                    json.dump({"ts": snap["ts"], **payload}, f)
                 os.replace(tmp, self._live_path)
             except OSError:
                 pass  # a full disk must not take the serve loop down

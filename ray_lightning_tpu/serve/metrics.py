@@ -194,6 +194,26 @@ class ServeStats:
             self._prefix = dict(fields)
 
     # -- consumption ---------------------------------------------------------
+    def capacity_view(self) -> Dict[str, object]:
+        """The cheap per-tick slice the SLO/capacity plane ingests:
+        counters + gauges + a recent queue-wait p50.  ``snapshot()``
+        sorts every 4096-sample reservoir — fine at human export
+        cadence, too heavy for the plane's sub-second tick (which
+        must stay under its 2% serve-loop overhead budget)."""
+        with self._lock:
+            out: Dict[str, object] = {
+                "ts": time.time(),
+                "counters": dict(self.counters),
+                "gauges": {k: float(v) for k, v in self.gauges.items()},
+            }
+            recent = self._queue_wait._vals[-512:]
+        p50 = percentile(recent, 50)
+        out["latency"] = {} if p50 is None else {
+            "queue_wait": {"n": len(recent),
+                           "p50_ms": round(p50 * 1e3, 3)},
+        }
+        return out
+
     def snapshot(self) -> Dict[str, object]:
         with self._lock:
             out: Dict[str, object] = {

@@ -17,7 +17,12 @@ The observability subsystem (ISSUEs 2 + 3).  One import surface:
   :class:`RankLogHandler` (rank-tagged log ring + forwarding), and
   :mod:`.export_prom` (OpenMetrics textfile/HTTP export);
 * :mod:`.trace_parse` / :mod:`.schema` — Chrome-trace parsing shared by
-  the tools, and the artifact-schema validators ``format.sh`` gates on.
+  the tools, and the artifact-schema validators ``format.sh`` gates on;
+* the **SLO & capacity plane** (ISSUE 18): :class:`TimeSeriesStore`
+  (bounded fixed-interval ring store with windowed rate/percentile/
+  slope/ETA queries), :class:`SloSpec` / :class:`SloEvaluator`
+  (multi-window multi-burn-rate alerting), feeding
+  ``serve/capacity.py``'s headroom oracle.
 
 See ``docs/OBSERVABILITY.md`` for the workflow.
 """
@@ -44,7 +49,13 @@ from ray_lightning_tpu.telemetry.propagate import (
     inject,
     root_context,
 )
+from ray_lightning_tpu.telemetry.slo import (
+    SloEvaluator,
+    SloSpec,
+    default_serve_slos,
+)
 from ray_lightning_tpu.telemetry.spans import PHASES, Span, SpanTracer
+from ray_lightning_tpu.telemetry.timeseries import TimeSeriesStore
 from ray_lightning_tpu.telemetry.step_stats import (
     StepStats,
     compile_event_count,
@@ -81,4 +92,8 @@ __all__ = [
     "host_stats",
     "straggler_ranks",
     "format_report",
+    "TimeSeriesStore",
+    "SloSpec",
+    "SloEvaluator",
+    "default_serve_slos",
 ]

@@ -89,9 +89,18 @@ def render_openmetrics(snapshot: Dict[str, Any],
     serve = snapshot.get("serve")
     if serve:
         lines.extend(_render_serve(serve))
+        capacity = serve.get("capacity")
+        if capacity:
+            lines.extend(_render_capacity(capacity))
+    slo = snapshot.get("slo")
+    if slo:
+        lines.extend(_render_slo(slo))
     router = snapshot.get("router")
     if router:
         lines.extend(_render_router(router))
+        fleet = router.get("capacity")
+        if fleet:
+            lines.extend(_render_fleet_capacity(fleet))
     mpmd = snapshot.get("mpmd")
     if mpmd:
         lines.extend(_render_mpmd(mpmd))
@@ -171,6 +180,116 @@ def _render_programs(programs: Dict[str, Any]) -> list:
                 f'{_PREFIX}_program_recompiles_total'
                 f'{{kind="{_esc(kind)}",site="{_esc(site)}"}} {n}'
             )
+    return lines
+
+
+def _render_capacity(capacity: Dict[str, Any]) -> list:
+    """The headroom oracle's section (``capacity_snapshot`` shape —
+    ``telemetry/schema.py::validate_capacity_snapshot``).  Nullable
+    fields (the oracle refuses to guess before it has a measured
+    per-slot service rate) are simply omitted."""
+    lines = []
+    metrics = [
+        ("capacity_tokens_per_sec", "measured emitted tokens/s over "
+         "the oracle window", "tokens_per_s"),
+        ("capacity_ceiling_tokens_per_sec", "predicted saturation "
+         "throughput (per-slot service rate x num_slots)",
+         "capacity_tokens_per_s"),
+        ("capacity_headroom_tokens_per_sec", "tokens/s slack below "
+         "the predicted ceiling", "headroom_tokens_per_s"),
+        ("capacity_utilization", "load as a fraction of the ceiling",
+         "utilization"),
+        ("capacity_service_rate_per_slot", "measured tokens/s per "
+         "busy decode slot", "service_rate_per_slot"),
+        ("capacity_kv_exhaustion_eta_seconds", "free-block trend "
+         "extrapolated to pool exhaustion", "kv_exhaustion_eta_s"),
+        ("capacity_queue_wait_slope_ms_per_sec", "queue-wait p50 "
+         "trend over the window", "queue_wait_slope_ms_per_s"),
+        ("capacity_rejection_rate", "rejected/submitted rate over "
+         "the window", "rejection_rate"),
+    ]
+    for metric, help_, key in metrics:
+        value = capacity.get(key)
+        if not isinstance(value, (int, float)):
+            continue
+        lines.append(f"# TYPE {_PREFIX}_{metric} gauge")
+        lines.append(f"# HELP {_PREFIX}_{metric} {help_}")
+        lines.append(f"{_PREFIX}_{metric} {value}")
+    return lines
+
+
+def _render_fleet_capacity(fleet: Dict[str, Any]) -> list:
+    """The router's fleet-wide capacity roll-up
+    (``serve/capacity.py::aggregate_fleet``)."""
+    lines = []
+    metrics = [
+        ("capacity_fleet_replicas_reporting", "members whose beats "
+         "carry a capacity block", "replicas_reporting"),
+        ("capacity_fleet_tokens_per_sec", "fleet emitted tokens/s",
+         "tokens_per_s"),
+        ("capacity_fleet_ceiling_tokens_per_sec", "fleet predicted "
+         "saturation throughput", "capacity_tokens_per_s"),
+        ("capacity_fleet_headroom_tokens_per_sec", "fleet tokens/s "
+         "slack", "headroom_tokens_per_s"),
+        ("capacity_fleet_utilization", "fleet load as a fraction of "
+         "its ceiling", "utilization"),
+        ("capacity_fleet_kv_exhaustion_eta_seconds", "worst member "
+         "KV-exhaustion ETA", "kv_exhaustion_eta_s"),
+    ]
+    for metric, help_, key in metrics:
+        value = fleet.get(key)
+        if not isinstance(value, (int, float)):
+            continue
+        lines.append(f"# TYPE {_PREFIX}_{metric} gauge")
+        lines.append(f"# HELP {_PREFIX}_{metric} {help_}")
+        lines.append(f"{_PREFIX}_{metric} {value}")
+    return lines
+
+
+def _render_slo(slo: Dict[str, Any]) -> list:
+    """The burn-rate evaluator's section
+    (``telemetry/slo.py::SloEvaluator.snapshot`` shape): per-objective
+    burn/error/firing gauges plus the lifetime alert counter."""
+    lines = []
+    per_slo = [
+        ("slo_burn_rate", "error-budget burn multiple (worst window "
+         "pair's floor)", "burn_rate"),
+        ("slo_error_rate", "error rate over the slow window",
+         "error_rate"),
+        ("slo_target", "the objective", "target"),
+    ]
+    for metric, help_, key in per_slo:
+        samples = [
+            (name, state[key]) for name, state in sorted(slo.items())
+            if isinstance(state.get(key), (int, float))
+        ]
+        if not samples:
+            continue
+        lines.append(f"# TYPE {_PREFIX}_{metric} gauge")
+        lines.append(f"# HELP {_PREFIX}_{metric} {help_}")
+        for name, value in samples:
+            lines.append(
+                f'{_PREFIX}_{metric}{{slo="{_esc(name)}"}} {value}'
+            )
+    lines.append(f"# TYPE {_PREFIX}_slo_firing gauge")
+    lines.append(
+        f"# HELP {_PREFIX}_slo_firing 1 while both burn windows "
+        f"exceed the pair threshold"
+    )
+    for name, state in sorted(slo.items()):
+        lines.append(
+            f'{_PREFIX}_slo_firing{{slo="{_esc(name)}"}} '
+            f'{int(bool(state.get("firing")))}'
+        )
+    lines.append(f"# TYPE {_PREFIX}_slo_alerts counter")
+    lines.append(
+        f"# HELP {_PREFIX}_slo_alerts slo_alert events emitted"
+    )
+    for name, state in sorted(slo.items()):
+        n = state.get("alerts_total", 0)
+        lines.append(
+            f'{_PREFIX}_slo_alerts_total{{slo="{_esc(name)}"}} {n}'
+        )
     return lines
 
 

@@ -180,6 +180,17 @@ class RunMonitor:
             self._exporter = PromExporter(
                 textfile=config.prom_file, port=config.prom_port
             )
+        # Optional trend retention (the SLO/capacity plane's sensing
+        # layer): when a TimeSeriesStore is attached, every beat's
+        # step-stats land as per-rank series — windowed step-time
+        # percentiles and throughput slopes for the fleet scheduler.
+        # None (the default) costs nothing on the beat path.
+        self.timeseries = None
+
+    def attach_timeseries(self, store) -> None:
+        """Feed per-rank heartbeat step-stats into a
+        :class:`~ray_lightning_tpu.telemetry.timeseries.TimeSeriesStore`."""
+        self.timeseries = store
 
     # -- stream consumption -------------------------------------------------
     def _state(self, rank: int) -> _RankState:
@@ -213,6 +224,18 @@ class RunMonitor:
         prev = st.last_beat
         st.beats += 1
         self.beats_received += 1
+        if self.timeseries is not None:
+            rank = int(beat.get("rank", 0))
+            ts = beat.get("ts")
+            for key, kind in (("step_time_ms", "hist"),
+                              ("data_wait_ms", "hist"),
+                              ("examples_per_sec", "gauge"),
+                              ("progress", "counter")):
+                value = beat.get(key)
+                if isinstance(value, (int, float)):
+                    self.timeseries.observe(
+                        f"rank{rank}.{key}", value, kind=kind, ts=ts,
+                    )
         st.last_beat = beat
         st.last_beat_at = now
         st.flagged_lost = False

@@ -55,6 +55,10 @@ __all__ = [
     "validate_recompile_record",
     "validate_program_snapshot",
     "validate_bench_programs",
+    "validate_timeseries_point",
+    "validate_slo_alert",
+    "validate_capacity_snapshot",
+    "validate_bench_slo",
     "FLIGHT_BUNDLE_SCHEMA_ID",
 ]
 
@@ -621,6 +625,10 @@ _SERVE_SNAPSHOT_OPTIONAL = {
     # Prefix-cache engines only (ServeStats.set_prefix, fed from
     # PrefixIndex.stats each gauge refresh).
     "prefix": dict,
+    # Capacity-plane engines only (serve/capacity.py::CapacityOracle —
+    # the headroom oracle's latest capacity_snapshot, so beats carry
+    # it to the router for free).
+    "capacity": dict,
 }
 _SERVE_PREFIX_REQUIRED = {
     "hit_rate": (int, float),
@@ -713,6 +721,161 @@ def validate_serve_snapshot(doc: Any,
             summary, _SERVE_LATENCY_FIELDS, {},
             f"{where}.latency.{family}",
         )
+    if "capacity" in doc:
+        problems += validate_capacity_snapshot(
+            doc["capacity"], f"{where}.capacity"
+        )
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# Fleet SLO & capacity plane (telemetry/timeseries.py, telemetry/slo.py,
+# serve/capacity.py): store persistence points, burn-rate alert events,
+# headroom-oracle snapshots
+# ---------------------------------------------------------------------------
+
+# One retained bin of a TimeSeriesStore series (dump_jsonl / points).
+# hist bins surface their per-bin median as ``value`` plus the merged
+# sample count ``n``; counter/gauge bins carry the bin value alone.
+_TIMESERIES_POINT_REQUIRED = {
+    "type": str,          # always "timeseries_point"
+    "name": str,
+    "kind": str,          # counter | gauge | hist
+    "ts": (int, float),   # bin START (bin_index * interval_s)
+    "value": (int, float),
+}
+_TIMESERIES_POINT_OPTIONAL = {
+    "n": int,             # hist bins only: merged sample count
+}
+_TIMESERIES_KINDS = ("counter", "gauge", "hist")
+
+
+def validate_timeseries_point(point: Any,
+                              where: str = "timeseries_point"
+                              ) -> List[str]:
+    problems = _validate_typed(
+        point, "timeseries_point", _TIMESERIES_POINT_REQUIRED,
+        _TIMESERIES_POINT_OPTIONAL, where,
+    )
+    if problems:
+        return problems
+    if point["kind"] not in _TIMESERIES_KINDS:
+        problems.append(f"{where}: unknown kind {point['kind']!r}")
+    if not point["name"]:
+        problems.append(f"{where}: empty series name")
+    if "n" in point:
+        if point["kind"] != "hist":
+            problems.append(
+                f"{where}: sample count n on a "
+                f"{point['kind']} bin"
+            )
+        elif point["n"] < 1:
+            problems.append(f"{where}: n < 1")
+    return problems
+
+
+# The slo_alert event's ``detail`` payload (the event envelope itself
+# is the stock _EVENT_* shape — alerts ride the existing event plane).
+_SLO_ALERT_DETAIL_REQUIRED = {
+    "slo": str,
+    "mode": str,                        # ratio | threshold
+    "target": (int, float),            # the objective, in (0, 1)
+    "burn_rate": (int, float),         # budget-burn multiple observed
+    "error_rate": (int, float),        # over the slow window, [0, 1]
+    "fast_window_s": (int, float),
+    "slow_window_s": (int, float),
+    "threshold_burn": (int, float),    # the pair's firing bound
+}
+
+
+def validate_slo_alert(item: Any, where: str = "slo_alert") -> List[str]:
+    problems = validate_event(item, where)
+    if problems:
+        return problems
+    if item.get("kind") != "slo_alert":
+        problems.append(
+            f"{where}: kind is {item.get('kind')!r}, expected "
+            f"'slo_alert'"
+        )
+    detail = item.get("detail")
+    if not isinstance(detail, dict):
+        problems.append(f"{where}: missing detail payload")
+        return problems
+    problems += _check_fields(
+        detail, _SLO_ALERT_DETAIL_REQUIRED, {}, f"{where}.detail"
+    )
+    if problems:
+        return problems
+    if not 0.0 < detail["target"] < 1.0:
+        problems.append(
+            f"{where}.detail: target {detail['target']} outside (0, 1)"
+        )
+    if not 0.0 <= detail["error_rate"] <= 1.0:
+        problems.append(
+            f"{where}.detail: error_rate {detail['error_rate']} "
+            f"outside [0, 1]"
+        )
+    if detail["burn_rate"] < 0:
+        problems.append(f"{where}.detail: negative burn_rate")
+    if detail["fast_window_s"] >= detail["slow_window_s"]:
+        problems.append(
+            f"{where}.detail: fast window "
+            f"{detail['fast_window_s']} not shorter than slow "
+            f"{detail['slow_window_s']}"
+        )
+    if detail["mode"] not in ("ratio", "threshold"):
+        problems.append(
+            f"{where}.detail: unknown mode {detail['mode']!r}"
+        )
+    return problems
+
+
+# The headroom oracle's output (CapacityOracle.snapshot — rides the
+# serve snapshot's ``capacity`` block, beats, router snapshots and the
+# rlt_capacity_* prom family).  The derived fields are nullable: the
+# oracle refuses to guess before the per-slot service rate has data.
+_CAPACITY_SNAPSHOT_REQUIRED = {
+    "type": str,          # always "capacity_snapshot"
+    "ts": (int, float),
+    "window_s": (int, float),
+    "tokens_per_s": (int, float),
+    "service_rate_per_slot": (int, float, type(None)),
+    "capacity_tokens_per_s": (int, float, type(None)),
+    "headroom_tokens_per_s": (int, float, type(None)),
+    "utilization": (int, float, type(None)),
+    "kv_exhaustion_eta_s": (int, float, type(None)),
+    "queue_wait_slope_ms_per_s": (int, float, type(None)),
+    "queue_depth": (int, float),
+    "rejection_rate": (int, float),
+}
+
+
+def validate_capacity_snapshot(snap: Any,
+                               where: str = "capacity_snapshot"
+                               ) -> List[str]:
+    problems = _validate_typed(
+        snap, "capacity_snapshot", _CAPACITY_SNAPSHOT_REQUIRED, {}, where
+    )
+    if problems:
+        return problems
+    if snap["window_s"] <= 0:
+        problems.append(f"{where}: window_s <= 0")
+    if snap["tokens_per_s"] < 0:
+        problems.append(f"{where}: negative tokens_per_s")
+    util = snap["utilization"]
+    if isinstance(util, (int, float)) and not 0.0 <= util <= 1.0:
+        problems.append(f"{where}: utilization {util} outside [0, 1]")
+    rej = snap["rejection_rate"]
+    if not 0.0 <= rej <= 1.0:
+        problems.append(
+            f"{where}: rejection_rate {rej} outside [0, 1]"
+        )
+    head = snap["headroom_tokens_per_s"]
+    if isinstance(head, (int, float)) and head < 0:
+        problems.append(f"{where}: negative headroom_tokens_per_s")
+    eta = snap["kv_exhaustion_eta_s"]
+    if isinstance(eta, (int, float)) and eta < 0:
+        problems.append(f"{where}: negative kv_exhaustion_eta_s")
     return problems
 
 
@@ -827,6 +990,24 @@ _ROUTER_REPLICA_OPTIONAL = {
     "prefix_cache_hit_rate": (int, float),
     "recompiles": int,
     "adapters": int,       # loaded LoRA tenants (pool-capable members)
+    # Capacity-plane members only: lifted from the capacity_snapshot
+    # riding the beat's serve snapshot (serve/capacity.py).
+    "headroom_tokens_per_s": (int, float, type(None)),
+    "utilization": (int, float, type(None)),
+    "kv_exhaustion_eta_s": (int, float, type(None)),
+}
+# The fleet-wide capacity roll-up (serve/capacity.py::aggregate_fleet)
+# the router attaches when any member reports a capacity block.
+_ROUTER_SNAPSHOT_OPTIONAL = {
+    "capacity": dict,
+}
+_FLEET_CAPACITY_REQUIRED = {
+    "replicas_reporting": int,
+    "tokens_per_s": (int, float),
+    "capacity_tokens_per_s": (int, float, type(None)),
+    "headroom_tokens_per_s": (int, float, type(None)),
+    "utilization": (int, float, type(None)),
+    "kv_exhaustion_eta_s": (int, float, type(None)),
 }
 _ROUTER_WORKER_OPTIONAL = {
     "last_beat_age_s": (int, float, type(None)),
@@ -865,14 +1046,37 @@ def _validate_router_member(entry: Any, where: str, count_key: str,
         problems.append(
             f"{where}: prefix_cache_hit_rate {hit} outside [0, 1]"
         )
+    util = entry.get("utilization")
+    if isinstance(util, (int, float)) and not 0.0 <= util <= 1.0:
+        problems.append(f"{where}: utilization {util} outside [0, 1]")
     return problems
 
 
 def validate_router_snapshot(doc: Any,
                              where: str = "router_snapshot") -> List[str]:
-    problems = _check_fields(doc, _ROUTER_SNAPSHOT_REQUIRED, {}, where)
+    problems = _check_fields(
+        doc, _ROUTER_SNAPSHOT_REQUIRED, _ROUTER_SNAPSHOT_OPTIONAL, where
+    )
     if problems:
         return problems
+    if "capacity" in doc:
+        cap_problems = _check_fields(
+            doc["capacity"], _FLEET_CAPACITY_REQUIRED, {},
+            f"{where}.capacity",
+        )
+        if not cap_problems:
+            util = doc["capacity"]["utilization"]
+            if isinstance(util, (int, float)) \
+                    and not 0.0 <= util <= 1.0:
+                cap_problems.append(
+                    f"{where}.capacity: utilization {util} "
+                    f"outside [0, 1]"
+                )
+            if doc["capacity"]["replicas_reporting"] < 1:
+                cap_problems.append(
+                    f"{where}.capacity: replicas_reporting < 1"
+                )
+        problems += cap_problems
     for key, value in doc["counters"].items():
         if not isinstance(value, int) or isinstance(value, bool) \
                 or value < 0:
@@ -950,6 +1154,51 @@ def validate_bench_serve(block: Any, where: str = "serve") -> List[str]:
             arm, _BENCH_SERVE_SWEEP_REQUIRED, _BENCH_SERVE_SWEEP_OPTIONAL,
             f"{where}.rate_sweep[{i}]",
         )
+    return problems
+
+
+# The bench_serve.py SLO/capacity-plane block: the oracle-calibration
+# gate (predicted saturation knee vs the measured Poisson-sweep knee),
+# the burn-rate alert discrimination check (fires hot, silent cold),
+# the zero-recompile pin and the plane-overhead A/B.  Headline numbers
+# are non-nullable — a round that cannot calibrate has failed; the
+# overhead ratio is best-effort (CPU noise floor).
+_BENCH_SLO_REQUIRED = {
+    "predicted_saturation_rps": (int, float),
+    "measured_saturation_rps": (int, float),
+    "prediction_error_pct": (int, float),
+    "alerts_hot": int,        # slo_alert events in the 1.5x arm
+    "alerts_cold": int,       # slo_alert events in the 0.5x arm
+    "recompiles_steady_state": int,
+}
+_BENCH_SLO_OPTIONAL = {
+    "overhead_pct": (int, float, type(None)),
+    "capacity_tokens_per_s": (int, float, type(None)),
+    "service_rate_per_slot": (int, float, type(None)),
+    "hot_rps": (int, float),
+    "cold_rps": (int, float),
+    "hot_utilization": (int, float, type(None)),
+    "ts_points": int,         # persisted timeseries_point count
+}
+
+
+def validate_bench_slo(block: Any, where: str = "slo") -> List[str]:
+    """Validate the ``slo`` block of a bench artifact (absent on
+    pre-capacity-plane rounds)."""
+    problems = _check_fields(
+        block, _BENCH_SLO_REQUIRED, _BENCH_SLO_OPTIONAL, where
+    )
+    if problems:
+        return problems
+    for key in ("predicted_saturation_rps", "measured_saturation_rps"):
+        if block[key] <= 0:
+            problems.append(f"{where}: {key} must be > 0")
+    if block["prediction_error_pct"] < 0:
+        problems.append(f"{where}: negative prediction_error_pct")
+    for key in ("alerts_hot", "alerts_cold",
+                "recompiles_steady_state"):
+        if block[key] < 0:
+            problems.append(f"{where}: negative {key}")
     return problems
 
 

@@ -40,9 +40,11 @@ from ray_lightning_tpu.telemetry.schema import (  # noqa: E402
     validate_bench_residual_policy,
     validate_bench_serve,
     validate_bench_serve_disagg,
+    validate_bench_slo,
     validate_bench_spec_decode,
     validate_bench_telemetry,
     validate_bench_trace,
+    validate_capacity_snapshot,
     validate_chrome_trace,
     validate_flight_bundle,
     validate_mpmd_snapshot,
@@ -54,8 +56,10 @@ from ray_lightning_tpu.telemetry.schema import (  # noqa: E402
     validate_serve_reply,
     validate_serve_request,
     validate_serve_snapshot,
+    validate_slo_alert,
     validate_span_jsonl,
     validate_stream_item,
+    validate_timeseries_point,
     validate_trace_context,
 )
 from ray_lightning_tpu.telemetry.spans import SpanTracer  # noqa: E402
@@ -187,6 +191,7 @@ def _self_test_live_plane(tmp: str) -> list:
     problems += _self_test_mpmd()
     problems += _self_test_trace()
     problems += _self_test_programs()
+    problems += _self_test_slo_capacity()
     return problems
 
 
@@ -982,6 +987,213 @@ def _self_test_serve_disagg() -> list:
     return problems
 
 
+def _self_test_slo_capacity() -> list:
+    """SLO & capacity plane producers vs their schema (ISSUE 18): a
+    REAL TimeSeriesStore's points/JSONL dump, a REAL SloEvaluator's
+    fired alert, and a REAL CapacityOracle snapshot fed from real
+    ServeStats snapshots — plus negatives (unknown kind, samples on a
+    non-hist point, a detail-less alert, target outside (0,1),
+    utilization > 1, a bench block missing its cold-arm pin)."""
+    from ray_lightning_tpu.serve.capacity import (
+        CapacityOracle, aggregate_fleet,
+    )
+    from ray_lightning_tpu.serve.metrics import ServeStats
+    from ray_lightning_tpu.telemetry.slo import (
+        SloEvaluator, default_serve_slos,
+    )
+    from ray_lightning_tpu.telemetry.timeseries import TimeSeriesStore
+
+    problems = []
+    clock = [1000.0]
+    store = TimeSeriesStore(interval_s=1.0, capacity=600,
+                            clock=lambda: clock[0])
+    # 200 one-second bins: half the admissions rejected (burn 50x the
+    # 0.99 budget — every window pair must fire), a busy gauge and a
+    # latency hist so every kind appears in the dump.
+    for i in range(200):
+        ts = 1000.0 + i
+        store.observe("submitted", 10 * i, kind="counter", ts=ts)
+        store.observe("rejected", 5 * i, kind="counter", ts=ts)
+        store.observe("queue_wait_p50_ms", 5.0 + i % 3, kind="gauge",
+                      ts=ts)
+        store.observe("token_ms", 4.0 + (i % 5), kind="hist", ts=ts)
+    pts = store.points(window_s=30.0)
+    if not pts:
+        problems.append("self-test timeseries: no points in window")
+    for point in pts:
+        problems += validate_timeseries_point(
+            point, "self-test timeseries point"
+        )
+    with tempfile.TemporaryDirectory(prefix="rlt_ts_") as tmp:
+        path = os.path.join(tmp, "ts.jsonl")
+        n = store.dump_jsonl(path, window_s=30.0)
+        with open(path) as f:
+            lines = [json.loads(ln) for ln in f if ln.strip()]
+        if len(lines) != n:
+            problems.append(
+                f"self-test timeseries: dump_jsonl wrote {len(lines)} "
+                f"lines, reported {n}"
+            )
+        for doc in lines:
+            problems += validate_timeseries_point(
+                doc, "self-test timeseries dump"
+            )
+    good = json_roundtrip(pts[0])
+    if not validate_timeseries_point({**good, "kind": "bogus"}):
+        problems.append(
+            "self-test timeseries: validator accepted an unknown kind"
+        )
+    if not validate_timeseries_point({**good, "spurious": 1}):
+        problems.append(
+            "self-test timeseries: validator accepted an unknown key"
+        )
+    gauge_pt = next(
+        (p for p in pts if p["kind"] == "gauge"), None
+    )
+    if gauge_pt is not None and not validate_timeseries_point(
+        {**json_roundtrip(gauge_pt), "n": 4}
+    ):
+        problems.append(
+            "self-test timeseries: validator accepted a sample count "
+            "on a non-hist point"
+        )
+
+    # The evaluator over the same store: 50% rejections must fire the
+    # availability SLO with a schema-valid alert on the event plane.
+    emitted = []
+    evaluator = SloEvaluator(store, default_serve_slos(),
+                             clock=lambda: clock[0],
+                             emit=emitted.append)
+    alerts = evaluator.evaluate()
+    if not alerts or not emitted:
+        problems.append(
+            "self-test slo: 50% rejection rate did not fire the "
+            "availability alert"
+        )
+    for alert in alerts:
+        problems += validate_slo_alert(alert, "self-test slo alert")
+        problems += validate_stream_item(alert, "self-test slo event")
+    if evaluator.evaluate():
+        problems.append(
+            "self-test slo: still-firing spec re-alerted without "
+            "re-arming (dedup broken)"
+        )
+    if alerts:
+        bad = json_roundtrip(alerts[0])
+        del bad["detail"]
+        if not validate_slo_alert(bad):
+            problems.append(
+                "self-test slo: validator accepted a detail-less alert"
+            )
+        bad = json_roundtrip(alerts[0])
+        bad["detail"]["target"] = 1.5
+        if not validate_slo_alert(bad):
+            problems.append(
+                "self-test slo: validator accepted target outside (0,1)"
+            )
+        bad = json_roundtrip(alerts[0])
+        bad["detail"]["fast_window_s"] = bad["detail"]["slow_window_s"]
+        if not validate_slo_alert(bad):
+            problems.append(
+                "self-test slo: validator accepted fast >= slow window"
+            )
+
+    # The oracle fed from REAL ServeStats snapshots: stable busy slots
+    # and a draining KV pool give a full capacity_snapshot.
+    oracle = CapacityOracle(interval_s=1.0, window_s=30.0,
+                            clock=lambda: clock[0])
+    stats = ServeStats()
+    stats.set_gauges(queue_depth=2, slots_active=4, num_slots=8,
+                     blocks_free=100, num_blocks=200)
+    for i in range(40):
+        stats.bump("tokens_out", 20)
+        stats.bump("submitted", 2)
+        stats.set_gauges(queue_depth=2, slots_active=4, num_slots=8,
+                         blocks_free=100 - 2 * i, num_blocks=200)
+        oracle.observe(stats.snapshot(), recompiles=0, ts=1000.0 + i)
+    clock[0] = 1040.0
+    snap = oracle.snapshot()
+    problems += validate_capacity_snapshot(
+        snap, "self-test capacity snapshot"
+    )
+    if not snap.get("capacity_tokens_per_s"):
+        problems.append(
+            "self-test capacity: oracle measured no ceiling from a "
+            "steady 20 tok/s @ 4/8 slots feed"
+        )
+    if snap.get("kv_exhaustion_eta_s") is None:
+        problems.append(
+            "self-test capacity: a linearly draining KV pool produced "
+            "no exhaustion ETA"
+        )
+    if oracle.predict_saturation_rps(16) is None:
+        problems.append(
+            "self-test capacity: no saturation prediction despite a "
+            "measured service rate"
+        )
+    bad = json_roundtrip(snap)
+    bad["utilization"] = 1.5
+    if not validate_capacity_snapshot(bad):
+        problems.append(
+            "self-test capacity: validator accepted utilization > 1"
+        )
+    bad = json_roundtrip(snap)
+    del bad["headroom_tokens_per_s"]
+    if not validate_capacity_snapshot(bad):
+        problems.append(
+            "self-test capacity: validator accepted a snapshot missing "
+            "its headroom"
+        )
+    fleet = aggregate_fleet([snap, json_roundtrip(snap), None])
+    if not fleet or fleet.get("replicas_reporting") != 2:
+        problems.append(
+            "self-test capacity: fleet fold miscounted live replicas"
+        )
+
+    # The serve snapshot carries the block; the validator must police it
+    # there too.
+    carried = stats.snapshot()
+    carried["capacity"] = json_roundtrip(snap)
+    problems += validate_serve_snapshot(
+        carried, "self-test capacity-bearing serve snapshot"
+    )
+    carried["capacity"]["rejection_rate"] = -0.5
+    if not validate_serve_snapshot(carried):
+        problems.append(
+            "self-test capacity: serve-snapshot validator accepted a "
+            "negative rejection rate in the carried block"
+        )
+
+    block = {
+        "predicted_saturation_rps": 2.4,
+        "measured_saturation_rps": 2.2,
+        "prediction_error_pct": 9.1,
+        "alerts_hot": 1, "alerts_cold": 0,
+        "recompiles_steady_state": 0,
+        "overhead_pct": 0.3,
+        "capacity_tokens_per_s": 38.4,
+        "service_rate_per_slot": 4.8,
+        "hot_rps": 3.3, "cold_rps": 1.1,
+        "hot_utilization": 0.97, "ts_points": 240,
+    }
+    problems += validate_bench_slo(block, "self-test bench slo")
+    if not validate_bench_slo(
+        {k: v for k, v in block.items() if k != "alerts_cold"}
+    ):
+        problems.append(
+            "self-test bench slo: validator accepted a block missing "
+            "the cold-arm alert pin"
+        )
+    if not validate_bench_slo(
+        {**block, "measured_saturation_rps": 0.0}
+    ):
+        problems.append(
+            "self-test bench slo: validator accepted a zero measured "
+            "saturation knee"
+        )
+    return problems
+
+
 def json_roundtrip(doc):
     return json.loads(json.dumps(doc))
 
@@ -1165,6 +1377,9 @@ def scan_bench_files() -> list:
         trace = doc.get("trace") or (serve or {}).get("trace")
         if trace is not None:  # pre-tracing rounds lack it
             problems += validate_bench_trace(trace, f"{name}:trace")
+        slo = doc.get("slo") or (serve or {}).get("slo")
+        if slo is not None:  # pre-SLO-plane rounds lack it
+            problems += validate_bench_slo(slo, f"{name}:slo")
         multi_lora = (doc.get("multi_lora")
                       or (serve or {}).get("multi_lora"))
         if multi_lora is not None:  # pre-multi-tenant rounds lack it

@@ -142,4 +142,19 @@ timeout 1800 python bench.py \
 RLT_PROGRAM_LEDGER=0 timeout 1800 python bench.py \
   2>&1 | tee "tools/hw_logs/${stamp}_bench_ledger_off.log"
 
+log "serve SLO & capacity: saturation-knee calibration + burn-rate alerts (slo block)"
+# Phase 9 predicts the saturation knee from a cold 0.5x Poisson arm
+# (measured decode-tick + admission costs, serve/capacity.py), then
+# measures it with a hot 1.5x arm and gates on prediction error —
+# real-chip tick costs are ~ms, so this is where the oracle's fit and
+# the <2% plane-overhead A/B actually earn their numbers.  The second
+# run doubles the store interval to confirm the fit is bin-width
+# robust on hardware.
+RLT_SLO=1 RLT_CAPACITY=1 RLT_DISAGG_REPLICAS=0 timeout 1800 \
+  python bench_serve.py \
+  2>&1 | tee "tools/hw_logs/${stamp}_bench_serve_slo.log"
+RLT_SLO=1 RLT_CAPACITY=1 RLT_TS_INTERVAL_S=0.5 RLT_DISAGG_REPLICAS=0 \
+  timeout 1800 python bench_serve.py \
+  2>&1 | tee "tools/hw_logs/${stamp}_bench_serve_slo_halfbin.log"
+
 log "done — logs in tools/hw_logs/${stamp}_*.log"

@@ -1,0 +1,297 @@
+"""rlt_bench_diff — cross-round BENCH_*.json trajectory diff.
+
+Rounds are comparable only through their gated keys (tokens/s,
+recompile pins, speedup ratios, overhead percentages — the numbers
+``bench*.py`` gates on and ``telemetry/schema.py`` shapes).  This tool
+diffs those keys between any two round artifacts, direction-aware:
+
+* ``higher`` keys (throughput, speedups, coverage) regress when the
+  new round drops more than the threshold;
+* ``lower`` keys (latency, overhead pcts) regress when it rises;
+* ``zero`` keys (steady-state recompile pins) regress on ANY non-zero
+  value — the zero-recompile contract has no tolerance.
+
+Regressions are flagged LOUDLY (``!! REGRESSION``, non-zero exit under
+``--strict``); blocks absent from either round (feature landed later,
+or a probe was skipped) diff as added/removed, never as failures.
+
+Usage:
+    python tools/rlt_bench_diff.py BENCH_r08.json BENCH_r09.json
+    python tools/rlt_bench_diff.py --latest          # two newest rounds
+    python tools/rlt_bench_diff.py --trajectory      # all rounds, table
+    python tools/rlt_bench_diff.py --selftest        # format.sh layer
+
+stdlib-only, jax-free (runs anywhere the artifacts land).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# Gated keys: (dotted path, direction).  Directions: "higher" is
+# better, "lower" is better, "zero" is a pin (any non-zero regresses).
+GATED_KEYS: Tuple[Tuple[str, str], ...] = (
+    ("value", "higher"),                       # the headline metric
+    ("serve.requests_per_sec", "higher"),
+    ("serve.tokens_per_sec", "higher"),
+    ("serve.p50_token_latency_ms", "lower"),
+    ("serve.p99_token_latency_ms", "lower"),
+    ("serve.continuous_vs_sequential", "higher"),
+    ("serve.recompiles_steady_state", "zero"),
+    ("spec_decode.vs_baseline", "higher"),
+    ("spec_decode.acceptance_rate", "higher"),
+    ("spec_decode.recompiles_steady_state", "zero"),
+    ("trace.coverage", "higher"),
+    ("trace.overhead_pct", "lower"),
+    ("multi_lora.vs_baseline", "higher"),
+    ("multi_lora.fairness_spread", "higher"),
+    ("multi_lora.recompiles_steady_state", "zero"),
+    ("serve_disagg.vs_monolith", "higher"),
+    ("serve_disagg.recompiles_steady_state", "zero"),
+    ("serve_disagg.chaos.lost_requests", "zero"),
+    ("prefix_cache.ttft_speedup", "higher"),
+    ("prefix_cache.hit_rate", "higher"),
+    ("prefix_cache.recompiles_steady_state", "zero"),
+    ("chunked_prefill.recompiles_steady_state", "zero"),
+    ("slo.prediction_error_pct", "lower"),
+    ("slo.alerts_cold", "zero"),
+    ("slo.recompiles_steady_state", "zero"),
+)
+
+# Relative change below which a higher/lower key is noise, not signal.
+DEFAULT_THRESHOLD_PCT = 10.0
+# Denominator floor: near-zero baselines diff by absolute delta
+# against this instead of exploding the percentage.
+_ABS_FLOOR = 1e-9
+
+
+def lookup(doc: Dict[str, Any], path: str) -> Optional[float]:
+    node: Any = doc
+    for part in path.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    if isinstance(node, bool) or not isinstance(node, (int, float)):
+        return None
+    return float(node)
+
+
+def _delta_pct(old: float, new: float) -> Optional[float]:
+    if abs(old) < _ABS_FLOOR:
+        return None  # no meaningful relative change off a ~0 baseline
+    return 100.0 * (new - old) / abs(old)
+
+
+def diff_docs(old: Dict[str, Any], new: Dict[str, Any],
+              threshold_pct: float = DEFAULT_THRESHOLD_PCT
+              ) -> List[Dict[str, Any]]:
+    """One row per gated key present in either round."""
+    rows = []
+    for path, direction in GATED_KEYS:
+        a, b = lookup(old, path), lookup(new, path)
+        if a is None and b is None:
+            continue
+        row: Dict[str, Any] = {
+            "key": path, "direction": direction, "old": a, "new": b,
+        }
+        if a is None:
+            row["status"] = "added"
+        elif b is None:
+            row["status"] = "removed"
+        elif direction == "zero":
+            # The pin: the OLD value being non-zero was that round's
+            # failure; the diff only polices the new one.
+            row["status"] = "regression" if b != 0 else "ok"
+            row["delta_pct"] = None
+        else:
+            pct = _delta_pct(a, b)
+            row["delta_pct"] = pct
+            if pct is None:
+                # ~0 baseline: judge the absolute move (overhead pcts
+                # hovering around the noise floor live here).
+                worse = (b < a) if direction == "higher" else (b > a)
+                big = abs(b - a) > threshold_pct / 10.0
+                row["status"] = "regression" if worse and big else "ok"
+            else:
+                worse = -pct if direction == "higher" else pct
+                if worse > threshold_pct:
+                    row["status"] = "regression"
+                elif worse < -threshold_pct:
+                    row["status"] = "improved"
+                else:
+                    row["status"] = "ok"
+        rows.append(row)
+    return rows
+
+
+def _round_files(root: str = ".") -> List[str]:
+    def key(path):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        return int(m.group(1)) if m else -1
+
+    files = [f for f in glob.glob(os.path.join(root, "BENCH_r*.json"))
+             if key(f) >= 0]
+    return sorted(files, key=key)
+
+
+def _load(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: bench artifact is not an object")
+    return doc
+
+
+def _fmt(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    return f"{v:.3f}".rstrip("0").rstrip(".")
+
+
+def print_diff(rows: List[Dict[str, Any]], old_name: str,
+               new_name: str) -> int:
+    regressions = 0
+    print(f"bench diff: {old_name} -> {new_name}")
+    print(f"{'key':<42} {'old':>10} {'new':>10} {'delta':>9}  status")
+    for row in rows:
+        pct = row.get("delta_pct")
+        delta = f"{pct:+.1f}%" if isinstance(pct, float) else "-"
+        status = row["status"]
+        if status == "regression":
+            regressions += 1
+            status = "!! REGRESSION"
+        print(f"{row['key']:<42} {_fmt(row['old']):>10} "
+              f"{_fmt(row['new']):>10} {delta:>9}  {status}")
+    if regressions:
+        print(f"\n{regressions} REGRESSION(S) in gated keys "
+              f"({old_name} -> {new_name})")
+    else:
+        print("\nno gated-key regressions")
+    return regressions
+
+
+def print_trajectory(paths: List[str]) -> None:
+    docs = [(os.path.basename(p), _load(p)) for p in paths]
+    print("gated-key trajectory across rounds")
+    header = f"{'key':<42}" + "".join(
+        f"{name.replace('BENCH_', '').replace('.json', ''):>9}"
+        for name, _ in docs
+    )
+    print(header)
+    for path, _ in GATED_KEYS:
+        values = [lookup(doc, path) for _, doc in docs]
+        if all(v is None for v in values):
+            continue
+        print(f"{path:<42}"
+              + "".join(f"{_fmt(v):>9}" for v in values))
+
+
+def self_test() -> int:
+    old = {
+        "value": 10.0,
+        "serve": {"requests_per_sec": 10.0, "tokens_per_sec": 160.0,
+                  "p50_token_latency_ms": 20.0,
+                  "p99_token_latency_ms": 40.0,
+                  "recompiles_steady_state": 0},
+        "trace": {"coverage": 1.0, "overhead_pct": 0.1},
+    }
+    new = json.loads(json.dumps(old))
+    new["serve"]["requests_per_sec"] = 8.0          # -20%: regression
+    new["serve"]["p50_token_latency_ms"] = 30.0     # +50%: regression
+    new["serve"]["tokens_per_sec"] = 200.0          # +25%: improved
+    new["serve"]["recompiles_steady_state"] = 2     # pin broken
+    new["slo"] = {"prediction_error_pct": 5.0,
+                  "alerts_cold": 0,
+                  "recompiles_steady_state": 0}     # added block
+    rows = {r["key"]: r for r in diff_docs(old, new)}
+    problems = []
+
+    def expect(key, status):
+        got = rows.get(key, {}).get("status")
+        if got != status:
+            problems.append(f"{key}: expected {status}, got {got}")
+
+    expect("serve.requests_per_sec", "regression")
+    expect("serve.p50_token_latency_ms", "regression")
+    expect("serve.tokens_per_sec", "improved")
+    expect("serve.recompiles_steady_state", "regression")
+    expect("serve.p99_token_latency_ms", "ok")
+    expect("value", "ok")
+    expect("slo.prediction_error_pct", "added")
+    expect("slo.alerts_cold", "added")
+    if "spec_decode.vs_baseline" in rows:
+        problems.append("absent-in-both block produced a row")
+    # Direction sanity: a zero pin that HOLDS must not flag, and a
+    # near-zero overhead baseline must use the absolute-move rule.
+    ok_rows = {r["key"]: r for r in diff_docs(new, new)}
+    for key, row in ok_rows.items():
+        if row["status"] == "regression" and key != \
+                "serve.recompiles_steady_state":
+            problems.append(f"self-diff regressed {key}")
+    shrunk = json.loads(json.dumps(new))
+    shrunk["trace"]["overhead_pct"] = 0.0
+    grown = json.loads(json.dumps(new))
+    grown["trace"]["overhead_pct"] = 5.0
+    if {r["key"]: r for r in diff_docs(shrunk, grown)}[
+            "trace.overhead_pct"]["status"] != "regression":
+        problems.append("overhead_pct absolute-move rule missed a rise")
+    if problems:
+        print("rlt_bench_diff selftest FAILED:")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    print("rlt_bench_diff selftest OK "
+          f"({len(GATED_KEYS)} gated keys)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Direction-aware diff of gated BENCH_*.json keys."
+    )
+    ap.add_argument("old", nargs="?", help="older round artifact")
+    ap.add_argument("new", nargs="?", help="newer round artifact")
+    ap.add_argument("--latest", action="store_true",
+                    help="diff the two newest BENCH_r*.json rounds")
+    ap.add_argument("--trajectory", action="store_true",
+                    help="table of every gated key across all rounds")
+    ap.add_argument("--threshold", type=float,
+                    default=DEFAULT_THRESHOLD_PCT,
+                    help="relative regression threshold (pct)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit non-zero when any gated key regressed")
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return self_test()
+    if args.trajectory:
+        paths = _round_files()
+        if len(paths) < 2:
+            print("need at least two BENCH_r*.json rounds")
+            return 2
+        print_trajectory(paths)
+        return 0
+    if args.latest:
+        paths = _round_files()
+        if len(paths) < 2:
+            print("need at least two BENCH_r*.json rounds")
+            return 2
+        args.old, args.new = paths[-2], paths[-1]
+    if not (args.old and args.new):
+        ap.print_usage()
+        return 2
+    rows = diff_docs(_load(args.old), _load(args.new), args.threshold)
+    regressions = print_diff(rows, os.path.basename(args.old),
+                             os.path.basename(args.new))
+    return 1 if (args.strict and regressions) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -881,6 +881,9 @@ _HOT_JIT = {
     f"{_PKG}/serve/dist/router.py": frozenset({
         "Router.submit_request", "Router._route",
         "Router._ensure_adapter",
+        # Headroom tie-break rides the placement hot path: the key
+        # function must stay a pure dict read, never a jit probe.
+        "Router._headroom",
     }),
     f"{_PKG}/mpmd/stage.py": frozenset({
         "StageRunner._run_opt_step",
@@ -924,6 +927,18 @@ _SCHEMA_PRODUCERS = {
         "make_handoff_item": "SERVE_HANDOFF",
         "make_adapter_load_item": "SERVE_ADAPTER_LOAD",
     },
+    # SLO & capacity plane (ISSUE 18): store points, alert detail,
+    # the oracle snapshot and the router's fleet fold.
+    f"{_PKG}/telemetry/timeseries.py": {
+        "TimeSeriesStore.points": "TIMESERIES_POINT",
+    },
+    f"{_PKG}/telemetry/slo.py": {
+        "_alert_detail": "SLO_ALERT_DETAIL!any",
+    },
+    f"{_PKG}/serve/capacity.py": {
+        "CapacityOracle.snapshot": "CAPACITY_SNAPSHOT",
+        "aggregate_fleet": "FLEET_CAPACITY!any",
+    },
 }
 
 
@@ -950,6 +965,9 @@ def repo_config(repo_root: str) -> Config:
         perf_timing_files=frozenset({
             f"{_PKG}/telemetry/spans.py",
             f"{_PKG}/telemetry/step_stats.py",
+            f"{_PKG}/telemetry/timeseries.py",
+            f"{_PKG}/telemetry/slo.py",
+            f"{_PKG}/serve/capacity.py",
             f"{_PKG}/serve/scheduler.py",
             f"{_PKG}/serve/metrics.py",
             f"{_PKG}/mpmd/transfer.py",

@@ -29,9 +29,16 @@ import json
 import os
 import sys
 import time
+from collections import deque
 from typing import Any, Dict, Optional
 
 _CLEAR = "\x1b[H\x1b[2J"
+# A live artifact older than this is marked STALE in the frame header:
+# every producer rewrites its file at ~1s cadence, so a snapshot this
+# old means the producer stopped — the gauges on screen are history,
+# not state.
+_STALE_AFTER_S = 10.0
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
 
 
 def _load_live_json(path: str) -> Optional[Dict[str, Any]]:
@@ -98,6 +105,53 @@ def load_snapshot(path: str) -> Optional[Dict[str, Any]]:
     return _load_live_json(path)
 
 
+def _spark(values, width: int = 32) -> str:
+    """Unicode sparkline of the last ``width`` values, min-max scaled."""
+    vals = [float(v) for v in values
+            if isinstance(v, (int, float))][-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    top = len(_SPARK_CHARS) - 1
+    return "".join(
+        _SPARK_CHARS[int((v - lo) / span * top)] for v in vals
+    )
+
+
+def note_history(snapshot: Optional[Dict[str, Any]],
+                 history: Dict[str, deque]) -> None:
+    """Accumulate capacity series across frames for the sparkline
+    pane.  Main-loop state — ``render`` itself stays a pure function
+    of (snapshot, history)."""
+    if not snapshot:
+        return
+    serve = snapshot.get("serve") or {}
+    cap = serve.get("capacity")
+    if not isinstance(cap, dict):
+        return
+    for key in ("tokens_per_s", "utilization",
+                "headroom_tokens_per_s", "queue_depth"):
+        value = cap.get(key)
+        if isinstance(value, (int, float)):
+            history.setdefault(key, deque(maxlen=240)).append(
+                float(value)
+            )
+
+
+def _stale_tag(snapshot: Dict[str, Any], now: float) -> str:
+    """The staleness marker (satellite fix: panes used to render
+    instantaneous gauges silently when a live.json stopped
+    refreshing)."""
+    ts = snapshot.get("ts")
+    if not isinstance(ts, (int, float)):
+        return ""
+    age = now - ts
+    if age <= _STALE_AFTER_S:
+        return ""
+    return f"  ** STALE {age:.0f}s — source stopped refreshing **"
+
+
 def _fmt(value: Any, width: int) -> str:
     if value is None:
         text = "-"
@@ -138,6 +192,47 @@ def _render_serve(serve: Dict[str, Any]) -> list:
     lines += _render_prefix(serve)
     lines += _render_lora(serve)
     lines += _render_phases(serve)
+    return lines
+
+
+def _num(value: Any, fmt: str = "{:.1f}") -> str:
+    return fmt.format(value) if isinstance(value, (int, float)) else "-"
+
+
+def _render_capacity(serve: Dict[str, Any],
+                     slo: Optional[Dict[str, Any]] = None,
+                     history: Optional[Dict[str, deque]] = None) -> list:
+    """The capacity pane (capacity-plane engines export a ``capacity``
+    block — serve/capacity.py): measured load vs the predicted
+    ceiling, leading saturation indicators, history sparklines, and
+    the burn-rate state of each SLO."""
+    cap = serve.get("capacity")
+    if not cap:
+        return []
+    eta = cap.get("kv_exhaustion_eta_s")
+    lines = [
+        f"capacity: {_num(cap.get('tokens_per_s'))} tok/s"
+        f" / ceiling {_num(cap.get('capacity_tokens_per_s'))}"
+        f"  util {_num(cap.get('utilization'), '{:.2f}')}"
+        f"  headroom {_num(cap.get('headroom_tokens_per_s'))}"
+        f"  rej {_num(cap.get('rejection_rate'), '{:.2f}')}"
+        + (f"  kv_eta {_num(eta, '{:.0f}')}s"
+           if isinstance(eta, (int, float)) else ""),
+    ]
+    if history:
+        for key, label in (("tokens_per_s", "tok/s"),
+                           ("utilization", "util "),
+                           ("queue_depth", "queue")):
+            series = history.get(key)
+            if series is not None and len(series) >= 2:
+                lines.append(f"          {label} {_spark(series)}")
+    if slo:
+        lines.append("slo:      " + "  ".join(
+            f"{name} burn {state.get('burn_rate', 0.0):.1f}x"
+            f"/{state.get('alerts_total', 0)} alert(s)"
+            + ("  FIRING" if state.get("firing") else "")
+            for name, state in sorted(slo.items())
+        ))
     return lines
 
 
@@ -228,9 +323,20 @@ def _render_router(router: Dict[str, Any]) -> list:
         f"  deaths r{c.get('replica_deaths', 0)}/p"
         f"{c.get('worker_deaths', 0)}"
         f"  respawns {c.get('prefill_respawns', 0)}",
-        "replica  alive  inflight  slots      blocks   beat_age  "
-        "spec_acc  adapters",
     ]
+    fleet = router.get("capacity")
+    if fleet:
+        lines.append(
+            f"fleet:  {_num(fleet.get('tokens_per_s'))} tok/s"
+            f" / ceiling {_num(fleet.get('capacity_tokens_per_s'))}"
+            f"  util {_num(fleet.get('utilization'), '{:.2f}')}"
+            f"  headroom {_num(fleet.get('headroom_tokens_per_s'))}"
+            f"  ({fleet.get('replicas_reporting', 0)} reporting)"
+        )
+    lines.append(
+        "replica  alive  inflight  slots      blocks   beat_age  "
+        "spec_acc  adapters"
+    )
     for r in router.get("replicas", []):
         slots = (f"{r.get('slots_active', 0):.0f}/"
                  f"{r.get('num_slots', 0):.0f}"
@@ -333,29 +439,39 @@ def _render_programs(programs: Dict[str, Any]) -> list:
     return lines
 
 
-def render(snapshot: Optional[Dict[str, Any]], source: str) -> str:
-    """One text frame (pure function — tested directly)."""
+def render(snapshot: Optional[Dict[str, Any]], source: str,
+           history: Optional[Dict[str, deque]] = None,
+           now: Optional[float] = None) -> str:
+    """One text frame (pure function of its inputs — tested directly).
+    ``now`` stamps snapshot age (STALE marking); ``history`` feeds the
+    capacity sparklines (accumulated by :func:`note_history`)."""
     stamp = time.strftime("%H:%M:%S")
     if not snapshot:
         return f"rlt_top {stamp} — no live data at {source} (yet?)\n"
+    if now is None:
+        now = time.time()
+    stale = _stale_tag(snapshot, now)
     if "mpmd" in snapshot and "ranks" not in snapshot:
-        return (f"rlt_top {stamp} — mpmd pipeline\n"
+        return (f"rlt_top {stamp} — mpmd pipeline{stale}\n"
                 + "\n".join(_render_mpmd(snapshot["mpmd"])) + "\n")
     if "serve" in snapshot and "ranks" not in snapshot:
         lines = _render_serve(snapshot["serve"])
+        lines += _render_capacity(snapshot["serve"],
+                                  snapshot.get("slo"), history)
         if snapshot.get("programs"):
             lines += _render_programs(snapshot["programs"])
-        return (f"rlt_top {stamp} — serving engine\n"
+        return (f"rlt_top {stamp} — serving engine{stale}\n"
                 + "\n".join(lines) + "\n")
     if "router" in snapshot and "ranks" not in snapshot:
         return (f"rlt_top {stamp} — serve router "
                 f"({len(snapshot['router'].get('replicas', []))} "
-                f"replica(s))\n"
+                f"replica(s)){stale}\n"
                 + "\n".join(_render_router(snapshot["router"])) + "\n")
     lines = [
         f"rlt_top {stamp} — {snapshot.get('ranks_reporting', 0)} rank(s), "
         f"{snapshot.get('beats', 0)} beats"
-        + ("  ** ABORTED **" if snapshot.get("aborted") else ""),
+        + ("  ** ABORTED **" if snapshot.get("aborted") else "")
+        + stale,
         "",
         "rank   step   epoch  progress  step_ms  wait_ms   age_s  "
         "phase       status",
@@ -375,6 +491,8 @@ def render(snapshot: Optional[Dict[str, Any]], source: str) -> str:
         )
     if snapshot.get("serve"):
         lines += _render_serve(snapshot["serve"])
+        lines += _render_capacity(snapshot["serve"],
+                                  snapshot.get("slo"), history)
     if snapshot.get("router"):
         lines += _render_router(snapshot["router"])
     if snapshot.get("mpmd"):
@@ -406,9 +524,12 @@ def main(argv=None) -> int:
                     help="render a single frame and exit")
     args = ap.parse_args(argv)
 
+    history: Dict[str, deque] = {}
     try:
         while True:
-            frame = render(load_snapshot(args.path), args.path)
+            snapshot = load_snapshot(args.path)
+            note_history(snapshot, history)
+            frame = render(snapshot, args.path, history=history)
             if args.once:
                 sys.stdout.write(frame)
                 return 0
