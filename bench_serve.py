@@ -105,6 +105,19 @@ SIGKILLs the busiest decode replica mid-sweep under Poisson load:
 zero lost requests (failover re-submission onto survivors), with
 failover detection latency and client-deduped re-emission counts in
 the block.  ``RLT_DISAGG_REPLICAS=0`` skips the phase.
+
+The final phase is the **serving-chaos A/B** (the ``serve_chaos``
+block, ``validate_bench_serve_chaos``): a planned drain with
+``RLT_MIGRATE_ON_DRAIN=1`` live-migrates resident KV blocks +
+scheduler position to a survivor (decode resumes mid-sequence, zero
+recomputed prefill) while an abrupt kill takes the recompute-failover
+path; both arms report time-to-recovery (the migration must beat the
+failover), bitwise parity vs an uninterrupted monolith — sampled AND
+greedy — zero lost requests, and steady-state recompiles pinned at
+ZERO.  The full fault x recovery matrix (beat blackhole, torn
+handoff, shm vanish, hedging, brownout) lives in
+``tools/chaos_serve_sweep.py``.  ``RLT_SERVE_CHAOS=0`` skips the
+phase.
 """
 
 from __future__ import annotations
@@ -126,9 +139,9 @@ from ray_lightning_tpu.serve.metrics import ServeStats
 from ray_lightning_tpu.telemetry import compile_event_count
 from ray_lightning_tpu.telemetry.schema import (
     validate_bench_multi_lora, validate_bench_prefix_cache,
-    validate_bench_serve, validate_bench_serve_disagg,
-    validate_bench_slo, validate_bench_spec_decode,
-    validate_bench_trace,
+    validate_bench_serve, validate_bench_serve_chaos,
+    validate_bench_serve_disagg, validate_bench_slo,
+    validate_bench_spec_decode, validate_bench_trace,
 )
 
 PROMPT_LEN = 16
@@ -518,6 +531,155 @@ def _disagg_block(module, params, serve_cfg, monolith_rps,
     finally:
         client.close()
         fleet.close()
+
+
+# Longer generations than the headline arm: the drain has to land
+# while the disturbed stream still has decode left to migrate, and the
+# failover arm's recompute cost (what migration avoids) scales with
+# the tokens already generated at kill time.  The kill lands at 3/4 of
+# the stream — the rolling-restart shape, where long-running sequences
+# are resident at drain time and recompute-from-zero is at its most
+# expensive (inproc members are detected dead instantly via their
+# thread handle, so the unplanned arm pays no detection window here;
+# recomputed work is the whole difference being measured).
+CHAOS_MAX_NEW = 96
+CHAOS_KILL_AT = 3 * CHAOS_MAX_NEW // 4
+
+
+def _serve_chaos_disturb(module, params, serve_cfg, ref, *, hard):
+    """One disturbance arm of the migration-vs-failover A/B: launch a
+    two-replica inproc fleet, start a sampled stream + a greedy
+    companion, take the placed replica down (``hard=False`` = planned
+    drain, ``hard=True`` = abrupt death) and measure time-to-recovery as
+    kill -> first FRESH token AFTER the router booked the recovery (the
+    counter anchor keeps a straggler token already in flight from
+    under-measuring TTR).  Returns the arm's booking dict."""
+    from ray_lightning_tpu.serve.client import ServeClient
+    from ray_lightning_tpu.serve.dist import launch_inproc_fleet
+
+    counter = "failovers" if hard else "migrations"
+    fleet = launch_inproc_fleet(
+        module, params, serve_cfg, n_replicas=2, n_prefill=0,
+        lost_after_s=0.5,
+    )
+    client = ServeClient(fleet.queue_handle())
+    try:
+        prompts = _prompts(2, module.config.vocab_size, seed=303)
+        r1 = client.submit(prompts[0], CHAOS_MAX_NEW, temperature=0.7)
+        r2 = client.submit(prompts[1], CHAOS_MAX_NEW)
+
+        def streaming():
+            track = fleet.router._inflight.get(r1)
+            return (track is not None and track.replica is not None
+                    and len(client._pending[r1].tokens) >= CHAOS_KILL_AT)
+
+        deadline = time.perf_counter() + 60
+        while not streaming():
+            if time.perf_counter() > deadline:
+                raise RuntimeError("disturbed stream never started")
+            time.sleep(0.01)
+        victim = fleet.router._inflight[r1].replica
+        t_kill = time.perf_counter()
+        next(r for r in fleet.replicas if r.id == victim).kill(hard=hard)
+        deadline = time.perf_counter() + 60
+        while fleet.router.counters[counter] < 1:
+            if time.perf_counter() > deadline:
+                raise RuntimeError(f"router never booked a {counter!r}")
+            time.sleep(0.01)
+        n_base = len(client._pending[r1].tokens)
+        while len(client._pending[r1].tokens) <= n_base:
+            if time.perf_counter() > deadline:
+                raise RuntimeError("stream never resumed post-recovery")
+            time.sleep(0.005)
+        ttr = time.perf_counter() - t_kill
+
+        lost = 0
+        outs = []
+        for rid in (r1, r2):
+            try:
+                outs.append(client.result(rid, timeout=600))
+            except Exception:  # noqa: BLE001 - booked as a lost request
+                lost += 1
+                outs.append(None)
+        parity = all(o is not None and o == r
+                     for o, r in zip(outs, ref))
+        re_emitted = client.re_emitted_tokens
+
+        # Steady-state pin AFTER recovery: a second wave must replay
+        # every compiled program (the one cold kv_import executable is
+        # allowed to compile DURING recovery, never after it).
+        before = compile_event_count()
+        w1 = client.submit(prompts[0], CHAOS_MAX_NEW, temperature=0.7)
+        w2 = client.submit(prompts[1], CHAOS_MAX_NEW)
+        client.result(w1, timeout=600)
+        client.result(w2, timeout=600)
+        steady = compile_event_count() - before
+        counters = fleet.router.counters
+        return {
+            "ttr_s": round(ttr, 3),
+            "lost": lost,
+            "parity": parity,
+            "re_emitted": re_emitted,
+            "steady": int(steady),
+            "migrations": counters["migrations"],
+            "failovers": counters["failovers"],
+        }
+    finally:
+        client.close()
+        fleet.close()
+
+
+def _serve_chaos_block(module, params, serve_cfg) -> dict:
+    """Phase 10: planned-drain live KV migration vs recompute failover.
+
+    The A/B behind the rolling-restart story: arm A drains the placed
+    replica with ``RLT_MIGRATE_ON_DRAIN=1`` (resident KV blocks +
+    scheduler position move to the survivor, decode resumes
+    mid-sequence, zero recomputed prefill); arm B SIGKILL-style kills
+    it (recompute failover, the client dedups re-emitted tokens).  Both
+    arms must stream bitwise-identical tokens vs an uninterrupted
+    monolith engine — sampled AND greedy — lose nothing, and leave no
+    cold executables behind.  The full fault matrix lives in
+    ``tools/chaos_serve_sweep.py``; this block pins the headline
+    numbers per bench round."""
+    ref_eng = ServeEngine(module, params, serve_cfg)
+    prompts = _prompts(2, module.config.vocab_size, seed=303)
+    ref = (ref_eng.generate(prompts[0], CHAOS_MAX_NEW, temperature=0.7),
+           ref_eng.generate(prompts[1], CHAOS_MAX_NEW))
+    ref_eng.stop()
+
+    os.environ["RLT_MIGRATE_ON_DRAIN"] = "1"
+    try:
+        # Unmeasured warmup drain: the survivor's kv_import program
+        # compiles on the first migration this process ever runs; pay
+        # that once here so the measured arm reports steady-state TTR
+        # (compile time is the ledger's to book, not a latency number
+        # to smuggle into the A/B).
+        _serve_chaos_disturb(module, params, serve_cfg, ref,
+                             hard=False)
+        mig = _serve_chaos_disturb(module, params, serve_cfg, ref,
+                                   hard=False)
+    finally:
+        os.environ.pop("RLT_MIGRATE_ON_DRAIN", None)
+    failover = _serve_chaos_disturb(module, params, serve_cfg, ref,
+                                    hard=True)
+    return {
+        "requests": 4,
+        "migrations": mig["migrations"],
+        "migration_ttr_s": mig["ttr_s"],
+        "failover_ttr_s": failover["ttr_s"],
+        # Speedup of the planned path over the unplanned one: drain
+        # skips the lost_after_s detection window AND the recomputed
+        # prefill, so this must land >= 1.
+        "migration_vs_failover": round(
+            failover["ttr_s"] / max(mig["ttr_s"], 1e-9), 3
+        ),
+        "lost_requests": mig["lost"] + failover["lost"],
+        "parity": mig["parity"] and failover["parity"],
+        "migration_re_emitted_tokens": mig["re_emitted"],
+        "failover_re_emitted_tokens": failover["re_emitted"],
+        "recompiles_steady_state": mig["steady"] + failover["steady"],
+    }
 
 
 LORA_REQUESTS_PER_TENANT = 2
@@ -1128,6 +1290,11 @@ def main() -> None:
         slo_block = _slo_block(module, params, serve_cfg, cfg,
                                cont_rps)
 
+    # Phase 10: planned-drain live migration vs recompute failover A/B.
+    chaos_block = None
+    if os.environ.get("RLT_SERVE_CHAOS", "1") != "0":
+        chaos_block = _serve_chaos_block(module, params, serve_cfg)
+
     # Compiled-program observatory: by this point every serve plane ran
     # (bucketed prefills, decode, chunked prefill, draft + K+1 verify,
     # LoRA scatter), so the process ledger must hold each steady-state
@@ -1233,6 +1400,43 @@ def main() -> None:
                 f"{disagg_block['chaos']['lost_requests']} request(s) "
                 "LOST across the replica kill — failover bar is zero"
             )
+    if chaos_block is not None:
+        problems += validate_bench_serve_chaos(chaos_block)
+        if chaos_block["migrations"] < 1:
+            problems.append(
+                "serve_chaos: planned drain landed no migration frame "
+                "— the drain fell back to recompute failover"
+            )
+        if chaos_block["lost_requests"]:
+            problems.append(
+                f"serve_chaos: {chaos_block['lost_requests']} "
+                "request(s) LOST across the drain/kill arms — the "
+                "resilience bar is zero"
+            )
+        if not chaos_block["parity"]:
+            problems.append(
+                "serve_chaos: recovered streams diverged from the "
+                "uninterrupted monolith reference"
+            )
+        if chaos_block["migration_re_emitted_tokens"]:
+            problems.append(
+                "serve_chaos: migration_re_emitted_tokens = "
+                f"{chaos_block['migration_re_emitted_tokens']} — a "
+                "live migration recomputed prefill"
+            )
+        if chaos_block["recompiles_steady_state"]:
+            problems.append(
+                "serve_chaos: recompiles_steady_state = "
+                f"{chaos_block['recompiles_steady_state']} — recovery "
+                "left cold executables behind in one of the arms"
+            )
+        if chaos_block["migration_vs_failover"] < 1.0:
+            problems.append(
+                "serve_chaos: migration TTR "
+                f"{chaos_block['migration_ttr_s']}s did not beat "
+                f"failover TTR {chaos_block['failover_ttr_s']}s — the "
+                "planned path must win"
+            )
     if slo_block is not None:
         problems += validate_bench_slo(slo_block)
         if (slo_block["prediction_error_pct"] is None
@@ -1291,6 +1495,8 @@ def main() -> None:
         out["prefix_cache"] = prefix_block
     if slo_block is not None:
         out["slo"] = slo_block
+    if chaos_block is not None:
+        out["serve_chaos"] = chaos_block
     print(json.dumps(out))
 
 

@@ -21,7 +21,9 @@
 #      subprocesses/fits) — the RLT_FAULT grammar, deterministic
 #      matching, exactly-once markers and the file corruptors vs the
 #      checkpoint verifier.  The full fault matrix lives in
-#      "python tools/chaos_sweep.py" / "pytest -m chaos";
+#      "python tools/chaos_sweep.py" / "pytest -m chaos"; the serving
+#      sibling (tools/chaos_serve_sweep.py --selftest) gates the serve
+#      fault templates, brownout ladder and retry/hedge maths;
 #   6. rlt-lint (tools/rlt_lint, stdlib-ast only) — the repo's own
 #      invariants as machine checks: hot-path jit/host-sync bans,
 #      guarded-by lock discipline, clock discipline, the RLT_* env-bus
@@ -137,6 +139,11 @@ python tools/rlt_bench_diff.py --selftest || fail=1
 # corruptor/verifier pair, so a drifted RLT_FAULT parser can't silently
 # turn the recovery acceptance suite into a no-op.
 python tools/chaos_sweep.py --selftest || fail=1
+# Serving-plane sibling (tools/chaos_serve_sweep.py --selftest): the
+# serve fault templates, the brownout ladder's hysteresis/probe logic,
+# client retry backoff maths, and the scorecard->bench-block contract.
+# The full serving matrix lives in "python tools/chaos_serve_sweep.py".
+python tools/chaos_serve_sweep.py --selftest || fail=1
 
 # -- layer 6: rlt-lint invariant checks (stdlib-ast, zero extra deps) --------
 # The fixture matrix self-tests every rule (a rule edit that stops

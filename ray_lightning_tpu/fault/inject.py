@@ -14,6 +14,14 @@ Grammar (``RLT_FAULT``)::
 
     kinds: crash   — os._exit(13): hard process death (OOM/preemption
                      without grace)
+           blackhole — raise FaultBlackhole at the injection point:
+                     send-sites (beats, KV handoffs) catch it and
+                     silently DROP the frame — the network-partition
+                     signature (process alive, messages vanish)
+           shm_vanish — unlink the injection point's ``path`` (a tmpfs
+                     KV segment): the frame still ships but its
+                     payload is gone when the consumer maps it — the
+                     segment-TTL / cross-host race signature
            lose_worker — crash, PLUS a fleet-capacity loss recorded in
                      the ``RLT_FAULT_STATE`` dir: the restart governor's
                      capacity oracle (:func:`lost_worker_count`) then
@@ -37,7 +45,16 @@ Grammar (``RLT_FAULT``)::
 
     keys:  point — injection point name (default "step"):
                    spawn | step | queue_put | ckpt_write | meta_write
+                   | handoff_send | handoff_read | replica_tick | beat
+                   | adapter_load
+                   (the serve plane: a prefill worker's handoff send,
+                   a replica's handoff admission, one engine step, a
+                   member's liveness beat, an adapter-load frame)
            rank  — only this global rank (default: any)
+           replica — only the decode replica with this member id
+                   (serve plane; see :func:`set_member`)
+           worker — only the prefill worker with this member id
+           rid   — only the request with this id (handoff points)
            stage — alias for ``rank`` on the MPMD pipeline plane: the
                    stage WORKER index (= actor rank; under
                    ``interleave=v`` worker ``p`` hosts the virtual
@@ -62,6 +79,10 @@ Examples::
     RLT_FAULT="hang@step:5,rank:0,secs:120"
     RLT_FAULT="sigterm@step:3,rank:0"
     RLT_FAULT="bitflip@point:ckpt_write,nth:2;crash@step:9"
+    RLT_FAULT="blackhole@point:beat,replica:decode-0"
+    RLT_FAULT="torn@point:handoff_send,worker:prefill-0,nth:2"
+    RLT_FAULT="shm_vanish@point:handoff_send,rid:abc123"
+    RLT_FAULT="slow@point:replica_tick,replica:decode-1,secs:0.5,once:0"
 
 Determinism across elastic restarts: set ``RLT_FAULT_STATE=<dir>`` (a
 directory shared by all workers); each fired ``once`` spec drops a
@@ -81,15 +102,18 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = [
     "FaultSpec",
     "FaultInjected",
+    "FaultBlackhole",
     "parse_faults",
     "fire",
     "set_rank",
+    "set_member",
     "step_fault_in_range",
     "record_worker_loss",
     "lost_worker_count",
@@ -100,14 +124,24 @@ __all__ = [
 log = logging.getLogger(__name__)
 
 KINDS = ("crash", "exc", "hang", "slow", "sigterm", "torn", "bitflip",
-         "lose_worker")
-POINTS = ("spawn", "step", "queue_put", "ckpt_write", "meta_write")
+         "lose_worker", "blackhole", "shm_vanish")
+POINTS = ("spawn", "step", "queue_put", "ckpt_write", "meta_write",
+          "handoff_send", "handoff_read", "replica_tick", "beat",
+          "adapter_load")
 
 _CRASH_EXIT_CODE = 13
 
 
 class FaultInjected(RuntimeError):
     """The exception the ``exc`` fault kind raises."""
+
+
+class FaultBlackhole(FaultInjected):
+    """The ``blackhole`` kind: raised at a send-site injection point,
+    caught THERE, and the frame silently dropped — the process stays
+    alive while its messages vanish (a network partition, not a death).
+    Subclasses :class:`FaultInjected` so generic chaos handlers still
+    recognise it."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -122,10 +156,16 @@ class FaultSpec:
     nth: Optional[int] = None
     secs: Optional[float] = None
     once: bool = True
+    replica: Optional[str] = None  # decode-member pin (serve plane)
+    worker: Optional[str] = None   # prefill-member pin (serve plane)
+    rid: Optional[str] = None      # request pin (handoff points)
     index: int = 0  # position in the RLT_FAULT list (marker identity)
 
     def matches(self, point: str, rank: Optional[int],
-                step: Optional[int], epoch: Optional[int]) -> bool:
+                step: Optional[int], epoch: Optional[int], *,
+                replica: Optional[str] = None,
+                worker: Optional[str] = None,
+                rid: Optional[str] = None) -> bool:
         """Coordinate match — everything except the nth/once gates,
         which are stateful and live on the plan."""
         if self.point != point:
@@ -135,6 +175,12 @@ class FaultSpec:
         if self.step is not None and step != self.step:
             return False
         if self.epoch is not None and epoch != self.epoch:
+            return False
+        if self.replica is not None and replica != self.replica:
+            return False
+        if self.worker is not None and worker != self.worker:
+            return False
+        if self.rid is not None and rid != self.rid:
             return False
         return True
 
@@ -172,6 +218,8 @@ def parse_faults(value: str) -> List[FaultSpec]:
                     kw["point"] = val
                 elif key in ("rank", "step", "epoch", "nth"):
                     kw[key] = int(val)
+                elif key in ("replica", "worker", "rid"):
+                    kw[key] = val
                 elif key == "stage":
                     # MPMD alias: a stage worker's process rank IS its
                     # stage index (StageRunner fires with rank=stage).
@@ -218,10 +266,14 @@ class FaultPlan:
             log.warning("fault marker %s could not be written", marker)
 
     def due(self, point: str, rank: Optional[int], step: Optional[int],
-            epoch: Optional[int]) -> List[FaultSpec]:
+            epoch: Optional[int],
+            replica: Optional[str] = None,
+            worker: Optional[str] = None,
+            rid: Optional[str] = None) -> List[FaultSpec]:
         due = []
         for spec in self.specs:
-            if not spec.matches(point, rank, step, epoch):
+            if not spec.matches(point, rank, step, epoch,
+                                replica=replica, worker=worker, rid=rid):
                 continue
             if spec.nth is not None:
                 # Occurrence counting happens on COORDINATE matches, so
@@ -243,6 +295,13 @@ _plan_key: Optional[Tuple[str, Optional[str]]] = None
 _plan: Optional[FaultPlan] = None
 
 _ctx_rank: Optional[int] = None
+# Serve-member identity is THREAD-local, not process-global: an inproc
+# fleet runs every replica/worker of the fleet inside one driver
+# process, each on its own serve/beat threads — a process-global pin
+# would attribute one member's faults to whichever member registered
+# last.  Each member-owned thread (engine serve loop, runner beat loop,
+# prefill work thread) declares its own identity.
+_ctx_member = threading.local()
 
 
 def set_rank(rank: Optional[int]) -> None:
@@ -251,6 +310,21 @@ def set_rank(rank: Optional[int]) -> None:
     ``rank:`` conditions."""
     global _ctx_rank
     _ctx_rank = rank
+
+
+def set_member(role: Optional[str], member_id: Optional[str]) -> None:
+    """Record the CALLING THREAD's serve-fleet identity (``role`` is
+    ``"decode"`` or ``"prefill"``) so serve injection points honor
+    ``replica:``/``worker:`` pins without threading ids through every
+    call site.  ``set_member(None, None)`` clears it (tests)."""
+    if role is None:
+        _ctx_member.replica = _ctx_member.worker = None
+    elif role == "decode":
+        _ctx_member.replica = str(member_id)
+        _ctx_member.worker = None
+    else:
+        _ctx_member.replica = None
+        _ctx_member.worker = str(member_id)
 
 
 def _current_plan() -> Optional[FaultPlan]:
@@ -377,6 +451,22 @@ def _execute(spec: FaultSpec, point: str, path: Optional[str]) -> None:
         raise FaultInjected(
             f"injected exception at {point} (spec #{spec.index})"
         )
+    if spec.kind == "blackhole":
+        raise FaultBlackhole(
+            f"injected blackhole at {point} (spec #{spec.index})"
+        )
+    if spec.kind == "shm_vanish":
+        if path is None:
+            log.warning(
+                "chaos: shm_vanish fault at %s has no segment path — "
+                "skipped", point,
+            )
+            return
+        try:
+            os.unlink(path)
+        except OSError as e:
+            log.warning("shm_vanish fault on %s failed: %r", path, e)
+        return
     if spec.kind == "hang":
         time.sleep(spec.secs if spec.secs is not None else 3600.0)
         return
@@ -446,18 +536,22 @@ def step_fault_in_range(start: int, stop: int, *,
 
 def fire(point: str, *, step: Optional[int] = None,
          epoch: Optional[int] = None, rank: Optional[int] = None,
-         path: Optional[str] = None) -> None:
+         path: Optional[str] = None, rid: Optional[str] = None) -> None:
     """An injection point: fire every due fault for these coordinates.
 
     Near-zero cost when ``RLT_FAULT`` is unset.  ``rank`` defaults to
-    the process context set by :func:`set_rank`.
+    the process context set by :func:`set_rank`; serve member pins
+    (``replica:``/``worker:``) resolve against :func:`set_member`.
     """
     plan = _current_plan()
     if plan is None:
         return
     if rank is None:
         rank = _ctx_rank
-    for spec in plan.due(point, rank, step, epoch):
+    for spec in plan.due(point, rank, step, epoch,
+                         replica=getattr(_ctx_member, "replica", None),
+                         worker=getattr(_ctx_member, "worker", None),
+                         rid=rid):
         # Mark BEFORE executing: crash/sigterm never return, and the
         # whole contract is that the respawned worker trains through.
         if spec.once:

@@ -104,6 +104,24 @@ KNOBS: Tuple[EnvKnob, ...] = (
     EnvKnob("RLT_HEADROOM_ROUTING", False,
             "router placement tie-break on reported headroom (resolved "
             "once at router build; router is driver/agent-local)"),
+    # -- serving-plane resilience (ISSUE 19) -----------------------------
+    EnvKnob("RLT_MIGRATE_ON_DRAIN", True,
+            "planned-drain live KV migration gate (0 = recompute "
+            "failover only; read by the replica runner, so actor "
+            "replicas need the bridge)"),
+    EnvKnob("RLT_BROWNOUT", False,
+            "router overload brownout ladder gate (resolved once at "
+            "router build; router is driver/agent-local)"),
+    EnvKnob("RLT_HEDGE", False,
+            "client hedged-resubmit gate (ServeClient RetryPolicy; "
+            "client-local by definition)"),
+    EnvKnob("RLT_RETRY_MAX", False,
+            "client retry attempts on typed rejections (client-local)"),
+    EnvKnob("RLT_RETRY_BACKOFF_S", False,
+            "client retry backoff base seconds (client-local)"),
+    EnvKnob("RLT_SERVE_CHAOS", False,
+            "bench_serve: skip the migration-vs-failover serve_chaos "
+            "phase when 0 (bench-process-local gate)"),
 )
 
 

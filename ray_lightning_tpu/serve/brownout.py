@@ -1,0 +1,105 @@
+"""Overload brownout ladder for the serving router (ISSUE 19).
+
+Graceful degradation under sustained overload, as a tiny hysteresis
+state machine the router feeds with fleet utilization (the
+``aggregate_fleet`` view built from per-replica
+:class:`~ray_lightning_tpu.serve.capacity.CapacityOracle` beat
+blocks).  Levels, each subsuming the one below:
+
+* **0 — healthy**: no intervention.
+* **1 — degrade**: speculative draft lanes forced off (``spec -> 0``).
+  Draft FLOPs are the cheapest capacity to reclaim: acceptance-rate
+  upside evaporates exactly when the fleet is saturated, because the
+  target-model verify pass is the bottleneck either way.
+* **2 — clamp**: ``max_new_tokens`` capped at ``max_new_cap`` on top.
+  Bounded responses bound per-request slot residency, which bounds
+  queue wait — the dominant p99 term under overload.
+* **3 — shed**: best-effort traffic (``priority < 1``) gets the typed
+  retryable ``shed`` reply on top.  Paying/priority traffic
+  (``priority >= 1``) still admits.  One **half-open probe** request
+  per ``probe_every_s`` is let through the shed gate so the ladder can
+  sense recovery from the probe's effect on utilization — without it
+  a fully-shedding fleet reports zero load and looks healthy while
+  serving nobody.
+
+Hysteresis: climbing rung ``i`` requires utilization >= ``enter[i]``;
+descending requires utilization < ``enter[i] - exit_margin``, and
+every move waits out ``min_dwell_s`` since the last one — a noisy
+utilization signal oscillating around a threshold must not flap the
+admission policy every poll tick.
+
+jax-free host logic; the clock is injectable for deterministic tests
+(``rlt: clock-injectable``).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Sequence
+
+__all__ = ["BrownoutLadder"]
+
+
+class BrownoutLadder:
+    """See module docstring.  Not thread-safe by itself — the router
+    only touches it under its own control-plane lock."""
+
+    def __init__(
+        self,
+        *,
+        enter: Sequence[float] = (0.85, 0.95, 1.0),
+        exit_margin: float = 0.10,
+        min_dwell_s: float = 2.0,
+        probe_every_s: float = 5.0,
+        max_new_cap: int = 64,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if len(enter) != 3:
+            raise ValueError(f"enter must name 3 rung thresholds: {enter}")
+        if sorted(enter) != list(enter):
+            raise ValueError(f"enter thresholds must be ascending: {enter}")
+        if exit_margin <= 0:
+            raise ValueError(f"exit_margin must be > 0: {exit_margin}")
+        if max_new_cap < 1:
+            raise ValueError(f"max_new_cap must be >= 1: {max_new_cap}")
+        self.enter = tuple(float(e) for e in enter)
+        self.exit_margin = float(exit_margin)
+        self.min_dwell_s = float(min_dwell_s)
+        self.probe_every_s = float(probe_every_s)
+        self.max_new_cap = int(max_new_cap)
+        self._clock = clock
+        self.level = 0
+        self._last_change: Optional[float] = None
+        self._last_probe: Optional[float] = None
+
+    def observe(self, utilization: float,
+                now: Optional[float] = None) -> int:
+        """Ingest one fleet-utilization sample; returns the (possibly
+        updated) level.  Moves one rung at a time: a single wild sample
+        cannot jump a healthy fleet straight to shedding."""
+        now = self._clock() if now is None else now
+        dwelt = (self._last_change is None
+                 or now - self._last_change >= self.min_dwell_s)
+        if self.level < 3 and utilization >= self.enter[self.level]:
+            # First climb off healthy is immediate — overload response
+            # latency matters more than flap protection at level 0.
+            if dwelt or self.level == 0:
+                self.level += 1
+                self._last_change = now
+        elif self.level > 0 \
+                and utilization < self.enter[self.level - 1] \
+                - self.exit_margin:
+            if dwelt:
+                self.level -= 1
+                self._last_change = now
+        return self.level
+
+    def allow_probe(self, now: Optional[float] = None) -> bool:
+        """At shed level, admit one best-effort request per
+        ``probe_every_s`` as the half-open recovery probe."""
+        now = self._clock() if now is None else now
+        if self._last_probe is None \
+                or now - self._last_probe >= self.probe_every_s:
+            self._last_probe = now
+            return True
+        return False

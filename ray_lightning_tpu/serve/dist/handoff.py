@@ -45,6 +45,8 @@ __all__ = [
     "make_adapter_load_item",
     "make_hello_item",
     "make_beat_item",
+    "make_migration_item",
+    "make_cancel_item",
     "encode_kv_payload",
     "decode_kv_payload",
 ]
@@ -216,12 +218,14 @@ def request_fields(
     spec: Optional[int] = None,
     adapter: Optional[str] = None,
     deadline_s: Optional[float] = None,
+    priority: int = 0,
     trace=None,
 ) -> Dict[str, Any]:
     """The canonical request dict that rides inside dispatch/handoff
     frames (a ``serve_request`` body with the router's fleet-wide
     ``sample_seed`` — and, on tracing routers, the request's
-    ``TraceContext`` — attached)."""
+    ``TraceContext`` — attached).  ``priority`` is the brownout shed
+    class: 0 (default) sheds first under overload, >= 1 survives."""
     item = {
         "type": "serve_request",
         "rid": str(rid),
@@ -234,6 +238,7 @@ def request_fields(
         "adapter": None if adapter is None else str(adapter),
         "deadline_s": deadline_s,
         "sample_seed": int(sample_seed),
+        "priority": int(priority),
         "reply": list(reply),
     }
     if trace is not None:
@@ -344,14 +349,17 @@ def make_beat_item(
     recompiles: Optional[int] = None,
     adapters: Optional[Sequence[str]] = None,
     closing: bool = False,
+    migrating: Optional[Sequence[str]] = None,
 ) -> Dict[str, Any]:
     """Periodic member liveness + completion feed.  ``done`` carries
     terminal ``(rid, status)`` pairs since the last beat (the router's
     in-flight pruning signal); ``failed`` carries ``(rid, error)``
-    pairs a prefill worker could not hand off (the router re-routes
-    them); ``adapters`` advertises the member's loaded LoRA tenants
+    pairs a member could not serve (the router re-routes them);
+    ``adapters`` advertises the member's loaded LoRA tenants
     (adapter-aware placement routes a tenant's requests to members
-    already holding its factors)."""
+    already holding its factors); ``migrating`` claims a rid set whose
+    live-KV export is in flight — the router suppresses beat-loss
+    failover for the member until the claim resolves or expires."""
     item: Dict[str, Any] = {
         "type": "serve_replica_beat",
         "role": role,
@@ -368,7 +376,55 @@ def make_beat_item(
         item["adapters"] = [str(a) for a in adapters]
     if closing:
         item["closing"] = True
+    if migrating is not None:
+        item["migrating"] = [str(r) for r in migrating]
     return item
+
+
+def make_migration_item(
+    req: Dict[str, Any],
+    *,
+    generated: Sequence[int],
+    cur_token: int,
+    seq_len: int,
+    data: bytes,
+    trace=None,
+) -> Dict[str, Any]:
+    """Draining replica → router → survivor replica: one resident
+    sequence's live state.  ``req`` is the canonical ``request_fields``
+    dict (reply address + fleet-wide ``sample_seed`` included — the
+    position-keyed sampler makes the continued stream bitwise-identical
+    on any survivor slot).  ``generated`` are the tokens already
+    emitted, ``cur_token`` the last sampled token (the next decode
+    tick's input), ``seq_len`` the KV positions written
+    (``prompt_len + len(generated) - 1`` — the final sampled token's KV
+    is never written until its own tick).  ``data`` is the
+    ``encode_tree({"kv": ...})`` export of the sequence's blocks;
+    migration frames ride the ordered beat lane, so the payload is
+    always inline bytes (never a tmpfs segment that would dangle if the
+    draining host dies)."""
+    item: Dict[str, Any] = {
+        "type": "serve_migration",
+        "rid": str(req["rid"]),
+        "req": dict(req),
+        "generated": [int(t) for t in generated],
+        "cur_token": int(cur_token),
+        "seq_len": int(seq_len),
+        "data": data,
+    }
+    if trace is not None:
+        from ray_lightning_tpu.telemetry.propagate import inject
+
+        inject(item, trace)
+    return item
+
+
+def make_cancel_item(rid: str) -> Dict[str, Any]:
+    """Router → decode replica: drop ``rid`` wherever it is (queued or
+    mid-decode), silently — the first-winner cancel of a hedged pair.
+    The replica reports it terminal with status ``cancelled`` on its
+    done feed (never to the client — the winner already replied)."""
+    return {"type": "serve_cancel", "rid": str(rid)}
 
 
 def encode_kv_payload(kv: Dict[str, Any], logits: Any) -> bytes:

@@ -28,6 +28,9 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_lightning_tpu.fault.inject import (
+    FaultBlackhole, fire as _fault_fire, set_member,
+)
 from ray_lightning_tpu.serve.dist.handoff import (
     KV_SEGMENT_PREFIX, CachedSender, encode_kv_payload, make_beat_item,
     make_handoff_item, make_hello_item,
@@ -237,10 +240,14 @@ class PrefillRunner:
         asymmetry the training monitor's heartbeat publisher relies on.
         A beat-starved worker would be declared lost and its dispatches
         redundantly re-routed on its very first compile."""
+        set_member("prefill", self.worker_id)
         self.hello()
         done = threading.Event()
 
         def beat_loop():
+            # Member identity is thread-local: the beat thread declares
+            # its own so worker:-pinned beat faults fire here too.
+            set_member("prefill", self.worker_id)
             while not done.is_set():
                 self._maybe_beat()
                 done.wait(min(self.beat_s, 0.1))
@@ -284,6 +291,7 @@ class PrefillRunner:
                     "serve_adapter_load on a prefill worker without an "
                     "adapter pool (serve_cfg.max_adapters == 0)"
                 )
+            _fault_fire("adapter_load", rid=str(item.get("name", "")))
             name = str(item["name"])
             if self.prefix is not None:
                 # A hot-(re)load may replace the adapter's weights:
@@ -422,6 +430,17 @@ class PrefillRunner:
                 out = make_handoff_item(req, bucket, data=payload,
                                         trace=handoff_trace)
         try:
+            # Serve fault grammar: shm_vanish unlinks the segment here
+            # (the consumer's read then fails retryably), torn corrupts
+            # it, blackhole drops the frame below.
+            _fault_fire("handoff_send", rid=rid, path=shm_path)
+        except FaultBlackhole:
+            # Injected partition: the frame is "sent" but never
+            # arrives.  An shm segment ages out via the TTL janitor,
+            # exactly like a real replica death between send and read;
+            # recovery is client/router-driven (deadline + retry).
+            return
+        try:
             self._put(tuple(item["kv_to"]), out)
         except (OSError, ConnectionError) as e:
             # The replica's inbox is unreachable (dying or dead): give
@@ -477,6 +496,12 @@ class PrefillRunner:
             return
         self._last_beat = now
         self._prune_segments(now)
+        try:
+            # Before the feed drain: a blackholed beat loses nothing —
+            # the next beat carries the same done/failed entries.
+            _fault_fire("beat")
+        except FaultBlackhole:
+            return
         with self._feed_lock:
             done, self._done = self._done, []
             failed, self._failed = self._failed, []

@@ -29,13 +29,17 @@ prefill workers + a started :class:`~.router.Router` into a
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_lightning_tpu.fault.inject import (
+    FaultBlackhole, fire as _fault_fire, set_member,
+)
 from ray_lightning_tpu.serve.dist.handoff import (
-    make_beat_item, make_hello_item,
+    make_beat_item, make_hello_item, make_migration_item,
 )
 from ray_lightning_tpu.serve.dist.router import RestartGovernor, Router
 
@@ -65,6 +69,13 @@ class DecodeReplicaRunner:
         self.beat_s = beat_s
         self.suppress_final = False  # hard-kill simulation: no last beat
         self._last = 0.0
+        # Fleet identity for the fault grammar: the engine's serve
+        # thread declares itself on start (thread-local member context).
+        engine.fault_member = ("decode", replica_id)
+        # Torn/vanished handoff payloads become beat-reported retryable
+        # failures (the router re-routes the prefill) instead of
+        # terminal invalid replies — replica mode only.
+        engine.report_handoff_failures = True
 
     def hello(self) -> None:
         engine = self.engine
@@ -80,25 +91,35 @@ class DecodeReplicaRunner:
             max_adapters=engine.config.max_adapters,
         ))
 
-    def publish_beat(self, closing: bool = False) -> None:
+    def publish_beat(self, closing: bool = False,
+                     migrating: Optional[List[str]] = None) -> None:
         from ray_lightning_tpu.telemetry import compile_event_count
 
+        # Fire BEFORE draining the feeds: a blackholed beat must lose
+        # nothing — the next beat carries the same completions, exactly
+        # as a real dropped datagram would play out.
+        _fault_fire("beat")
         engine = self.engine
         self._beat_handle.put(make_beat_item(
             "decode", self.replica_id,
             done=engine.drain_done(),
+            failed=engine.drain_failed(),
             snapshot=engine.snapshot(),
             recompiles=compile_event_count(),
             adapters=(engine.adapter_names()
                       if engine.adapters is not None else None),
-            closing=closing,
+            closing=closing, migrating=migrating,
         ))
 
     def run(self, stop=None) -> None:
         """Beat until ``stop()`` goes true, then stop the engine (which
         sweeps stale ``rlt-kv`` segments) and publish the final feed —
         completions that landed between the last beat and the stop must
-        still reach the router."""
+        still reach the router.  On a PLANNED drain (stop requested, no
+        hard kill) the resident sequences are first live-migrated to
+        router-chosen survivors (``RLT_MIGRATE_ON_DRAIN=0`` disables;
+        abrupt death keeps the recompute failover path)."""
+        set_member("decode", self.replica_id)
         self.hello()
         self.engine.start()
         try:
@@ -106,12 +127,59 @@ class DecodeReplicaRunner:
                 time.sleep(min(self.beat_s, 0.05))
                 self._maybe_beat()
         finally:
+            if not self.suppress_final and \
+                    os.environ.get("RLT_MIGRATE_ON_DRAIN", "1") != "0":
+                try:
+                    self._migrate_out()
+                except (OSError, ConnectionError, FaultBlackhole):
+                    pass  # router gone/partitioned: recompute failover
             self.engine.stop()
             if not self.suppress_final:
                 try:
                     self.publish_beat(closing=True)
-                except (OSError, ConnectionError):
+                except (OSError, ConnectionError, FaultBlackhole):
                     pass  # router already gone
+
+    def _migrate_out(self) -> bool:
+        """Planned-drain live migration: quiesce the serve loop, claim
+        the resident rid set on the beat lane (the router suppresses
+        beat-loss failover for a claimed set), then ship each resident
+        sequence's KV + position as ``serve_migration`` frames.  The
+        frames ride the SAME ordered connection as the beats, so every
+        one is processed before the closing beat that follows."""
+        engine = self.engine
+        engine.halt_loop()
+        sched = engine.scheduler
+        rids = [
+            r.rid for slot, r in enumerate(sched.slots)
+            if r is not None and slot not in engine._chunk_jobs
+            and r.generated
+        ]
+        if not rids:
+            return False
+        # The claim beat goes FIRST — it refreshes last_beat AND
+        # registers the claim, so a multi-second export on a loaded box
+        # cannot race the router's death path into double-placement.
+        self.publish_beat(migrating=rids)
+        from ray_lightning_tpu.mpmd.transfer import encode_tree
+
+        sent = 0
+        for entry in engine.export_resident():
+            rid = str(entry["req"]["rid"])
+            try:
+                _fault_fire("handoff_send", rid=rid)
+            except FaultBlackhole:
+                continue  # injected partition: this frame is lost —
+                # the claim expires and recompute failover covers it
+            item = make_migration_item(
+                entry["req"], generated=entry["generated"],
+                cur_token=entry["cur_token"],
+                seq_len=entry["seq_len"],
+                data=encode_tree({"kv": entry["kv"]}),
+            )
+            self._beat_handle.put(item)
+            sent += 1
+        return sent > 0
 
     def _maybe_beat(self) -> None:
         now = time.monotonic()
@@ -120,8 +188,9 @@ class DecodeReplicaRunner:
         self._last = now
         try:
             self.publish_beat()
-        except (OSError, ConnectionError):
-            pass  # router restarting/gone; keep serving
+        except (OSError, ConnectionError, FaultBlackhole):
+            pass  # router restarting/gone (or injected partition);
+            # keep serving — the feeds drain on the next beat
 
 
 # ---------------------------------------------------------------------------
@@ -204,17 +273,23 @@ class InprocReplica:
     def kill(self, hard: bool = False) -> None:
         if self._dead:
             return
-        self._dead = True
         self._runner.suppress_final = hard
         if hard:
             # Abrupt death: halt the serve loop wherever it is and make
             # the inbox refuse (a dead process's port would).
+            self._dead = True
             self.engine._stop.set()
             if self.engine._inbox is not None:
                 self.engine._inbox.shutdown()
-        self._stop.set()
-        if not hard:
+            self._stop.set()
+        else:
+            # Planned drain: the handle must read ALIVE until the
+            # runner's teardown (live migration + closing beat) is
+            # done — marking it dead first would race the router's
+            # liveness sweep into a spurious failover mid-drain.
+            self._stop.set()
             self._thread.join(timeout=30)
+            self._dead = True
 
 
 class InprocPrefill:

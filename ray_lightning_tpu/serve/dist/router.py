@@ -80,7 +80,7 @@ from collections import OrderedDict, deque
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from ray_lightning_tpu.serve.dist.handoff import (
-    MemberOutbox, make_dispatch_item, request_fields,
+    MemberOutbox, make_cancel_item, make_dispatch_item, request_fields,
 )
 from ray_lightning_tpu.telemetry.propagate import (
     child_context, root_context, trace_args,
@@ -134,6 +134,16 @@ class _Member:
         # (the next beat confirms or corrects it).
         self.adapters: Set[str] = set()
         self.alive = True
+        # Live-migration claim: a draining replica's ``migrating`` beat
+        # names the rid set whose KV export is in flight.  Until the
+        # claim expires (or every claimed rid's migration frame lands),
+        # beat-loss failover is SUPPRESSED for this member — the
+        # device->host gather of a full KV cache can exceed
+        # ``lost_after_s``, and declaring the exporter dead mid-export
+        # would race a recompute failover against the incoming
+        # migration frames for the same rids.
+        self.migrating_until: float = 0.0
+        self.migrating_rids: Set[str] = set()
 
     def beat_age_s(self, now: float) -> float:
         return now - (self.last_beat
@@ -144,7 +154,7 @@ class _Track:
     """One routed request until a terminal status comes back."""
 
     __slots__ = ("req", "replica", "worker", "resubmits", "t0",
-                 "t_wall", "trace")
+                 "t_wall", "trace", "hedge_replica")
 
     def __init__(self, req: Dict[str, Any], t0: float):
         self.req = req
@@ -154,6 +164,12 @@ class _Track:
         self.t0 = t0
         self.t_wall = time.time()
         self.trace = None  # the request's root TraceContext (tracing on)
+        # Second placement of the SAME rid/seed on a different replica
+        # (client-triggered hedge against a tail-latency straggler).
+        # First terminal report wins; the other placement gets a
+        # serve_cancel.  Also the hot spare: if the primary dies, the
+        # hedge placement is promoted instead of a recompute failover.
+        self.hedge_replica: Optional[str] = None
 
 
 class Router:
@@ -173,6 +189,8 @@ class Router:
         export_every_s: float = 1.0,
         poll_interval_s: float = 0.02,
         headroom_routing: Optional[bool] = None,
+        migration_claim_s: float = 30.0,
+        brownout=None,
     ):
         from ray_lightning_tpu.cluster.queue import DriverQueue
 
@@ -201,6 +219,9 @@ class Router:
             "replica_drains": 0, "worker_drains": 0,
             "prefill_respawns": 0, "prefill_respawns_denied": 0,
             "adapter_loads_sent": 0, "prefix_affinity_hits": 0,
+            "migrations": 0, "migration_reroutes": 0,
+            "hedges": 0, "hedge_cancels": 0,
+            "shed": 0, "cancelled": 0,
         }
         # Prefix-affinity map: (adapter, leading-token) key -> the
         # replica that last served a prompt with that prefix, so
@@ -274,6 +295,25 @@ class Router:
             headroom_routing = \
                 os.environ.get("RLT_HEADROOM_ROUTING", "0") == "1"
         self._headroom_routing = bool(headroom_routing)
+        # How long a ``migrating`` beat claim suppresses beat-loss
+        # failover for the draining replica (the export of a full KV
+        # residency can take many seconds; an expired claim falls back
+        # to recompute failover for whatever never arrived).
+        self.migration_claim_s = migration_claim_s
+        # Overload brownout ladder (capacity plane -> admission):
+        # OFF unless passed explicitly or RLT_BROWNOUT=1.  When on,
+        # fleet utilization from beat capacity blocks drives staged
+        # degradation in submit_request — spec off, max_new capped,
+        # then priority-class shedding with a half-open recovery probe.
+        if brownout is None:
+            import os
+
+            if os.environ.get("RLT_BROWNOUT", "0") == "1":
+                from ray_lightning_tpu.serve.brownout import BrownoutLadder
+
+                brownout = BrownoutLadder()
+        self.brownout = brownout
+        self._brownout_last_level = 0
         # Fleet trend store, created lazily on the first beat carrying
         # a capacity block: per-replica tokens_out counters + headroom
         # gauges, the sensing input ROADMAP item 4's fleet scheduler
@@ -347,6 +387,7 @@ class Router:
         now = time.monotonic() if now is None else now
         with self._lock:
             self._drain_beats(now)
+            self._update_brownout(now)
             self._drain_requests(now)
             self._check_liveness(now)
             self._drain_retry(now)
@@ -424,6 +465,12 @@ class Router:
                     m.last_beat = now
             elif kind == "serve_replica_beat":
                 self._ingest_beat(item, now)
+            elif kind == "serve_migration":
+                # Live-KV migration frames ride the ordered beat lane
+                # (FIFO per connection: claim beat -> migration frames
+                # -> closing beat), so every migrated rid is retargeted
+                # BEFORE the closing beat re-places the leftovers.
+                self._on_migration(item, now)
 
     def _ingest_beat(self, item: Dict[str, Any],
                      now: float) -> None:  # rlt: holds self._lock
@@ -462,9 +509,16 @@ class Router:
             # member that dropped a load frame (restart, full pool)
             # stops being preferred for that tenant within one beat.
             m.adapters = {str(a) for a in item["adapters"]}
+        if "migrating" in item:
+            # A drain's export claim: suppress beat-loss failover for
+            # this member while the gather runs (see _is_lost) and
+            # remember which rids are promised — each arriving
+            # migration frame checks one off.
+            m.migrating_rids = {str(r) for r in item["migrating"]}
+            m.migrating_until = now + self.migration_claim_s
         for rid, status in item.get("done", []):
             if m.role == "decode":
-                self._complete(str(rid), str(status))
+                self._complete(str(rid), str(status), source=m.id)
             else:
                 track = self._inflight.get(str(rid))
                 if track is not None and track.worker == m.id:
@@ -472,22 +526,46 @@ class Router:
         for rid, err in item.get("failed", []):
             track = self._inflight.get(str(rid))
             # Ownership guard (mirrors the done-loop above): a stale
-            # failure report from a worker this rid was already routed
-            # AWAY from (its replica died first) must not yank the
-            # request off its healthy new placement.
-            if track is not None and track.worker == m.id:
+            # failure report from a member this rid was already routed
+            # AWAY from must not yank the request off its healthy new
+            # placement.  Prefill workers report undeliverable
+            # handoffs; decode replicas report handoffs they could not
+            # ADMIT (torn frame, injected read fault) — both re-route
+            # away from the replica that was supposed to decode.
+            if track is None:
+                continue
+            if track.worker == m.id or (m.role == "decode"
+                                        and track.replica == m.id):
                 self._on_handoff_failure(str(rid), str(err), now)
         if item.get("closing") and m.alive:
             self._on_member_closing(m, now)
 
-    def _complete(self, rid: str,
-                  status: str) -> None:  # rlt: holds self._lock
+    def _complete(self, rid: str, status: str,
+                  source: Optional[str] = None) -> None:  # rlt: holds self._lock
         track = self._inflight.pop(rid, None)
         if track is None:
             return
-        key = status if status in ("rejected", "expired", "invalid") \
+        key = status if status in ("rejected", "expired", "invalid",
+                                   "cancelled") \
             else "completed"
         self.counters[key] += 1
+        if track.hedge_replica is not None and status != "cancelled":
+            # First terminal report wins the hedged pair; the OTHER
+            # placement gets a serve_cancel so it stops burning slots
+            # (its own later "cancelled" done lands after the pop and
+            # is a no-op).  The client deduplicates both token streams
+            # by index — same rid, same fleet seed, identical tokens.
+            loser_id = track.replica if source == track.hedge_replica \
+                else track.hedge_replica
+            loser = self._replicas.get(loser_id) \
+                if loser_id is not None else None
+            if loser is not None and loser.alive \
+                    and loser.inbox is not None:
+                try:
+                    self._put(loser.inbox, make_cancel_item(rid))
+                    self.counters["hedge_cancels"] += 1
+                except (OSError, ConnectionError):
+                    pass  # loser is dying; its death path cleans up
         if track.trace is not None:
             # The root span anchors the whole trace: every downstream
             # span's parent chain terminates at <rid>.root.
@@ -507,6 +585,12 @@ class Router:
         telemetry surface.  The member's own teardown (engine stop +
         segment sweep) is the operator's — no reap here."""
         m.alive = False
+        # Rids the drain's live migration already retargeted have
+        # track.replica pointing at their survivor (migration frames
+        # ride the same ordered lane, AHEAD of this closing beat) — the
+        # selector below naturally skips them.  What's left is the
+        # un-migratable tail: queued or mid-chunked-prefill requests,
+        # and exports the fault plane blackholed.
         remaining = [rid for rid, t in self._inflight.items()
                      if (t.replica if m.role == "decode" else t.worker)
                      == m.id]
@@ -519,11 +603,23 @@ class Router:
             track.worker = None
             if m.role == "decode":
                 track.replica = None
+                if track.hedge_replica is not None \
+                        and track.hedge_replica != m.id:
+                    # The hedge placement is already decoding this rid
+                    # elsewhere — promote it, skip the recompute.
+                    track.replica, track.hedge_replica = \
+                        track.hedge_replica, None
+                    continue
+                track.hedge_replica = None
             track.resubmits += 1
             self._route(rid, track, now,
                         exclude={m.id} if m.role == "decode"
                         else frozenset(),
                         must_place=True)
+        if m.role == "decode":
+            for t in self._inflight.values():
+                if t.hedge_replica == m.id:
+                    t.hedge_replica = None  # primary still live
         self._sweep_segments()
 
     def _on_handoff_failure(self, rid: str, err: str,
@@ -540,6 +636,86 @@ class Router:
         track.replica = None
         track.resubmits += 1
         self._route(rid, track, now, exclude=exclude, must_place=True)
+
+    # -- live-KV migration ---------------------------------------------------
+    def _on_migration(self, item: Dict[str, Any],
+                      now: float) -> None:  # rlt: holds self._lock
+        """One ``serve_migration`` frame from a draining replica: pick
+        a survivor, forward the frame (KV blocks + scheduler position +
+        the original request fields ride inside), retarget the track.
+        The survivor resumes decode mid-sequence — zero recomputed
+        prefill, and the fleet-wide seed + position-keyed sampler keep
+        the continued stream bitwise-identical at any temperature.  No
+        viable survivor (or a failed adapter ensure) falls back to the
+        recompute-failover path the crash plane already exercises."""
+        rid = str(item.get("rid"))
+        track = self._inflight.get(rid)
+        source = track.replica if track is not None else None
+        # Check the rid off its source's claim set either way — a frame
+        # that landed is a promise kept, even if the track is gone.
+        for m in self._replicas.values():
+            m.migrating_rids.discard(rid)
+            if not m.migrating_rids and m.migrating_until:
+                # Every promised frame arrived: release the failover
+                # suppression early instead of waiting out the claim.
+                m.migrating_until = 0.0
+        if track is None:
+            log.debug("migration frame for unknown rid %s dropped", rid)
+            return
+        req = item.get("req") or {}
+        adapter = req.get("adapter")
+        survivors = [
+            m for m in self._replicas.values()
+            if m.alive and m.inbox is not None and m.id != source
+            and self._assigned(m.id) < (m.caps.get("num_slots", 1)
+                                        + m.caps.get("max_queue", 0))
+        ]
+        if adapter is not None:
+            survivors = [
+                m for m in survivors
+                if m.caps.get("max_adapters", 0) > 0
+                and (adapter in m.adapters or adapter in self._adapters)
+            ]
+        target = min(
+            survivors,
+            key=lambda m: (self._assigned(m.id),
+                           -self._blocks_free(m), m.id),
+        ) if survivors else None
+        if target is not None and adapter is not None:
+            try:
+                self._ensure_adapter(target, adapter)
+            except (OSError, ConnectionError):
+                self._on_replica_death(target, now)
+                target = None
+        if target is not None:
+            try:
+                self._put(target.inbox, item)
+            except (OSError, ConnectionError):
+                self._on_replica_death(target, now)
+                target = None
+        if target is None:
+            # Recompute fallback: re-route through the normal failover
+            # path (prefill replays from token 0 on a survivor; the
+            # client dedups the re-emitted indices).
+            self.counters["migration_reroutes"] += 1
+            track.worker = None
+            track.replica = None
+            track.resubmits += 1
+            self._route(rid, track, now,
+                        exclude={source} if source else frozenset(),
+                        must_place=True)
+            return
+        track.replica = target.id
+        track.worker = None
+        self.counters["migrations"] += 1
+        if track.trace is not None:
+            self.tracer.record(
+                "migration", time.time(), 0.0,
+                args=trace_args(
+                    child_context(track.trace), rid=rid,
+                    from_replica=source, to_replica=target.id,
+                ),
+            )
 
     # -- client submissions --------------------------------------------------
     def _drain_requests(self, now: float) -> None:  # rlt: holds self._lock
@@ -581,6 +757,16 @@ class Router:
                 raise ValueError("not a serve_request item")
             rid = str(item["rid"])
             reply = tuple(item["reply"])
+            existing = self._inflight.get(rid)
+            if existing is not None:
+                # Re-submission of a rid the fleet already tracks: a
+                # hedge marker places a DUPLICATE on another replica
+                # (same seed — the client dedups both streams by token
+                # index); anything else is a client retry racing its
+                # own in-flight request and is dropped silently.
+                if item.get("hedge"):
+                    self._hedge(rid, existing, now)
+                return rid
             seed = item.get("sample_seed")
             if seed is None:
                 # The fleet-wide sampling-stream identity: stamped HERE
@@ -601,6 +787,7 @@ class Router:
                 spec=item.get("spec"),
                 adapter=item.get("adapter"),
                 deadline_s=item.get("deadline_s"),
+                priority=int(item.get("priority") or 0),
                 trace=ctx,
             )
             problem = self._validate(req)
@@ -611,6 +798,30 @@ class Router:
                     "error": problem, "tokens": [],
                 })
                 return rid
+            if self.brownout is not None and self.brownout.level > 0:
+                # Staged overload degradation (ladder levels, each
+                # subsuming the previous): 1 = drop speculative draft
+                # lanes (spec FLOPs are the cheapest capacity to
+                # reclaim), 2 = cap response length, 3 = shed
+                # best-effort traffic (priority < 1) with a typed
+                # retryable reply — except the half-open probe the
+                # ladder lets through to sense recovery.
+                lvl = self.brownout.level
+                if req.get("spec"):
+                    req["spec"] = 0
+                if lvl >= 2:
+                    cap = int(self.brownout.max_new_cap)
+                    if req["max_new_tokens"] > cap:
+                        req["max_new_tokens"] = cap
+                if lvl >= 3 and int(req.get("priority") or 0) < 1 \
+                        and not self.brownout.allow_probe(now):
+                    self.counters["shed"] += 1
+                    self._reply(reply, {
+                        "type": "serve_done", "rid": rid,
+                        "status": "shed", "reason": "brownout",
+                        "tokens": [],
+                    })
+                    return rid
             track = _Track(req, now)
             track.trace = ctx
             self._inflight[rid] = track
@@ -651,10 +862,89 @@ class Router:
                     f"first")
         return None
 
+    def _hedge(self, rid: str, track: _Track,
+               now: float) -> None:  # rlt: holds self._lock
+        """Place a DUPLICATE of an in-flight request on a second
+        replica (client-triggered tail-latency hedge).  Same rid, same
+        fleet-wide seed: both replicas emit the identical stream, the
+        client dedups by token index, the first terminal report wins
+        and the loser is cancelled (see _complete).  Hedging is
+        best-effort — no spare capacity, an unplaced primary, or an
+        existing hedge all make this a silent no-op (the primary
+        placement is untouched either way)."""
+        if track.hedge_replica is not None or track.replica is None:
+            return
+        req = track.req
+        candidates = [
+            m for m in self._replicas.values()
+            if m.alive and m.inbox is not None and m.id != track.replica
+            and self._assigned(m.id) < (m.caps.get("num_slots", 1)
+                                        + m.caps.get("max_queue", 0))
+        ]
+        if req.get("spec"):
+            candidates = [m for m in candidates
+                          if m.caps.get("spec_k", 0) > 0]
+        adapter = req.get("adapter")
+        if adapter is not None:
+            candidates = [
+                m for m in candidates
+                if m.caps.get("max_adapters", 0) > 0
+                and (adapter in m.adapters or adapter in self._adapters)
+            ]
+        if not candidates:
+            return
+        target = min(
+            candidates,
+            key=lambda m: (self._assigned(m.id),
+                           -self._blocks_free(m), m.id),
+        )
+        try:
+            if adapter is not None:
+                self._ensure_adapter(target, adapter)
+            # Direct submission only: a hedge exists to beat a
+            # straggler, re-running disaggregated prefill for it would
+            # put the duplicate behind the same worker queue that may
+            # be the straggle's cause.
+            self._put(target.inbox, dict(req))
+        except (OSError, ConnectionError):
+            self._on_replica_death(target, now)
+            return
+        track.hedge_replica = target.id
+        self.counters["hedges"] += 1
+
+    def _update_brownout(self, now: float) -> None:  # rlt: holds self._lock
+        """Feed the brownout ladder the fleet's beat-aggregated
+        utilization (no capacity blocks -> no signal -> ladder stays
+        where it is; it only moves on evidence)."""
+        if self.brownout is None:
+            return
+        blocks = [
+            m.snapshot.get("capacity") for m in self._replicas.values()
+            if m.alive and isinstance(m.snapshot, dict)
+            and isinstance(m.snapshot.get("capacity"), dict)
+        ]
+        if not blocks:
+            return
+        from ray_lightning_tpu.serve.capacity import aggregate_fleet
+
+        fleet = aggregate_fleet(blocks)
+        util = fleet.get("utilization") if fleet else None
+        if not isinstance(util, (int, float)):
+            return
+        level = self.brownout.observe(float(util), now)
+        if level != self._brownout_last_level:
+            log.warning(
+                "serve brownout level %d -> %d (fleet utilization "
+                "%.2f)", self._brownout_last_level, level, util,
+            )
+            self._brownout_last_level = level
+
     # -- placement -----------------------------------------------------------
     def _assigned(self, replica_id: str) -> int:  # rlt: holds self._lock
+        # Hedge placements occupy a slot on their replica exactly like
+        # primaries — capacity accounting must see both.
         return sum(1 for t in self._inflight.values()
-                   if t.replica == replica_id)
+                   if replica_id in (t.replica, t.hedge_replica))
 
     def _pending(self, worker_id: str) -> int:  # rlt: holds self._lock
         return sum(1 for t in self._inflight.values()
@@ -1019,6 +1309,17 @@ class Router:
                 return True
         except Exception:  # noqa: BLE001 - a broken handle IS dead
             return True
+        if now < m.migrating_until:
+            # A drain's migration-export claim is in flight: the
+            # device->host KV gather can silence beats for longer than
+            # lost_after_s, and declaring the exporter dead here would
+            # race a recompute failover against migration frames
+            # already on the wire for the SAME rids — double-placing
+            # every resident request.  The claim is bounded
+            # (migration_claim_s): a replica that dies mid-export just
+            # fails over a little later, and loses nothing the crash
+            # path wouldn't have lost anyway.
+            return False
         grace = self.lost_after_s if m.last_beat is not None \
             else self.hello_grace_s
         return m.beat_age_s(now) > grace
@@ -1048,8 +1349,17 @@ class Router:
             self.counters["failed_over_requests"] += len(victims)
         for rid in victims:
             track = self._inflight[rid]
+            if track.hedge_replica is not None \
+                    and track.hedge_replica != m.id:
+                # Hot-spare promotion: the hedge placement is already
+                # decoding this rid with the same seed — no recompute
+                # failover needed, just retarget the track.
+                track.replica, track.hedge_replica = \
+                    track.hedge_replica, None
+                continue
             track.replica = None
             track.worker = None
+            track.hedge_replica = None
             track.resubmits += 1
             if track.trace is not None:
                 # The failover hop is a first-class span LINKED under
@@ -1064,6 +1374,9 @@ class Router:
                     ),
                 )
             self._route(rid, track, now, exclude={m.id}, must_place=True)
+        for t in self._inflight.values():
+            if t.hedge_replica == m.id:
+                t.hedge_replica = None  # primary placement still live
         self._reap(m)
 
     def _on_worker_death(self, w: _Member,
@@ -1283,6 +1596,8 @@ class Router:
                 "replicas": replicas,
                 "workers": workers,
             }
+            if self.brownout is not None:
+                out["brownout_level"] = int(self.brownout.level)
             if cap_blocks:
                 from ray_lightning_tpu.serve.capacity import (
                     aggregate_fleet,

@@ -44,6 +44,9 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ray_lightning_tpu.fault.inject import (
+    FaultBlackhole, FaultInjected, fire as _fault_fire, set_member,
+)
 from ray_lightning_tpu.telemetry.propagate import (
     child_context, trace_args,
 )
@@ -400,6 +403,22 @@ class ServeEngine:
         # the router can prune its in-flight tracking.  Bounded: an
         # undreained feed (no router) must never grow without bound.
         self._done_feed: deque = deque(maxlen=4096)  # guarded by self._lock
+        # Non-terminal (rid, error) handoff-admission failures — fed to
+        # the router by replica beats (``failed`` key) so it re-routes
+        # the PREFILL instead of failing the request terminally.  Only
+        # populated when a replica runner opts in below.
+        self._failed_feed: deque = deque(maxlen=4096)  # guarded by self._lock
+        # Disaggregated-replica mode: a torn/vanished handoff payload
+        # becomes a beat-reported retryable failure (router re-routes
+        # the prefill) instead of a terminal ``invalid`` reply.  The
+        # replica runner flips this on; a standalone queue-plane engine
+        # keeps the terminal-reply behavior.
+        self.report_handoff_failures = False
+        # Serve-fleet identity for the fault grammar: the runner sets
+        # ("decode", replica_id) so the serve THREAD (started later,
+        # from start()) can declare itself to the thread-local member
+        # context in fault/inject.py.
+        self.fault_member: Optional[Tuple[str, str]] = None
         self._error: Optional[BaseException] = None
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -816,6 +835,7 @@ class ServeEngine:
         when any work was done (False = idle)."""
         import jax.numpy as jnp
 
+        _fault_fire("replica_tick")
         self._drain_inbox()
         with self._lock:
             if self.prefix_cache is not None and self._prefix_drops:
@@ -1522,6 +1542,42 @@ class ServeEngine:
             self._done_feed.clear()
         return items
 
+    def drain_failed(self) -> List[Tuple[str, str]]:
+        """Non-terminal ``(rid, error)`` handoff-admission failures
+        since the last call — the beat's ``failed`` feed when
+        ``report_handoff_failures`` is on.  The router treats each like
+        a prefill-worker failure: re-dispatch the prefill, never a
+        terminal client reply."""
+        with self._lock:
+            items = list(self._failed_feed)
+            self._failed_feed.clear()
+        return items
+
+    def cancel(self, rid: str) -> bool:
+        """Drop one request wherever it is — queued or mid-decode (the
+        hedged-request first-winner cancel, and the client-abort path).
+        Idempotent: unknown or already-finished rids return False.  The
+        terminal status is ``cancelled`` (done feed + typed reply), so
+        routers and clients prune it like any completion."""
+        with self._lock:
+            req = self.scheduler.cancel(rid)
+            if req is None:
+                return False
+            handle = self._handles.pop(rid, None)
+            self._done_feed.append((rid, "cancelled"))
+        self.stats.bump("cancelled")
+        req.finished_t = time.monotonic()
+        if handle is not None:
+            handle._done.set()
+        reply = getattr(req, "_reply", None)
+        if reply is not None:
+            self._reply(reply, {
+                "type": "serve_done", "rid": rid,
+                "status": "cancelled", "reason": "cancelled",
+                "tokens": [int(t) for t in req.generated],
+            })
+        return True
+
     # -- background thread ---------------------------------------------------
     def start(self) -> "ServeEngine":
         if self._thread is not None:
@@ -1534,6 +1590,11 @@ class ServeEngine:
         return self
 
     def _serve_forever(self) -> None:
+        if self.fault_member is not None:
+            # The serve thread declares its fleet identity so
+            # replica:-pinned faults fire here, not on whichever member
+            # thread registered last (inproc fleets share one process).
+            set_member(*self.fault_member)
         while not self._stop.is_set():
             try:
                 worked = self.step()
@@ -1570,6 +1631,64 @@ class ServeEngine:
                     "tokens": [int(t) for t in req.generated],
                 })
             handle._done.set()
+
+    def halt_loop(self) -> None:
+        """Quiesce the background serve thread WITHOUT tearing the
+        engine down (``stop()`` also closes reply handles, the inbox
+        and exporters): the planned-drain migration path halts the
+        loop, exports the resident sequences from the frozen scheduler
+        (:meth:`export_resident`), then calls :meth:`stop`."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def export_resident(self) -> List[dict]:
+        """Export every resident decoding sequence's KV blocks plus
+        scheduler position — the planned-drain live-migration payload
+        (docs/FAULT_TOLERANCE.md "Serving-plane faults").  Call with
+        the loop quiesced (:meth:`halt_loop`); each entry feeds
+        ``make_migration_item`` and a survivor's migration admission.
+        Queued requests and chunked prefills mid-flight are NOT
+        exported: they have no emitted position worth moving, so the
+        router's ordinary recompute failover covers them."""
+        out = []
+        sched = self.scheduler
+        Bs = self.config.block_size
+        for slot, req in enumerate(sched.slots):
+            if req is None or slot in self._chunk_jobs:
+                continue
+            if not req.generated:
+                continue
+            # seq_lens[slot] == prompt + generated − 1: the final
+            # sampled token's KV was never written (it is the NEXT
+            # decode tick's input), so exactly ceil(seq_len/Bs) blocks
+            # hold everything the survivor needs.
+            seq_len = int(sched.seq_lens[slot])
+            n_blocks = -(-seq_len // Bs)
+            ids = sched._blocks[slot][:n_blocks]
+            kv = self.cache.export_blocks(self._pool, ids)
+            fields = {
+                "rid": req.rid, "prompt": list(req.prompt),
+                "max_new_tokens": int(req.max_new_tokens),
+                "temperature": float(req.temperature),
+                "eos_token_id": req.eos_token_id,
+                "top_k": req.top_k,
+                "adapter": req.adapter,
+                "priority": int(req.priority),
+                "sample_seed": req.sample_seed,
+            }
+            reply = getattr(req, "_reply", None)
+            if reply is not None:
+                fields["reply"] = list(reply)
+            out.append({
+                "req": fields,
+                "generated": list(req.generated),
+                "cur_token": int(self._cur_tokens[slot]),
+                "seq_len": seq_len,
+                "kv": kv,
+            })
+        return out
 
     def stop(self) -> None:
         self._stop.set()
@@ -1669,7 +1788,12 @@ class ServeEngine:
             # recompile-free like every other admission.
             self._load_adapter_item(item)
             return
-        if kind == "serve_kv_handoff":
+        if kind == "serve_cancel":
+            # Hedge loser (or client abort): drop the request wherever
+            # it is — queued, decoding, or already gone (idempotent).
+            self.cancel(str(item["rid"]))
+            return
+        if kind in ("serve_kv_handoff", "serve_migration"):
             fields = dict(item["req"])
             adapter = fields.get("adapter")
             if (adapter is not None and self.adapters is not None
@@ -1697,6 +1821,17 @@ class ServeEngine:
             raise ValueError(f"not a serve request/handoff: {kind!r}")
         rid = str(item["rid"])
         reply = tuple(fields["reply"])  # (host, port)
+        if kind == "serve_migration":
+            self._admit_migration(item, fields, rid, reply)
+            return
+        if item.get("hedge"):
+            # Hedged duplicate that reached a single engine directly
+            # (no router to place it on a SECOND replica): drop it —
+            # the primary admission is already decoding this rid, and
+            # a duplicate here would double-book the slot.
+            with self._lock:
+                if rid in self._handles:
+                    return
 
         def on_token(i: int, tok: int) -> None:
             self._reply(reply, {
@@ -1705,6 +1840,9 @@ class ServeEngine:
             })
 
         try:
+            if kind == "serve_kv_handoff":
+                _fault_fire("handoff_read", rid=rid,
+                            path=item.get("shm"))
             handoff = (self._decode_handoff(item)
                        if kind == "serve_kv_handoff" else None)
             trace_ctx = None
@@ -1747,7 +1885,14 @@ class ServeEngine:
                 on_token=on_token, rid=rid, _handoff=handoff,
                 _trace_ctx=trace_ctx,
             )
-        except (ValueError, TypeError, KeyError, OSError) as e:
+        except FaultBlackhole:
+            # Injected network partition on the read side: the frame
+            # just never arrived.  No reply, no feed entry — recovery
+            # is the router's beat-loss/claim machinery, exactly as for
+            # a real partition.
+            return
+        except (ValueError, TypeError, KeyError, OSError,
+                FaultInjected) as e:
             # TypeError covers malformed field coercion (int(None), ...);
             # KeyError/OSError cover a torn handoff payload or a segment
             # that vanished before the read (TTL-pruned after a very
@@ -1758,6 +1903,15 @@ class ServeEngine:
             # a phantom in-flight request against this replica forever.
             # The done feed carries the terminal status so a router
             # prunes it like any other.
+            if kind == "serve_kv_handoff" and self.report_handoff_failures:
+                # Disaggregated replica: a torn/vanished payload is the
+                # PREFILL's failure, not the request's — report it on
+                # the beat's failed feed so the router re-dispatches
+                # the prefill (same recovery as a worker death) instead
+                # of failing the client terminally.
+                with self._lock:
+                    self._failed_feed.append((rid, repr(e)))
+                return
             with self._lock:
                 self._done_feed.append((rid, "invalid"))
             self._reply(reply, {
@@ -1784,6 +1938,7 @@ class ServeEngine:
                 "pool (ServeConfig.max_adapters == 0) — router caps "
                 "should have excluded this replica"
             )
+        _fault_fire("adapter_load", rid=str(item.get("name", "")))
         self.add_adapter(str(item["name"]), decode_adapter(item))
 
     def _decode_handoff(self, item: dict) -> dict:
@@ -1810,6 +1965,181 @@ class ServeEngine:
                 f"replica must share block_size/bucket config"
             )
         return tree
+
+    def _admit_migration(self, item: dict, fields: dict, rid: str,
+                         reply: Tuple[str, int]) -> None:
+        """One ``serve_migration`` frame: adopt a draining replica's
+        resident sequence mid-decode — import its KV blocks, seat the
+        request with its emitted history, and continue decode at the
+        exact position the source stopped.  Zero recomputed prefill;
+        the position-keyed sampler keeps the continued stream
+        bitwise-identical at any temperature.  Any adoption failure
+        (pool dry, geometry drift, torn payload) falls back to the
+        recompute path: a fresh submit with the same fleet seed replays
+        the identical stream and the client dedups re-emitted
+        indices."""
+
+        def on_token(i: int, tok: int) -> None:
+            self._reply(reply, {
+                "type": "serve_token", "rid": rid, "index": i,
+                "token": int(tok),
+            })
+
+        try:
+            adopted = self._adopt_migration(item, fields, rid, reply,
+                                            on_token)
+        except (ValueError, TypeError, KeyError, OSError,
+                FaultInjected) as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "serve: migration adopt failed for %s (%s) — "
+                "recompute fallback", rid, e,
+            )
+            adopted = False
+        if adopted:
+            self.stats.bump("migrations_in")
+            return
+        self.stats.bump("migration_fallbacks")
+        try:
+            handle = self.submit(
+                fields["prompt"], int(fields["max_new_tokens"]),
+                temperature=float(fields.get("temperature", 0.0)),
+                eos_token_id=fields.get("eos_token_id"),
+                top_k=fields.get("top_k"),
+                adapter=fields.get("adapter"),
+                sample_seed=fields.get("sample_seed"),
+                on_token=on_token, rid=rid,
+            )
+        except (ValueError, TypeError, KeyError, OSError) as e:
+            with self._lock:
+                self._done_feed.append((rid, "invalid"))
+            self._reply(reply, {
+                "type": "serve_done", "rid": rid, "status": "invalid",
+                "error": str(e), "tokens": [],
+            })
+            return
+        handle.request._reply = reply
+        if handle.status == "rejected":
+            self._reply_done(handle.request)
+
+    def _adopt_migration(self, item: dict, fields: dict, rid: str,
+                         reply: Tuple[str, int], on_token) -> bool:
+        """Seat one migrated sequence.  True = adopted (decode resumes
+        at ``seq_len`` next tick); False = resources unavailable (no
+        free slot / pool dry / no matching import width) — the caller
+        falls back to recompute.  Malformed payloads raise and fall
+        back the same way."""
+        import jax.numpy as jnp
+
+        from ray_lightning_tpu.serve.dist.handoff import decode_kv_payload
+        from ray_lightning_tpu.serve.scheduler import Request
+
+        sched = self.scheduler
+        Bs = self.config.block_size
+        prompt = [int(t) for t in fields["prompt"]]
+        generated = [int(t) for t in item["generated"]]
+        max_new = int(fields["max_new_tokens"])
+        seq_len = int(item["seq_len"])
+        cur_token = int(item["cur_token"])
+        if not generated or len(generated) >= max_new:
+            raise ValueError(
+                "migration carries no live decode position"
+            )
+        if seq_len != len(prompt) + len(generated) - 1:
+            raise ValueError(
+                f"migration position mismatch: seq_len {seq_len} != "
+                f"prompt {len(prompt)} + generated {len(generated)} - 1"
+            )
+        if len(prompt) + max_new > self.max_model_len:
+            raise ValueError(
+                f"migrated request exceeds max_model_len "
+                f"({self.max_model_len})"
+            )
+        sample_seed = fields.get("sample_seed")
+        if sample_seed is None:
+            raise ValueError(
+                "migration without a sample_seed — the continued "
+                "stream would not replay the source's"
+            )
+        n_blocks = -(-seq_len // Bs)
+        kv = decode_kv_payload(item)["kv"]
+        if int(kv["k"].shape[1]) != n_blocks:
+            raise ValueError(
+                f"migration payload carries {int(kv['k'].shape[1])} "
+                f"blocks, position {seq_len} needs {n_blocks} — "
+                f"source and survivor must share block_size"
+            )
+        ids = sched._alloc(n_blocks)
+        if ids is None:
+            return False
+        ok = False
+        try:
+            # Scatter through the SAME per-block-count executables the
+            # bucketed handoff imports compiled (greedy decomposition
+            # into bucket block counts) — a migration admission never
+            # adds a program variant, so steady-state recompiles stay
+            # pinned at zero on the survivor.
+            sizes = sorted({b // Bs for b in sched.buckets},
+                           reverse=True)
+            off = 0
+            while off < n_blocks:
+                c = next((s for s in sizes if s <= n_blocks - off),
+                         None)
+                if c is None:
+                    return False  # bucket set can't tile the remainder
+                chunk = jnp.asarray(
+                    np.asarray(ids[off: off + c], np.int32)
+                )
+                payload = {k: jnp.asarray(v[:, off: off + c])
+                           for k, v in kv.items()}
+                self._pool = self._import_fn(self._pool, payload, chunk)
+                off += c
+            req = Request(
+                rid=rid, prompt=prompt, max_new_tokens=max_new,
+                temperature=float(fields.get("temperature", 0.0)),
+                eos_token_id=fields.get("eos_token_id"),
+                top_k=fields.get("top_k"),
+                # The draft cache never saw this prefix: plain decode
+                # only.  _spec_tick at width 0 emits exactly the plain
+                # position-keyed token, so mixed ticks stay bitwise.
+                spec=0,
+                adapter=fields.get("adapter"),
+                priority=int(fields.get("priority", 0)),
+                sample_seed=int(sample_seed),
+                on_token=on_token,
+            )
+            req.generated = generated
+            handle = ServeHandle(rid, req)
+            with self._lock:
+                if req.adapter is not None:
+                    if self.adapters is None:
+                        raise ValueError(
+                            f"migrated request names adapter "
+                            f"{req.adapter!r} but this engine has no "
+                            f"adapter pool"
+                        )
+                    try:
+                        req._adapter_slot = self.adapters.slot_of(
+                            req.adapter
+                        )
+                    except KeyError:
+                        raise ValueError(
+                            f"unknown adapter {req.adapter!r} on the "
+                            f"migration survivor"
+                        ) from None
+                slot = sched.adopt(req, ids, seq_len)
+                if slot is None:
+                    return False
+                self.stats.bump("submitted")
+                self._handles[rid] = handle
+            self._cur_tokens[slot] = cur_token
+            req._reply = reply
+            ok = True
+            return True
+        finally:
+            if not ok:
+                sched.allocator.free(ids)
 
     def _reply_done(self, req) -> None:
         reply = getattr(req, "_reply", None)

@@ -76,6 +76,9 @@ class Request:
     # Seconds from arrival the FIRST token must land by (TTFT SLO at
     # admission; None = no deadline).
     deadline_s: Optional[float] = None
+    # Brownout shed class: 0 (default) sheds first when the router's
+    # overload ladder reaches its shed rung; >= 1 keeps its seat.
+    priority: int = 0
     # Called with (token_index, token_id) as tokens stream out; after a
     # preemption the engine re-emits from index 0 — consumers dedup on
     # the index (greedy regenerates identical tokens).
@@ -611,6 +614,61 @@ class Scheduler:
         req.first_token_t = None
         self.queue.appendleft(req)
         return req
+
+    def adopt(self, req: Request, ids: List[int], seq_len: int,
+              now: Optional[float] = None) -> Optional[int]:
+        """Place an ALREADY-RUNNING request (a live-KV migration
+        import) directly into a free slot: ``ids`` are blocks the
+        caller allocated from THIS scheduler's pool and scattered the
+        imported KV into; ``seq_len`` is the KV frontier those blocks
+        cover.  Mirrors :meth:`poll`'s slot population exactly — minus
+        the queue/claim bookkeeping the request already paid on its
+        draining home replica.  Returns the slot, or None when no slot
+        is free (the caller falls back to recompute resubmission)."""
+        now = time.monotonic() if now is None else now
+        slot = next(
+            (i for i, r in enumerate(self.slots) if r is None), None
+        )
+        if slot is None:
+            return None
+        req.state = RequestState.RUNNING
+        req.slot = slot
+        if req.admitted_t is None:
+            req.admitted_t = now
+        if req.first_token_t is None and req.generated:
+            req.first_token_t = now
+        req._seq_no = self._admit_counter
+        self._admit_counter += 1
+        self.slots[slot] = req
+        self._blocks[slot] = list(ids)
+        row = self.block_tables[slot]
+        row[:] = TRASH_BLOCK
+        row[: len(ids)] = ids
+        self.seq_lens[slot] = seq_len
+        self.temperatures[slot] = req.temperature
+        self.top_ks[slot] = req.top_k or 0
+        self.sample_seeds[slot] = req.sample_seed
+        self.draft_lens[slot] = seq_len
+        self.adapter_slots[slot] = req._adapter_slot
+        return slot
+
+    def cancel(self, rid: str) -> Optional[Request]:
+        """Drop ``rid`` wherever it is — queued (removed) or active
+        (slot released, blocks freed).  Returns the request (terminal
+        status is the CALLER's call — the hedge cancel path reports
+        ``cancelled``, never a client-visible state), or None when the
+        rid is unknown here."""
+        for i, r in enumerate(self.queue):
+            if r.rid == rid:
+                del self.queue[i]
+                r.slot = None
+                return r
+        for slot, r in enumerate(self.slots):
+            if r is not None and r.rid == rid:
+                self._release(slot)
+                r.slot = None
+                return r
+        return None
 
     def finish(self, slot: int, now: Optional[float] = None) -> Request:
         now = time.monotonic() if now is None else now

@@ -40,12 +40,14 @@ __all__ = [
     "validate_serve_snapshot",
     "validate_serve_kv_handoff",
     "validate_serve_adapter_load",
+    "validate_serve_migration",
     "validate_router_snapshot",
     "validate_bench_serve",
     "validate_bench_spec_decode",
     "validate_bench_prefix_cache",
     "validate_bench_chunked_prefill",
     "validate_bench_serve_disagg",
+    "validate_bench_serve_chaos",
     "validate_bench_multi_lora",
     "validate_mpmd_stage_item",
     "validate_mpmd_xfer",
@@ -546,6 +548,13 @@ _SERVE_REQUEST_OPTIONAL = {
     # Disaggregated serving: the router's fleet-wide sampling-stream
     # identity (absent/None = the engine assigns its own ordinal).
     "sample_seed": (int, type(None)),
+    # Brownout shed class: 0 (default) sheds first under fleet
+    # overload, >= 1 survives to the shed rung (router admission).
+    "priority": int,
+    # Client hedged resubmit: a duplicate submission of an ALREADY
+    # in-flight rid — the router places it on a second replica, first
+    # terminal wins, the loser is cancelled.
+    "hedge": bool,
     # Distributed tracing: the request's trace-context envelope
     # (validate_trace_context; absent on untraced producers).
     "trace": dict,
@@ -561,11 +570,15 @@ _SERVE_TOKEN_REQUIRED = {
 _SERVE_DONE_REQUIRED = {
     "type": str,              # "serve_done"
     "rid": str,
-    "status": str,            # finished/rejected/expired/invalid/error
+    # finished/rejected/expired/invalid/error, plus the resilience
+    # outcomes: "shed" (brownout overload reply, retryable) and
+    # "cancelled" (hedge loser / operator drop, retryable).
+    "status": str,
     "tokens": list,
 }
 _SERVE_DONE_OPTIONAL = {
-    "reason": (str, type(None)),   # eos/length/rejected/expired
+    # eos/length/rejected/expired/brownout/cancelled
+    "reason": (str, type(None)),
     "error": str,                  # invalid submissions only
 }
 
@@ -936,6 +949,67 @@ def validate_serve_kv_handoff(item: Any,
     return problems
 
 
+# The draining replica → router → survivor live-migration envelope
+# (serve/dist/handoff.py::make_migration_item): one resident
+# sequence's KV blocks + scheduler position + the canonical request
+# fields, so the survivor resumes decode mid-sequence with zero
+# recomputed prefill.  Unlike KV handoffs the payload is ALWAYS inline
+# bytes ("data") — migration frames ride the ordered beat lane, and a
+# tmpfs segment would dangle if the draining host died mid-drain.
+_SERVE_MIGRATION_REQUIRED = {
+    "type": str,          # always "serve_migration"
+    "rid": str,
+    "req": dict,          # request_fields dict (reply + sample_seed)
+    "generated": list,    # tokens already emitted to the client
+    "cur_token": int,     # last sampled token (next tick's input)
+    "seq_len": int,       # KV positions written (prompt+gen-1)
+    "data": bytes,        # encode_tree({"kv": ...})
+}
+_SERVE_MIGRATION_OPTIONAL = {
+    "trace": dict,
+}
+
+
+def validate_serve_migration(item: Any,
+                             where: str = "serve_migration"
+                             ) -> List[str]:
+    problems = _validate_typed(
+        item, "serve_migration", _SERVE_MIGRATION_REQUIRED,
+        _SERVE_MIGRATION_OPTIONAL, where,
+    )
+    if problems:
+        return problems
+    if not item["generated"]:
+        problems.append(
+            f"{where}: empty generated — a sequence with no emitted "
+            f"tokens has nothing worth migrating (recompute failover "
+            f"covers it)"
+        )
+    if item["seq_len"] < 1:
+        problems.append(f"{where}: seq_len < 1")
+    problems += validate_serve_request(item["req"], f"{where}.req")
+    req = item["req"] if isinstance(item["req"], dict) else {}
+    seed = req.get("sample_seed")
+    if not isinstance(seed, int) or isinstance(seed, bool):
+        # Without the fleet seed the survivor cannot continue the
+        # stream bitwise at temperature > 0.
+        problems.append(f"{where}.req: missing/invalid sample_seed")
+    prompt = req.get("prompt")
+    if isinstance(prompt, list) and item["generated"] \
+            and item["seq_len"] >= 1 \
+            and item["seq_len"] != len(prompt) \
+            + len(item["generated"]) - 1:
+        # The invariant the importer's block math depends on: the
+        # final sampled token's KV is never written until its own
+        # decode tick.
+        problems.append(
+            f"{where}: seq_len {item['seq_len']} != prompt + "
+            f"generated - 1 ({len(prompt) + len(item['generated']) - 1})"
+        )
+    problems += _check_optional_trace(item, where)
+    return problems
+
+
 # The router/operator → member adapter hot-load envelope (multi-tenant
 # LoRA; serve/dist/handoff.py::make_adapter_load_item).  Like KV
 # handoffs, the bulk factor payload (encode_adapter bytes) rides
@@ -997,9 +1071,12 @@ _ROUTER_REPLICA_OPTIONAL = {
     "kv_exhaustion_eta_s": (int, float, type(None)),
 }
 # The fleet-wide capacity roll-up (serve/capacity.py::aggregate_fleet)
-# the router attaches when any member reports a capacity block.
+# the router attaches when any member reports a capacity block, and
+# the brownout ladder's current rung (brownout-enabled routers only;
+# 0 = healthy, 1 = spec off, 2 = max_new capped, 3 = shedding).
 _ROUTER_SNAPSHOT_OPTIONAL = {
     "capacity": dict,
+    "brownout_level": int,
 }
 _FLEET_CAPACITY_REQUIRED = {
     "replicas_reporting": int,
@@ -1077,6 +1154,11 @@ def validate_router_snapshot(doc: Any,
                     f"{where}.capacity: replicas_reporting < 1"
                 )
         problems += cap_problems
+    lvl = doc.get("brownout_level")
+    if lvl is not None and not 0 <= lvl <= 3:
+        problems.append(
+            f"{where}: brownout_level {lvl} outside [0, 3]"
+        )
     for key, value in doc["counters"].items():
         if not isinstance(value, int) or isinstance(value, bool) \
                 or value < 0:
@@ -1434,6 +1516,64 @@ def validate_bench_serve_disagg(block: Any,
                     f"{where}.chaos: completed + lost > submitted"
                 )
         problems += chaos_problems
+    return problems
+
+
+# The bench_serve.py serving-chaos block (ISSUE 19): the
+# migration-vs-failover A/B.  Both arms drain/kill a replica
+# mid-stream; the migration arm must lose zero requests, re-emit zero
+# tokens (the KV moved, nothing was recomputed), and keep token parity
+# with the uninterrupted engine — the failover arm is the recompute
+# baseline it beats on time-to-recover.  Both arms pin steady-state
+# recompiles.
+_BENCH_SERVE_CHAOS_REQUIRED = {
+    "migrations": int,                      # migration frames landed
+    "migration_ttr_s": (int, float),        # drain -> stream resumed
+    "failover_ttr_s": (int, float),         # kill -> stream resumed
+    "migration_vs_failover": (int, float),  # failover_ttr / migration_ttr
+    "lost_requests": int,
+    "migration_re_emitted_tokens": int,     # MUST be 0 (no recompute)
+    "recompiles_steady_state": int,
+}
+_BENCH_SERVE_CHAOS_OPTIONAL = {
+    # bool keys ride the optional dict (the required-path bool guard
+    # exists to catch True-as-int); presence is enforced below.
+    "parity": bool,                         # tokens == uninterrupted run
+    "failover_re_emitted_tokens": int,
+    "requests": int,
+    "shed": int,                 # brownout arm: typed shed replies
+    "brownout_level_max": int,
+    "hedges": int,
+    "hedge_cancels": int,
+}
+
+
+def validate_bench_serve_chaos(block: Any,
+                               where: str = "serve_chaos") -> List[str]:
+    """Validate the ``serve_chaos`` block of a bench artifact (absent
+    on pre-chaos rounds)."""
+    problems = _check_fields(
+        block, _BENCH_SERVE_CHAOS_REQUIRED, _BENCH_SERVE_CHAOS_OPTIONAL,
+        where,
+    )
+    if problems:
+        return problems
+    if "parity" not in block:
+        problems.append(f"{where}: missing required key 'parity'")
+    for key in ("migrations", "lost_requests",
+                "migration_re_emitted_tokens",
+                "recompiles_steady_state"):
+        if block[key] < 0:
+            problems.append(f"{where}: negative {key}")
+    for key in ("migration_ttr_s", "failover_ttr_s",
+                "migration_vs_failover"):
+        if block[key] < 0:
+            problems.append(f"{where}: negative {key}")
+    lvl = block.get("brownout_level_max")
+    if lvl is not None and not 0 <= lvl <= 3:
+        problems.append(
+            f"{where}: brownout_level_max {lvl} outside [0, 3]"
+        )
     return problems
 
 

@@ -511,6 +511,107 @@ class TestRouterPolicy:
             rig.close()
 
 
+class TestServeResilience:
+    """ISSUE 19 units (jax-free): parked-retry FIFO under sustained
+    saturation, the migration claim vs beat-loss detection, and the
+    brownout ladder's hysteresis.  The fleet-level chaos lives in the
+    slow-marked TestInprocFleet drills + tools/chaos_serve_sweep.py."""
+
+    def test_retry_queue_drains_in_submission_order(self):
+        """A failover burst onto a saturated survivor parks every
+        displaced request; as capacity frees one slot at a time they
+        place in original submission order — sustained saturation must
+        not reorder (starve) the oldest accepted work."""
+        rig = _RouterRig(n_replicas=2,
+                         caps={"num_slots": 2, "max_queue": 0,
+                               "spec_k": 0, "max_prompt_len": 16,
+                               "max_model_len": 64, "block_size": 8})
+        try:
+            for i in range(4):
+                rig.submit(f"q{i}")   # 2 per replica, both full
+            victim = rig.router._inflight["q0"].replica
+            survivor = "r1" if victim == "r0" else "r0"
+            displaced = [r for r, t in rig.router._inflight.items()
+                         if t.replica == victim]
+            resident = [r for r, t in rig.router._inflight.items()
+                        if t.replica == survivor]
+            _drain(rig.replicas[survivor][1])
+            rig.replicas[victim][0]._alive = False
+            rig.router.poll()
+            # Survivor full: both displaced requests parked, in order.
+            assert list(rig.router._retry) == displaced
+            assert not _drain(rig.reply_q, timeout=0.2)  # none rejected
+            placed = []
+            for done_rid in resident:  # free ONE slot at a time
+                rig.beat_done(survivor, [(done_rid, "finished")])
+                placed += [i["rid"]
+                           for i in _drain(rig.replicas[survivor][1])]
+            assert placed == displaced  # FIFO, never newest-first
+        finally:
+            rig.close()
+
+    def test_migration_claim_suppresses_beat_loss(self):
+        """ISSUE 19 bugfix regression: a ``migrating`` beat claims the
+        replica for ``migration_claim_s`` — the device->host KV gather
+        can silence beats past ``lost_after_s``, and declaring the
+        exporter dead mid-export would race recompute failover against
+        migration frames already on the wire for the SAME rids.  The
+        claim is bounded: once it expires a silent replica dies
+        normally and nothing is lost."""
+        rig = _RouterRig(n_replicas=2, lost_after_s=0.15,
+                         migration_claim_s=0.6)
+        try:
+            rig.submit("x")
+            victim = rig.router._inflight["x"].replica
+            survivor = "r1" if victim == "r0" else "r0"
+            rig.router.beat_handle.put(make_beat_item(
+                "decode", victim, migrating=["x"]))
+            rig.router.poll()
+            time.sleep(0.25)  # beat-age > lost_after_s, claim active
+            # The survivor beats on; ONLY the exporter goes silent.
+            rig.router.beat_handle.put(make_beat_item(
+                "decode", survivor))
+            rig.router.poll()
+            assert rig.router._replicas[victim].alive
+            assert rig.router.counters["failovers"] == 0
+            assert rig.router._inflight["x"].replica == victim
+            time.sleep(0.5)   # claim expired, still no beat: dead now
+            rig.router.beat_handle.put(make_beat_item(
+                "decode", survivor))
+            rig.router.poll()
+            rig.router.flush_outboxes()
+            assert not rig.router._replicas[victim].alive
+            assert rig.router.counters["failovers"] == 1
+            # The orphan finished the normal way: recompute failover.
+            assert rig.router._inflight["x"].replica != victim
+        finally:
+            rig.close()
+
+    def test_brownout_ladder_hysteresis_and_probe(self):
+        """Thin unit beside tools/chaos_serve_sweep.py --selftest: one
+        rung per observation, dwell between moves, descent needs the
+        exit margin, one half-open probe per window."""
+        from ray_lightning_tpu.serve.brownout import BrownoutLadder
+
+        t = [0.0]
+        b = BrownoutLadder(min_dwell_s=1.0, probe_every_s=5.0,
+                           clock=lambda: t[0])
+        assert b.observe(0.90) == 1   # first climb off 0 is immediate
+        assert b.observe(0.99) == 1   # dwell holds the rung
+        t[0] = 1.1
+        assert b.observe(0.99) == 2
+        t[0] = 2.2
+        assert b.observe(1.00) == 3
+        t[0] = 3.3
+        assert b.observe(0.94) == 3   # within exit margin: no descent
+        assert b.observe(0.10) == 2   # one rung down, never straight 0
+        t[0] = 10.0
+        assert b.allow_probe()        # opens the half-open window
+        assert not b.allow_probe()    # window closed until it elapses
+        t[0] = 15.1
+        assert b.allow_probe()
+
+
 # ---------------------------------------------------------------------------
 # Segment lifetime: dead prefill handoffs must not leak tmpfs
 # ---------------------------------------------------------------------------
@@ -938,6 +1039,109 @@ class TestInprocFleet:
             assert c["failovers"] >= 1 and c["replica_deaths"] == 1
             assert c["failed_over_requests"] >= 1
         finally:
+            client.close()
+            fleet.close()
+
+    @pytest.mark.slow  # tier-1 budget audit (round 19): ~10s fleet
+    # fit; the migration-claim + closing-beat router units carry the
+    # drain semantics in tier-1, tools/chaos_serve_sweep.py is the
+    # full-matrix gate
+    def test_drain_migration_parity_zero_reemit(self, dist_model):
+        """Tentpole acceptance: planned drain live-migrates resident
+        sequences — decode resumes mid-sequence on the survivor with
+        ZERO recomputed prefill (re_emitted_tokens == 0, the failover
+        path's signature) and bitwise token parity vs an uninterrupted
+        engine, greedy AND temperature>0."""
+        from ray_lightning_tpu.serve.client import ServeClient
+        from ray_lightning_tpu.serve.dist import launch_inproc_fleet
+
+        m, params = dist_model
+        p1, p2 = list(range(1, 9)), list(range(9, 17))
+        ref = _reference_tokens(dist_model, [p1, p2], [0.7, 0.0],
+                                max_new=30)
+        os.environ["RLT_MIGRATE_ON_DRAIN"] = "1"
+        fleet = launch_inproc_fleet(m, params, _serve_cfg(),
+                                    n_replicas=2, n_prefill=0,
+                                    lost_after_s=0.5)
+        client = ServeClient(fleet.queue_handle())
+        try:
+            r1 = client.submit(p1, 30, temperature=0.7)
+            r2 = client.submit(p2, 30)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                track = fleet.router._inflight.get(r1)
+                if (track is not None and track.replica is not None
+                        and len(client._pending[r1].tokens) >= 3):
+                    victim = track.replica
+                    break
+                time.sleep(0.01)
+            else:
+                pytest.fail("request never started streaming")
+            next(r for r in fleet.replicas
+                 if r.id == victim).kill(hard=False)
+            out1 = client.result(r1, timeout=120)
+            out2 = client.result(r2, timeout=120)
+            assert out1 == ref[0]         # bitwise across the drain
+            assert out2 == ref[1]
+            assert client.re_emitted_tokens == 0  # nothing recomputed
+            c = fleet.router.counters
+            assert c["migrations"] >= 1
+            assert c["failovers"] == 0 and c["replica_deaths"] == 0
+        finally:
+            os.environ.pop("RLT_MIGRATE_ON_DRAIN", None)
+            client.close()
+            fleet.close()
+
+    @pytest.mark.slow  # tier-1 budget audit (round 19): ~10s fleet
+    # fit; hedge admission/cancel policy units ride the router rig in
+    # tier-1, this drill proves the wire + dedup end to end
+    def test_hedge_first_winner_cancels_loser(self, dist_model):
+        """A hedged duplicate races a fault-slowed replica: first
+        finisher wins, the router cancels the loser's copy, and the
+        duplicate stream merges bitwise through the token-index dedup
+        (re_emitted_tokens counts the merged copies)."""
+        from ray_lightning_tpu.serve.client import ServeClient
+        from ray_lightning_tpu.serve.dist import launch_inproc_fleet
+
+        m, params = dist_model
+        p1 = list(range(1, 9))
+        ref = _reference_tokens(dist_model, [p1], [0.7], max_new=30)
+        fleet = launch_inproc_fleet(m, params, _serve_cfg(),
+                                    n_replicas=2, n_prefill=0,
+                                    lost_after_s=5.0)
+        client = ServeClient(fleet.queue_handle())
+        try:
+            r1 = client.submit(p1, 30, temperature=0.7)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                track = fleet.router._inflight.get(r1)
+                if (track is not None and track.replica is not None
+                        and len(client._pending[r1].tokens) >= 3):
+                    victim = track.replica
+                    break
+                time.sleep(0.01)
+            else:
+                pytest.fail("request never started streaming")
+            # Stall the placed replica's decode ticks (the straggler
+            # hedging exists for), then duplicate onto a survivor.
+            os.environ["RLT_FAULT"] = (
+                f"slow@point:replica_tick,replica:{victim},"
+                f"secs:0.3,once:0")
+            assert client.hedge(r1)
+            out1 = client.result(r1, timeout=120)
+            assert out1 == ref[0]          # merged stream is bitwise
+            assert client.re_emitted_tokens > 0  # copies really merged
+            c = fleet.router.counters
+            assert c["hedges"] >= 1
+            # The router learns the winner from the next done beat —
+            # wait out the beat lag before asserting the cancel.
+            deadline = time.monotonic() + 15
+            while (c["hedge_cancels"] < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert c["hedge_cancels"] >= 1  # loser copy cancelled
+        finally:
+            os.environ.pop("RLT_FAULT", None)
             client.close()
             fleet.close()
 

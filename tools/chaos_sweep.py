@@ -119,6 +119,84 @@ def _selftest() -> list:
         inject._corrupt_torn(path)
         check(bool(verify_stream_file(path)), "verify: torn missed")
 
+    # Serve-plane grammar (docs/FAULT_TOLERANCE.md "Serving-plane
+    # faults"): injection points, member/rid pins, send-site kinds.
+    specs = inject.parse_faults(
+        "blackhole@point:beat,replica:decode-0;"
+        "torn@point:handoff_send,worker:prefill-0,nth:2;"
+        "shm_vanish@point:handoff_send,rid:abc123;"
+        "slow@point:replica_tick,replica:decode-1,secs:0.5,once:0;"
+        "exc@point:adapter_load;"
+        "blackhole@point:handoff_read,replica:decode-1"
+    )
+    check(len(specs) == 6, "serve grammar: expected 6 specs")
+    check(specs[0].kind == "blackhole" and specs[0].replica == "decode-0",
+          "serve grammar: replica pin parse")
+    check(specs[1].worker == "prefill-0" and specs[1].nth == 2,
+          "serve grammar: worker/nth parse")
+    check(specs[2].kind == "shm_vanish" and specs[2].rid == "abc123",
+          "serve grammar: rid pin parse")
+    check(specs[3].kind == "slow" and specs[3].secs == 0.5
+          and specs[3].once is False, "serve grammar: slow secs/once")
+    check(specs[4].point == "adapter_load",
+          "serve grammar: adapter_load point")
+    for bad in ("blackhole@point:nowhere", "crash@replica",
+                "wormhole@point:beat"):
+        try:
+            inject.parse_faults(bad)
+            problems.append(f"serve grammar: {bad!r} should not parse")
+        except ValueError:
+            pass
+
+    # Member-pinned matching: a replica pin must fire only for that
+    # member, a rid pin only for that request, and the thread-local
+    # member context must scope fire() to the declaring thread.
+    plan3 = inject.FaultPlan(
+        inject.parse_faults(
+            "blackhole@point:beat,replica:decode-0;"
+            "exc@point:handoff_read,rid:r-7"
+        ),
+        None,
+    )
+    check(not plan3.due("beat", None, None, None, replica="decode-1"),
+          "serve match: wrong replica fired")
+    check(len(plan3.due("beat", None, None, None,
+                        replica="decode-0")) == 1,
+          "serve match: pinned replica did not fire")
+    check(not plan3.due("handoff_read", None, None, None,
+                        replica="decode-0", rid="r-8"),
+          "serve match: wrong rid fired")
+    check(len(plan3.due("handoff_read", None, None, None,
+                        replica="decode-0", rid="r-7")) == 1,
+          "serve match: pinned rid did not fire")
+
+    # End-to-end through fire(): FaultBlackhole at a send-site, and
+    # shm_vanish unlinking the handoff's segment path.
+    with tempfile.TemporaryDirectory(prefix="rlt_chaos_serve_") as tmp:
+        os.environ["RLT_FAULT"] = (
+            "blackhole@point:beat,replica:decode-0,once:0;"
+            "shm_vanish@point:handoff_send,rid:r-1,once:0"
+        )
+        try:
+            inject.set_member("decode", "decode-0")
+            try:
+                inject.fire("beat")
+                problems.append("serve fire: blackhole did not raise")
+            except inject.FaultBlackhole:
+                pass
+            seg = os.path.join(tmp, "seg")
+            with open(seg, "wb") as f:
+                f.write(b"\x00" * 8)
+            inject.fire("handoff_send", rid="r-2", path=seg)
+            check(os.path.exists(seg),
+                  "serve fire: shm_vanish hit the wrong rid")
+            inject.fire("handoff_send", rid="r-1", path=seg)
+            check(not os.path.exists(seg),
+                  "serve fire: shm_vanish left the segment")
+        finally:
+            inject.set_member(None, None)
+            os.environ.pop("RLT_FAULT", None)
+
     # Elastic world sizing: the lose_worker capacity oracle and the
     # governor's shrink/grow/reject decision logic (pure — no fits).
     with tempfile.TemporaryDirectory(prefix="rlt_chaos_cap_") as tmp:

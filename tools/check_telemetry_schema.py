@@ -39,6 +39,7 @@ from ray_lightning_tpu.telemetry.schema import (  # noqa: E402
     validate_bench_programs,
     validate_bench_residual_policy,
     validate_bench_serve,
+    validate_bench_serve_chaos,
     validate_bench_serve_disagg,
     validate_bench_slo,
     validate_bench_spec_decode,
@@ -652,8 +653,99 @@ def _self_test_serve() -> list:
         )
     problems += _self_test_spec_decode(stats)
     problems += _self_test_serve_disagg()
+    problems += _self_test_serve_chaos()
     problems += _self_test_multi_lora()
     problems += _self_test_prefix_cache()
+    return problems
+
+
+def _self_test_serve_chaos() -> list:
+    """Serving-plane resilience producers vs their schema (ISSUE 19):
+    a REAL migration frame (the serve/dist frame builder carrying KV
+    payload + scheduler position), the typed shed reply, the hedged
+    resubmit / priority request fields, the router snapshot's brownout
+    level, and the bench serve_chaos block — plus negatives (a
+    position invariant that doesn't add up, an empty migration, a
+    brownout level off the ladder, a chaos block missing its parity
+    pin)."""
+    from ray_lightning_tpu.serve.dist.handoff import (
+        make_migration_item, request_fields,
+    )
+    from ray_lightning_tpu.telemetry.schema import (
+        validate_bench_serve_chaos, validate_serve_migration,
+    )
+
+    req = request_fields(
+        "abc", [1, 2, 3], 8, reply=("127.0.0.1", 12345), sample_seed=7,
+        temperature=0.7, priority=1,
+    )
+    problems = validate_serve_request(req, "self-test priority request")
+    item = make_migration_item(
+        req, generated=[5, 6], cur_token=6, seq_len=4, data=b"\x00kv",
+    )
+    problems += validate_serve_migration(item, "self-test migration")
+    # No json_roundtrip here: migration frames carry a raw-bytes KV
+    # payload (they ride the pickled beat lane, never JSON).
+    if not validate_serve_migration({**item, "seq_len": 99}):
+        problems.append(
+            "self-test migration: validator accepted a scheduler "
+            "position that doesn't match prompt + generated"
+        )
+    if not validate_serve_migration({**item, "generated": []}):
+        problems.append(
+            "self-test migration: validator accepted an empty stream "
+            "(nothing decoded = nothing worth migrating)"
+        )
+    seedless = {
+        **item,
+        "req": {k: v for k, v in req.items() if k != "sample_seed"},
+    }
+    if not validate_serve_migration(seedless):
+        problems.append(
+            "self-test migration: validator accepted a frame without "
+            "the fleet sample_seed (parity on the survivor needs it)"
+        )
+    problems += validate_serve_request(
+        {**req, "hedge": True}, "self-test hedged resubmit"
+    )
+    problems += validate_serve_reply(
+        {"type": "serve_done", "rid": "abc", "status": "shed",
+         "reason": "brownout", "tokens": []},
+        "self-test shed reply",
+    )
+    if not validate_router_snapshot(
+        {"replicas": [], "prefill_workers": [], "inflight": 0,
+         "counters": {}, "brownout_level": 7}
+    ):
+        problems.append(
+            "self-test router snapshot: validator accepted a brownout "
+            "level off the ladder"
+        )
+    block = {
+        "migrations": 2, "migration_ttr_s": 0.4, "failover_ttr_s": 1.3,
+        "migration_vs_failover": 3.2, "lost_requests": 0,
+        "migration_re_emitted_tokens": 0, "parity": True,
+        "recompiles_steady_state": 0, "failover_re_emitted_tokens": 9,
+        "hedges": 1, "hedge_cancels": 1, "shed": 2,
+        "brownout_level_max": 3,
+    }
+    problems += validate_bench_serve_chaos(
+        block, "self-test bench serve_chaos"
+    )
+    for key in ("parity", "migration_re_emitted_tokens"):
+        broken = {k: v for k, v in block.items() if k != key}
+        if not validate_bench_serve_chaos(broken):
+            problems.append(
+                f"self-test serve_chaos: validator accepted a block "
+                f"missing {key!r}"
+            )
+    if not validate_bench_serve_chaos(
+        {**block, "brownout_level_max": 9}
+    ):
+        problems.append(
+            "self-test serve_chaos: validator accepted a brownout "
+            "level off the ladder"
+        )
     return problems
 
 
@@ -1361,6 +1453,12 @@ def scan_bench_files() -> list:
         if disagg is not None:  # pre-disaggregation rounds lack it
             problems += validate_bench_serve_disagg(
                 disagg, f"{name}:serve_disagg"
+            )
+        chaos = (doc.get("serve_chaos")
+                 or (serve or {}).get("serve_chaos"))
+        if chaos is not None:  # pre-serve-chaos rounds lack it
+            problems += validate_bench_serve_chaos(
+                chaos, f"{name}:serve_chaos"
             )
         prefix = (doc.get("prefix_cache")
                   or (serve or {}).get("prefix_cache"))

@@ -871,6 +871,11 @@ _HOT_JIT = {
         "ServeEngine._claim_prefix", "ServeEngine._suffix_prefill",
         "ServeEngine._start_chunk_job", "ServeEngine._chunk_tick",
         "ServeEngine._prefix_insert",
+        # Live-migration admission: importing a mid-flight sequence
+        # must reuse the SAME greedy-decomposed _import_fn executables
+        # the handoff path warmed — a fresh jit here would turn every
+        # drain into a recompile storm on the survivor.
+        "ServeEngine._admit_migration",
     }),
     f"{_PKG}/serve/lora.py": frozenset({
         "AdapterPool.add", "AdapterPool.remove", "AdapterPool.slot_of",
@@ -884,6 +889,11 @@ _HOT_JIT = {
         # Headroom tie-break rides the placement hot path: the key
         # function must stay a pure dict read, never a jit probe.
         "Router._headroom",
+        # Serving-plane resilience (ISSUE 19): migration retarget,
+        # hedged placement and the brownout gate all ride the poll /
+        # submit hot loops — pure dict work only.
+        "Router._on_migration", "Router._hedge",
+        "Router._update_brownout",
     }),
     f"{_PKG}/mpmd/stage.py": frozenset({
         "StageRunner._run_opt_step",
@@ -926,6 +936,7 @@ _SCHEMA_PRODUCERS = {
         "request_fields": "SERVE_REQUEST",
         "make_handoff_item": "SERVE_HANDOFF",
         "make_adapter_load_item": "SERVE_ADAPTER_LOAD",
+        "make_migration_item": "SERVE_MIGRATION",
     },
     # SLO & capacity plane (ISSUE 18): store points, alert detail,
     # the oracle snapshot and the router's fleet fold.
@@ -970,6 +981,11 @@ def repo_config(repo_root: str) -> Config:
             f"{_PKG}/serve/capacity.py",
             f"{_PKG}/serve/scheduler.py",
             f"{_PKG}/serve/metrics.py",
+            # Brownout dwell/probe timers and client retry/hedge
+            # latency samples are per-process intervals: monotonic
+            # only, never wall clock.
+            f"{_PKG}/serve/brownout.py",
+            f"{_PKG}/serve/client.py",
             f"{_PKG}/mpmd/transfer.py",
             f"{_PKG}/parallel/grad_sync.py",
             f"{_PKG}/core/loop.py",
