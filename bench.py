@@ -754,6 +754,167 @@ def _bench_mpmd(on_tpu: bool) -> dict:
     }
 
 
+def _collectives_before_last_dot(hlo) -> "int | None":
+    """HLO-structural overlap proof: count collective ops scheduled
+    BEFORE the program's last matmul.  A step-end sync is data-
+    dependence-ordered after every backward dot (count 0); the tapped
+    backward interleaves its bucket collectives into the dot stream
+    (count > 0).  On the CPU backend sharded collectives lower to
+    all-to-all/all-gather; data dependence, not the scheduler, fixes
+    their position, so the text order is trustworthy."""
+    if not hlo:
+        return None
+    lines = hlo.splitlines()
+    last_dot = max(
+        (i for i, line in enumerate(lines) if " dot(" in line),
+        default=None,
+    )
+    if last_dot is None:
+        return None
+    return sum(
+        1 for line in lines[:last_dot]
+        if "=" in line and ("all-to-all" in line or "all-gather" in line)
+    )
+
+
+def _bench_comm_overlap(on_tpu: bool) -> dict:
+    """The schema-gated ``comm_overlap`` block (round 25): step-end vs
+    backward-overlapped grad sync, both arms at grad_comm=int8_ef on a
+    mesh over every local device.  Acceptance surface: loss parity at
+    the EF tolerance, identical wire volume (bucket re-planning only
+    pads), unchanged dispatches/opt-step, zero steady-state recompiles
+    in both arms, and the HLO gate proving the overlapped arm's
+    collectives are interleaved into the backward."""
+    from ray_lightning_tpu.telemetry import program_ledger as _ledger
+
+    cfg = GPTConfig.tiny()
+    n_dev = jax.local_device_count()
+    segments = 2
+    steps = 6
+    batch_size = max(8, n_dev)
+
+    class _HloProbe(Callback):
+        """Grab the step program's HLO MID-fit: the ledger's site
+        registry holds the LedgeredFunction by weak reference, so the
+        text is only reachable while the loop's step fn is alive."""
+
+        def __init__(self):
+            self.collectives = None
+
+        def on_train_batch_end(self, trainer, module, logs, batch_idx):
+            if self.collectives is None:
+                self.collectives = _collectives_before_last_dot(
+                    _ledger.hlo_text("train/step")
+                )
+
+    def run(seg):
+        pre = len(_ledger.snapshot().get("recompiles", []))
+        probe = _HloProbe()
+        module = GPT(cfg, attn_impl="auto" if on_tpu else "xla")
+        module.precision = "f32"
+        trainer = Trainer(
+            strategy=LocalStrategy(
+                mesh_axes={"data": n_dev},
+                grad_comm={"mode": "int8_ef", "dcn_only": False},
+                grad_overlap_segments=seg,
+            ),
+            max_steps=steps,
+            enable_checkpointing=False,
+            limit_val_batches=0,
+            log_every_n_steps=10_000,
+            callbacks=[probe],
+        )
+        dm = SyntheticLMDataModule(
+            cfg, batch_size=batch_size, num_batches=steps + 1,
+        )
+        trainer.fit(module, dm)
+        events = _ledger.snapshot().get("recompiles", [])[pre:]
+        return {
+            "loss": float(trainer.callback_metrics["train_loss"]),
+            "bytes": float(trainer.comm_stats["grad_sync_bytes"]),
+            "dispatches": _dispatches_per_opt_step(trainer),
+            # variant 0 events are cross-arm first compiles of a fresh
+            # LedgeredFunction; steady-state recompiles re-lower an
+            # EXISTING function (variant >= 1).
+            "recompiles": sum(
+                1 for e in events
+                if e.get("site") == "train/step"
+                and e.get("variant", 0) >= 1
+            ),
+            "collectives": probe.collectives,
+        }
+
+    a = run(0)          # step-end sync (the zero-risk default)
+    b = run(segments)   # tapped backward
+    rel = abs(b["loss"] - a["loss"]) / max(abs(a["loss"]), 1e-9)
+    block = {
+        "segments": segments,
+        "mode": "int8_ef",
+        "devices": n_dev,
+        "loss_rel_diff": round(rel, 6),
+        "loss_step_end": round(a["loss"], 6),
+        "loss_overlap": round(b["loss"], 6),
+        "grad_sync_bytes_step_end": a["bytes"],
+        "grad_sync_bytes_overlap": b["bytes"],
+        "bytes_ratio": round(b["bytes"] / max(a["bytes"], 1e-9), 4),
+        "dispatches_per_opt_step_step_end": a["dispatches"],
+        "dispatches_per_opt_step_overlap": b["dispatches"],
+        "recompiles_step_end": a["recompiles"],
+        "recompiles_overlap": b["recompiles"],
+        "collectives_before_last_dot_step_end": a["collectives"],
+        "collectives_before_last_dot_overlap": b["collectives"],
+        "hlo_gate": (
+            None if a["collectives"] is None or b["collectives"] is None
+            else a["collectives"] == 0 and b["collectives"] > 0
+        ),
+    }
+
+    # Quantized-DCN-wire probe: the in-proc 2-worker pipeline (the same
+    # StageRunner code path the actor plane drives) at f32 vs the
+    # bf16-act/int8-grad codec — loss parity + measured byte ratio.
+    try:
+        from ray_lightning_tpu.mpmd.inproc import run_inproc_pipeline_fit
+        from ray_lightning_tpu.mpmd.plan import _gpt_untie, gpt_mpmd_spec
+
+        mcfg = GPTConfig(vocab_size=256, n_layer=4, n_head=4, d_model=64,
+                         seq_len=64, warmup_steps=2)
+        mmod = GPT(mcfg, attn_impl="xla")
+        mmod.precision = "f32"
+        spec = gpt_mpmd_spec(mmod)
+        full = _gpt_untie(mmod.init_params(jax.random.PRNGKey(0)))
+        rng = np.random.default_rng(17)
+        data = [
+            {"tokens": rng.integers(
+                0, mcfg.vocab_size, (8, mcfg.seq_len + 1)
+            ).astype(np.int32)}
+            for _ in range(3)
+        ]
+        arms = {
+            enc: run_inproc_pipeline_fit(
+                spec, full, spec.tx_factory, lambda s: data[s], 3,
+                n_workers=2, n_micro=4, wire_dtype=enc,
+            )
+            for enc in ("f32", "act:bf16,grad:int8")
+        }
+        ref, q = arms["f32"], arms["act:bf16,grad:int8"]
+        sent = sum(x["bytes_sent"] for x in q["xfer"])
+        fullw = sum(x["bytes_full_width"] for x in q["xfer"])
+        block["mpmd_wire_enc"] = "act:bf16,grad:int8"
+        block["mpmd_wire_ratio"] = round(fullw / max(sent, 1), 4)
+        block["mpmd_loss_rel_diff"] = round(
+            max(
+                abs(x - y) / max(abs(x), 1e-9)
+                for x, y in zip(ref["losses"], q["losses"])
+            ), 6,
+        )
+    except Exception as e:  # noqa: BLE001 - probe must not cost the block
+        sys.stderr.write(f"comm_overlap mpmd wire probe skipped: {e}\n")
+        block["mpmd_wire_enc"] = None
+        block["mpmd_wire_ratio"] = None
+        block["mpmd_loss_rel_diff"] = None
+    return block
+
+
 def _detect_backend() -> str:
     """Resolve the backend, degrading to CPU if the TPU runtime is
     unreachable (tunnel/service outage) — the harness must always get a
@@ -853,6 +1014,11 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 - same discipline
             sys.stderr.write(f"mpmd probes skipped: {e}\n")
     try:
+        comm_overlap_block = _bench_comm_overlap(on_tpu)
+    except Exception as e:  # noqa: BLE001 - same discipline
+        sys.stderr.write(f"comm_overlap probes skipped: {e}\n")
+        comm_overlap_block = None
+    try:
         opt_state_block = _bench_opt_state_block(cfg, batch_size, fit_tps)
     except Exception as e:  # noqa: BLE001 - same discipline
         sys.stderr.write(f"opt_state probes skipped: {e}\n")
@@ -939,6 +1105,11 @@ def main() -> None:
         # tokens/sec vs the single-mesh GPipe formulation + the
         # GPipe-vs-interleaved-1F1B bubble decomposition.
         **({"mpmd": mpmd_block} if mpmd_block is not None else {}),
+        # Backward-overlapped grad sync A/B (schema-gated): loss parity,
+        # wire-volume invariance, zero-recompile pins, the HLO
+        # interleaving proof, and the quantized MPMD wire probe
+        # (docs/PERFORMANCE.md "Comm/compute overlap").
+        "comm_overlap": comm_overlap_block,
         # HBM-traffic diet (schema-gated): optimizer-state precision
         # accounting + parity, and the scan-residual-compression arm
         # (docs/PERFORMANCE.md "Optimizer-state precision & update

@@ -32,6 +32,10 @@ from ray_lightning_tpu.fault import inject as chaos
 from ray_lightning_tpu.fault.drain import PreemptedError
 from ray_lightning_tpu.parallel import sharding as shardlib
 from ray_lightning_tpu.parallel import step_fns
+from ray_lightning_tpu.parallel.overlap import (
+    normalize_grad_overlap,
+    resolve_grad_overlap,
+)
 from ray_lightning_tpu.telemetry import Telemetry
 from ray_lightning_tpu.telemetry import program_ledger
 from ray_lightning_tpu.utils.state_stream import (
@@ -88,6 +92,17 @@ class FitConfig:
     # TPU batch-only gspmd meshes, off on CPU), "on", "off"/bools.
     # Gated off wherever ZeRO already shards the state.
     update_sharding: Optional[Any] = None
+    # Backward-overlapped gradient sync (parallel/overlap.py): split the
+    # model trunk into G sub-scans and run each param group's bucketed
+    # quantized all-reduce inside the backward via custom_vjp grad taps,
+    # so the collectives hide under remaining backward compute instead
+    # of firing serialized after jax.grad.  Values: None (read the
+    # RLT_GRAD_OVERLAP env bus, forwarded to workers like
+    # RLT_GRAD_COMM), "off"/""/0 (step-end sync, the zero-risk
+    # default), or an int G >= 1.  Composes with grad_comm (the wire
+    # codec is unchanged — only WHERE the collectives fire moves); with
+    # grad_comm=full only the bitwise-neutral trunk segmentation runs.
+    grad_overlap_segments: Optional[Any] = None
     seed: int = 0
     precision: str = "f32"
     default_root_dir: str = "."
@@ -151,6 +166,7 @@ class FitConfig:
         # workers run TPUs.
         _normalize_megastep(self.megastep)
         _normalize_update_sharding(self.update_sharding)
+        normalize_grad_overlap(self.grad_overlap_segments)
         if self.fast_dev_run:
             self.max_epochs = 1
             self.limit_train_batches = 1
@@ -340,6 +356,13 @@ class LoopContext:
         # paths when their step runs inside the quantized-sync island.
         self.grad_sync_active = False
         self.comm_stats: Dict[str, Any] = {}
+        # Backward-overlapped sync (populated by run_fit): the resolved
+        # trunk-segment count G (0 = step-end).  Module forwards read it
+        # to segment their layer scan; during the overlapped island's
+        # differentiation ``grad_tap_plane`` additionally carries the
+        # per-trace tap registry (parallel/overlap.py TapPlane).
+        self.grad_overlap_segments = 0
+        self.grad_tap_plane = None
         # Telemetry runtime for this stage (always present; tier "off"
         # degrades every surface to a no-op).  ``telemetry_dir`` is where
         # exporters (span dumps, ProfilerCallback traces) co-locate.
@@ -1530,10 +1553,19 @@ def _run_fit_inner(
     # pick per-device-safe compute paths inside the sync island.
     from ray_lightning_tpu.parallel import grad_sync as gsync
 
+    # Backward-overlapped sync: the resolved trunk-segment count G is
+    # visible to the module's forward via the trainer context even when
+    # grad_sync itself is off (grad_comm=full) — pure segmentation is
+    # bitwise-neutral, so the knob's schedule shape can be A/B'd
+    # independently of the wire codec.
+    overlap_segments = resolve_grad_overlap(config.grad_overlap_segments)
+    ctx.grad_overlap_segments = overlap_segments
     grad_sync = gsync.maybe_build_grad_sync(
-        module, mesh, grad_comm, mode=mode, zero_stage=zero_stage
+        module, mesh, grad_comm, mode=mode, zero_stage=zero_stage,
+        overlap_segments=overlap_segments,
     )
     ctx.grad_sync_active = grad_sync is not None
+    tel.set_meta("grad_overlap_segments", overlap_segments)
     # Wire accounting flows through the telemetry counters (the unified
     # report) — ``ctx.comm_stats`` stays as a compatibility view of the
     # same numbers, not a parallel bookkeeping path.

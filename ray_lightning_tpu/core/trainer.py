@@ -87,6 +87,7 @@ class Trainer:
         accumulate_grad_batches: int = 1,
         megastep=None,
         update_sharding=None,
+        grad_overlap_segments=None,
         enable_checkpointing: bool = True,
         fast_dev_run: bool = False,
         resume_from_checkpoint: Optional[str] = None,
@@ -133,6 +134,11 @@ class Trainer:
             # strategy's knob / the RLT_UPDATE_SHARDING env bus /
             # "auto".
             update_sharding=update_sharding,
+            # Backward-overlapped gradient sync (G trunk segments +
+            # custom_vjp grad taps — docs/PERFORMANCE.md "Comm/compute
+            # overlap").  None defers to the strategy's knob / the
+            # RLT_GRAD_OVERLAP env bus / off.
+            grad_overlap_segments=grad_overlap_segments,
             seed=seed,
             precision=precision,
             default_root_dir=default_root_dir,

@@ -532,9 +532,40 @@ class GPT(TpuModule):
                     cp.save_only_these_names(*names),
                 )
             block = jax.checkpoint(block, policy=policy)
-        (x, aux), _ = jax.lax.scan(
-            block, (x, jnp.zeros((), jnp.float32)), params["blocks"]
+        # Grad-overlap trunk segmentation (parallel/overlap.py): split
+        # the layer scan into G sub-scans so each segment's stacked
+        # grads emerge at a segment boundary — tapped there, their
+        # bucket collectives overlap the earlier segments' backward
+        # instead of waiting for the whole trunk.  The taps sit OUTSIDE
+        # the (possibly remat-wrapped) block on the scan's xs input, and
+        # each sub-scan runs the same per-layer op sequence as the
+        # single scan, so segmentation alone (no plane — e.g. the
+        # grad_comm=full arm) is bitwise-neutral.
+        trainer = getattr(self, "trainer", None)
+        plane = getattr(trainer, "grad_tap_plane", None)
+        segs = (
+            plane.trunk_segments if plane is not None
+            else int(getattr(trainer, "grad_overlap_segments", 0) or 0)
         )
+        carry = (x, jnp.zeros((), jnp.float32))
+        if segs >= 1:
+            from ray_lightning_tpu.parallel.pipeline import layer_splits
+
+            bounds = layer_splits(
+                cfg.n_layer, min(segs, max(cfg.n_layer, 1))
+            )
+            for g in range(len(bounds) - 1):
+                b, e = bounds[g], bounds[g + 1]
+                sub = {
+                    k: jax.lax.slice_in_dim(v, b, e, axis=0)
+                    for k, v in params["blocks"].items()
+                }
+                if plane is not None:
+                    sub = plane.tap(f"seg{g}", sub)
+                carry, _ = jax.lax.scan(block, carry, sub)
+        else:
+            carry, _ = jax.lax.scan(block, carry, params["blocks"])
+        x, aux = carry
         if bf16r:
             x = x.astype(c)
         # Per-layer mean: the aux weight is depth-independent (balanced
@@ -542,6 +573,61 @@ class GPT(TpuModule):
         aux = aux / max(cfg.n_layer, 1)
         x = _layer_norm(x, params["ln_f_g"], params["ln_f_b"], lnp)
         return x, aux
+
+    def grad_overlap_groups(self, abstract_params, segments: int):
+        """Param partition for the backward-overlapped grad sync
+        (``parallel/overlap.py``), ordered by backward completion.
+
+        The final-LN group's cotangent completes *first* in the backward
+        (loss → layer N → … → layer 1 → embedding), so its sync hides
+        under the entire trunk backward; the trunk segments then
+        complete in reverse forward order (``seg{G-1}`` before
+        ``seg0``), each overlapping the segments still differentiating
+        below it; the embeddings complete last — their sync is the only
+        one with no compute left to hide under, ≈ the step-end
+        behavior.  ``head``/``embed`` are *entry* groups (top-level
+        param keys, applied by dict replacement so the tied-softmax
+        ``wte`` read in the CE head sees the tapped value too); the
+        ``seg{g}`` groups are tapped by :meth:`forward_hidden` at each
+        sub-scan boundary.
+        """
+        if segments < 1:
+            return None
+        from ray_lightning_tpu.parallel.pipeline import layer_splits
+
+        cfg = self.config
+        bounds = layer_splits(
+            cfg.n_layer, min(int(segments), max(cfg.n_layer, 1))
+        )
+        sds = jax.ShapeDtypeStruct
+
+        def _like(leaf):
+            return sds(tuple(leaf.shape), leaf.dtype)
+
+        def _rows(leaf, b, e):
+            return sds((e - b,) + tuple(leaf.shape[1:]), leaf.dtype)
+
+        groups = [(
+            "head",
+            {k: _like(abstract_params[k]) for k in ("ln_f_g", "ln_f_b")},
+            True,
+        )]
+        for g in range(len(bounds) - 1):
+            b, e = bounds[g], bounds[g + 1]
+            groups.append((
+                f"seg{g}",
+                {
+                    k: _rows(v, b, e)
+                    for k, v in abstract_params["blocks"].items()
+                },
+                False,
+            ))
+        groups.append((
+            "embed",
+            {k: _like(abstract_params[k]) for k in ("wte", "wpe")},
+            True,
+        ))
+        return groups
 
     # -- steps --------------------------------------------------------------
     def _loss(self, params, tokens):

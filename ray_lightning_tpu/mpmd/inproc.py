@@ -19,7 +19,12 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ray_lightning_tpu.mpmd.plan import MpmdSpec, StagePlan
 from ray_lightning_tpu.mpmd.stage import StageRunner
-from ray_lightning_tpu.mpmd.transfer import LocalChannel, Mailbox
+from ray_lightning_tpu.mpmd.transfer import (
+    LocalChannel,
+    Mailbox,
+    WireCodec,
+    WireDtypeConfig,
+)
 
 __all__ = ["split_micro_batches", "run_inproc_pipeline_fit"]
 
@@ -62,6 +67,7 @@ def run_inproc_pipeline_fit(
     device_groups: Optional[List[list]] = None,
     recv_timeout_s: float = 120.0,
     trace_dir: Optional[str] = None,
+    wire_dtype: Any = None,
 ) -> Dict[str, Any]:
     """Run a full MPMD fit with stage workers as threads; returns
     per-step losses (loss worker), per-worker steady-state stats, and
@@ -86,6 +92,14 @@ def run_inproc_pipeline_fit(
                 Mesh(np.asarray(device_groups[p]), ("data",))
             )
 
+    wire_cfg = WireDtypeConfig.coerce(wire_dtype)
+
+    def _codec() -> Optional[WireCodec]:
+        # One codec PER channel: int8 error-feedback residuals live on
+        # the sender side, keyed by micro-batch slot — sharing a codec
+        # across channels would cross-pollinate residuals.
+        return WireCodec(wire_cfg) if wire_cfg.active else None
+
     mailboxes = [Mailbox() for _ in range(n_workers)]
     runners: List[StageRunner] = []
     for p in range(n_workers):
@@ -94,8 +108,12 @@ def run_inproc_pipeline_fit(
             interleave=interleave,
             mesh=meshes[p],
             mailbox=mailboxes[p],
-            send_next=LocalChannel(mailboxes[(p + 1) % n_workers]),
-            send_prev=LocalChannel(mailboxes[(p - 1) % n_workers]),
+            send_next=LocalChannel(
+                mailboxes[(p + 1) % n_workers], codec=_codec()
+            ),
+            send_prev=LocalChannel(
+                mailboxes[(p - 1) % n_workers], codec=_codec()
+            ),
             recv_timeout_s=recv_timeout_s,
             trace_dir=trace_dir,
         ))
@@ -148,6 +166,7 @@ def run_inproc_pipeline_fit(
     return {
         "losses": loss_worker.losses,
         "per_stage_stats": [r.fit_stats() for r in runners],
+        "xfer": [r.xfer_stats() for r in runners],
         "step_summaries": [r.step_summaries for r in runners],
         "op_costs": [r.op_costs() for r in runners],
         "params": spec.assemble_params(parts, plan),

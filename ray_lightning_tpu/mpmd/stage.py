@@ -631,6 +631,37 @@ class StageRunner:
             for op, durs in self._op_durs.items() if durs
         }
 
+    def xfer_stats(self) -> Dict[str, Any]:
+        """Aggregate wire accounting over this worker's SEND channels
+        (``None`` entries — edge workers — contribute nothing).  Feeds
+        the strategy's ``mpmd_xfer`` telemetry block; ``wire_ratio`` is
+        full-width-bytes / encoded-bytes, so 1.0 means the codec is off
+        and ≥3 means the int8 arm is earning its keep."""
+        agg: Dict[str, Any] = {
+            "bytes_sent": 0, "bytes_full_width": 0, "wire_ratio": 1.0,
+        }
+        enc = None
+        for ch in (self.send_next, self.send_prev):
+            stats = getattr(ch, "xfer_stats", None)
+            if stats is None:
+                continue
+            s = stats()
+            agg["bytes_sent"] += int(s.get("bytes_sent", 0))
+            agg["bytes_full_width"] += int(s.get("bytes_full_width", 0))
+            if s.get("enc"):
+                enc = s["enc"]
+            if "shm_sends" in s:
+                agg["shm_sends"] = (
+                    agg.get("shm_sends", 0) + int(s["shm_sends"])
+                )
+        if agg["bytes_sent"] > 0:
+            agg["wire_ratio"] = (
+                agg["bytes_full_width"] / agg["bytes_sent"]
+            )
+        if enc is not None:
+            agg["enc"] = enc
+        return agg
+
     def fit_stats(self) -> Dict[str, float]:
         """Steady-state worker summary: the first optimizer step
         carries every program's compile and is excluded when later

@@ -19,15 +19,27 @@ activation streams in while the stage computes on ``i`` — a
 arrived yet, and that blocked time is measured and reported as pipeline
 bubble.
 
+**Quantized wire** (``wire_dtype`` knob / ``RLT_MPMD_WIRE_DTYPE``): the
+DCN segments between stages ship full-width f32 by default — the same
+bandwidth waste grad_comm already fixed for the data-parallel wire.  A
+:class:`WireCodec` on the send channel applies the block-scaled codec
+host-side before serialization: activations in bf16 or int8,
+activation-grads in int8 **with a sender-side error-feedback residual**
+(keyed per (kind, mb, chunk, leaf) and persisting across steps, so the
+compression error telescopes like grad_sync's EF).  Encoded leaves ride
+the wire as self-describing tagged dicts; ``decode_tree`` dequantizes
+transparently, so receivers need no codec config.
+
 Wire item shape (schema-pinned in ``telemetry/schema.py`` as
 ``mpmd_xfer``)::
 
     {"type": "mpmd_xfer", "kind": "act"|"grad", "step": int, "mb": int,
-     "data": bytes} | {..., "shm": path}
+     "data": bytes} | {..., "shm": path}   # + optional "enc": "a:…,g:…"
 """
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import threading
 import time
@@ -37,12 +49,18 @@ import numpy as np
 
 from ray_lightning_tpu.cluster import rpc
 from ray_lightning_tpu.cluster.queue import DriverQueue, QueueHandle
+from ray_lightning_tpu.fault.inject import (
+    FaultBlackhole,
+    fire as _fault_fire,
+)
 
 __all__ = [
     "Mailbox",
     "StageInbox",
     "LocalChannel",
     "QueueChannel",
+    "WireDtypeConfig",
+    "WireCodec",
     "encode_tree",
     "decode_tree",
     "resolve_payload",
@@ -64,7 +82,216 @@ def encode_tree(tree: Any) -> bytes:
 
 
 def decode_tree(payload: bytes) -> Any:
-    return rpc.loads(payload)
+    """Deserialize a wire payload, transparently dequantizing any
+    codec-tagged leaves (``WireCodec`` output is self-describing, so the
+    receive side needs no wire-dtype config — an f32 sender and an int8
+    sender land in the same mailbox)."""
+    return _dewire_tree(rpc.loads(payload))
+
+
+# -- quantized wire codec ----------------------------------------------------
+
+_WIRE_TAG = "__wire__"
+_WIRE_DTYPES = ("f32", "bf16", "int8")
+
+
+@dataclasses.dataclass(frozen=True)
+class WireDtypeConfig:
+    """Per-direction DCN payload dtypes for the pipeline transfer lane.
+
+    ``act`` applies to forward activation segments, ``grad`` to backward
+    activation-grad segments.  ``"f32"`` is the legacy full-width wire;
+    ``"bf16"`` halves the bytes with rounding only; ``"int8"`` is the
+    block-scaled codec (~3.9× fewer bytes) — on the grad direction it
+    additionally carries a sender-side error-feedback residual, the same
+    telescoping-error discipline as ``grad_sync`` int8_ef.
+    """
+
+    act: str = "f32"
+    grad: str = "f32"
+    block_size: int = 256
+
+    def __post_init__(self):
+        for field in ("act", "grad"):
+            v = getattr(self, field)
+            if v not in _WIRE_DTYPES:
+                raise ValueError(
+                    f"mpmd_wire_dtype {field}={v!r}: expected one of "
+                    f"{_WIRE_DTYPES}"
+                )
+        if self.block_size < 1:
+            raise ValueError("block_size must be >= 1")
+
+    @property
+    def active(self) -> bool:
+        return self.act != "f32" or self.grad != "f32"
+
+    @property
+    def enc(self) -> str:
+        """Compact mode string recorded on wire items / telemetry."""
+        return f"act:{self.act},grad:{self.grad}"
+
+    @classmethod
+    def coerce(cls, value: Any) -> "WireDtypeConfig":
+        """None | str | dict | WireDtypeConfig → WireDtypeConfig.
+
+        ``None`` reads the ``RLT_MPMD_WIRE_DTYPE`` env bus (forwarded to
+        workers like ``RLT_GRAD_COMM``); absent that, f32 — compression
+        is always opt-in.  A bare mode string applies to both
+        directions; ``"act:bf16,grad:int8"`` sets them independently.
+        """
+        if isinstance(value, cls):
+            return value
+        if value is None:
+            value = os.environ.get("RLT_MPMD_WIRE_DTYPE") or "f32"
+        if isinstance(value, dict):
+            kw = dict(value)
+        elif isinstance(value, str):
+            s = value.strip().lower()
+            if not s:
+                s = "f32"
+            if ":" in s:
+                kw = {}
+                for part in s.split(","):
+                    k, _, v = part.partition(":")
+                    kw[k.strip()] = v.strip()
+            else:
+                kw = {"act": s, "grad": s}
+        else:
+            raise TypeError(
+                f"mpmd_wire_dtype must be a mode string, dict or "
+                f"WireDtypeConfig; got {type(value).__name__}"
+            )
+        unknown = set(kw) - {"act", "grad", "block_size"}
+        if unknown:
+            raise ValueError(
+                f"mpmd_wire_dtype: unknown keys {sorted(unknown)} "
+                "(expected act/grad/block_size)"
+            )
+        return cls(**kw)
+
+
+def _quantize_leaf_int8(flat: np.ndarray, block: int):
+    """Block-scaled int8 of a flat f32 vector → (q int8, scales f32).
+    Mirrors ``ops/collective_quant.quantize_block_scaled`` (absmax/127,
+    zero blocks get scale 1.0 so they quantize exactly) but runs
+    host-side in numpy — the transfer lane is host memory by the time
+    it serializes."""
+    n = flat.size
+    pad = (-n) % block
+    if pad:
+        flat = np.pad(flat, (0, pad))
+    blocks = flat.reshape(-1, block)
+    scales = np.abs(blocks).max(axis=1).astype(np.float32) / 127.0
+    scales[scales == 0.0] = 1.0
+    q = np.clip(np.rint(blocks / scales[:, None]), -127, 127).astype(
+        np.int8
+    )
+    return q.reshape(-1), scales
+
+
+def _dewire_leaf(leaf: Any) -> Any:
+    if not (isinstance(leaf, dict) and _WIRE_TAG in leaf):
+        return leaf
+    mode = leaf[_WIRE_TAG]
+    if mode == "bf16":
+        return np.asarray(leaf["data"]).astype(leaf["dtype"])
+    if mode == "int8":
+        block = int(leaf["block"])
+        q = np.asarray(leaf["q"], np.float32).reshape(-1, block)
+        deq = (q * np.asarray(leaf["s"], np.float32)[:, None]).reshape(-1)
+        shape = tuple(leaf["shape"])
+        n = int(np.prod(shape)) if shape else 1
+        return deq[:n].reshape(shape).astype(leaf["dtype"])
+    raise ValueError(f"unknown wire codec tag {mode!r}")
+
+
+def _dewire_tree(tree: Any) -> Any:
+    import jax
+
+    return jax.tree_util.tree_map(
+        _dewire_leaf, tree,
+        is_leaf=lambda x: isinstance(x, dict) and _WIRE_TAG in x,
+    )
+
+
+class WireCodec:
+    """Sender-side payload codec + wire accounting for one channel.
+
+    Holds the per-(kind, mb, chunk, leaf) error-feedback residuals for
+    the int8 grad direction: pipeline schedules re-send the same
+    (mb, chunk) slots every step, so each slot's compression error is
+    re-added to the next step's payload before quantizing and
+    telescopes instead of accumulating.  A slot whose leaf shape
+    changes (e.g. a ragged final batch) resets its residual to zero —
+    correctness first, one step of error lost.
+
+    Accounting: ``bytes_full_width`` is the analytic f32 footprint of
+    every float leaf (plus raw bytes of non-float leaves) — the
+    denominator the ``mpmd_xfer`` wire-ratio artifact divides by.
+    """
+
+    def __init__(self, cfg: WireDtypeConfig):
+        self.cfg = cfg
+        self._resid: Dict[Tuple, np.ndarray] = {}
+        self.bytes_full_width = 0
+
+    def mode_for(self, kind: str) -> str:
+        return self.cfg.grad if kind == "grad" else self.cfg.act
+
+    def encode_payload(
+        self, kind: str, step: int, mb: int, chunk: int, tree: Any
+    ) -> bytes:
+        """Host-ify, wire-encode and serialize one segment payload."""
+        import jax
+
+        del step  # residual slots are keyed per (mb, chunk), not step
+        mode = self.mode_for(kind)
+        use_ef = mode == "int8" and kind == "grad"
+        counter = [0]
+
+        def _encode(leaf):
+            idx = counter[0]
+            counter[0] += 1
+            a = np.asarray(leaf)
+            if not np.issubdtype(a.dtype, np.floating):
+                self.bytes_full_width += a.nbytes
+                return a
+            self.bytes_full_width += a.size * 4
+            if mode == "f32":
+                return a
+            if mode == "bf16":
+                import ml_dtypes
+
+                return {
+                    _WIRE_TAG: "bf16",
+                    "data": a.astype(ml_dtypes.bfloat16),
+                    "dtype": a.dtype.str,
+                }
+            flat = a.astype(np.float32, copy=False).reshape(-1)
+            key = (kind, int(mb), int(chunk), idx)
+            if use_ef:
+                resid = self._resid.get(key)
+                if resid is not None and resid.shape == flat.shape:
+                    flat = flat + resid
+            q, scales = _quantize_leaf_int8(flat, self.cfg.block_size)
+            if use_ef:
+                deq = (
+                    q.astype(np.float32).reshape(-1, self.cfg.block_size)
+                    * scales[:, None]
+                ).reshape(-1)[: flat.size]
+                self._resid[key] = flat - deq
+            return {
+                _WIRE_TAG: "int8",
+                "q": q,
+                "s": scales,
+                "shape": tuple(a.shape),
+                "dtype": a.dtype.str,
+                "block": self.cfg.block_size,
+            }
+
+        wired = jax.tree_util.tree_map(_encode, tree)
+        return rpc.dumps(wired)
 
 
 def resolve_payload(item: Dict[str, Any], unlink: bool = True) -> bytes:
@@ -207,21 +434,39 @@ class StageInbox:
         self.queue.shutdown()
 
 
+def _channel_xfer_stats(channel) -> Dict[str, Any]:
+    """Wire accounting view shared by both channel flavors."""
+    codec: Optional[WireCodec] = channel._codec
+    sent = channel.bytes_sent
+    full = codec.bytes_full_width if codec is not None else sent
+    return {
+        "bytes_sent": sent,
+        "bytes_full_width": full,
+        "wire_ratio": round(full / sent, 3) if sent else None,
+        "enc": codec.cfg.enc if codec is not None else "act:f32,grad:f32",
+    }
+
+
 class LocalChannel:
     """In-process channel straight into a :class:`Mailbox` — the
     transport of the threaded in-process pipeline (tests, the inline
     parity harness)."""
 
-    def __init__(self, mailbox: Mailbox):
+    def __init__(self, mailbox: Mailbox, codec: Optional[WireCodec] = None):
         self._mailbox = mailbox
+        self._codec = codec
         self.bytes_sent = 0
 
     def send(self, kind: str, step: int, mb: int, tree: Any,
              chunk: int = 0, trace=None) -> None:
-        # Round-trip through the real encoder: in-process parity runs
-        # must exercise the same host-ification the wire path does
+        # Round-trip through the real encoder (and, when configured, the
+        # real wire codec): in-process parity runs must exercise the
+        # same host-ification + quantization the wire path does
         # (the trace envelope rides the same inject the wire uses).
-        payload = encode_tree(tree)
+        if self._codec is not None:
+            payload = self._codec.encode_payload(kind, step, mb, chunk, tree)
+        else:
+            payload = encode_tree(tree)
         self.bytes_sent += len(payload)
         envelope: Dict[str, Any] = {}
         if trace is not None:
@@ -233,6 +478,9 @@ class LocalChannel:
             trace=envelope.get("trace"),
         )
 
+    def xfer_stats(self) -> Dict[str, Any]:
+        return _channel_xfer_stats(self)
+
 
 class QueueChannel:
     """Cross-process channel to a neighbor stage's :class:`StageInbox`.
@@ -242,7 +490,8 @@ class QueueChannel:
     """
 
     def __init__(self, handle: QueueHandle, same_host: bool = False,
-                 shm_threshold: int = SHM_THRESHOLD_BYTES):
+                 shm_threshold: int = SHM_THRESHOLD_BYTES,
+                 codec: Optional[WireCodec] = None):
         self._handle = handle
         self._store = None
         if same_host:
@@ -250,17 +499,23 @@ class QueueChannel:
 
             self._store = SegmentStore(prefix="rlt-seg")
         self._shm_threshold = shm_threshold
+        self._codec = codec
         self.bytes_sent = 0
         self.shm_sends = 0
 
     def send(self, kind: str, step: int, mb: int, tree: Any,
              chunk: int = 0, trace=None) -> None:
-        payload = encode_tree(tree)
+        if self._codec is not None:
+            payload = self._codec.encode_payload(kind, step, mb, chunk, tree)
+        else:
+            payload = encode_tree(tree)
         self.bytes_sent += len(payload)
         item: Dict[str, Any] = {
             "type": "mpmd_xfer", "kind": kind, "step": int(step),
             "mb": int(mb), "chunk": int(chunk),
         }
+        if self._codec is not None:
+            item["enc"] = self._codec.cfg.enc
         if trace is not None:
             from ray_lightning_tpu.telemetry.propagate import inject
 
@@ -268,9 +523,22 @@ class QueueChannel:
         if self._store is not None and len(payload) >= self._shm_threshold:
             item["shm"] = self._store.put(payload)
             self.shm_sends += 1
+            # Chaos plane: the training fault grammar's torn/shm_vanish
+            # pins corrupt/unlink the segment between write and read —
+            # a quantized payload must then fail LOUDLY at decode (the
+            # inbox poisons its mailbox), never dequantize garbage.
+            try:
+                _fault_fire("handoff_send", step=step, path=item["shm"])
+            except FaultBlackhole:
+                return  # partition semantics: the frame vanishes in flight
         else:
             item["data"] = payload
         self._handle.put(item)
+
+    def xfer_stats(self) -> Dict[str, Any]:
+        stats = _channel_xfer_stats(self)
+        stats["shm_sends"] = self.shm_sends
+        return stats
 
     def close(self) -> None:
         self._handle.close()

@@ -25,7 +25,12 @@ import os
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_lightning_tpu.mpmd.stage import STAGE_CKPT_RE, StageRunner
-from ray_lightning_tpu.mpmd.transfer import QueueChannel, StageInbox
+from ray_lightning_tpu.mpmd.transfer import (
+    QueueChannel,
+    StageInbox,
+    WireCodec,
+    WireDtypeConfig,
+)
 
 __all__ = [
     "latest_mpmd_checkpoint",
@@ -139,12 +144,18 @@ def _stage_execute_remote(
     if isinstance(tx, tuple) and not hasattr(tx, "init"):
         tx = tx[0]
 
+    # coerce(None) falls back to the bridged RLT_MPMD_WIRE_DTYPE env
+    # knob, so actor workers honor it even when the task omits the key.
+    wire_cfg = WireDtypeConfig.coerce(task.get("wire_dtype"))
+
     def channel(addr):
         if addr is None:
             return None
         return QueueChannel(
             QueueHandle(addr[0], addr[1]),
             same_host=task.get("same_host", False),
+            # One codec per channel: int8 EF residuals are sender-side.
+            codec=WireCodec(wire_cfg) if wire_cfg.active else None,
         )
 
     send_next = channel(next_addr)
@@ -253,6 +264,7 @@ def _stage_execute_remote(
         "losses": list(runner.losses),
         "stats": runner.fit_stats(),
         "op_costs": runner.op_costs(),
+        "xfer": runner.xfer_stats(),
         "final_step": int(jax.device_get(runner.state.step)),
         "callback_metrics": last_logs,
         "hosts_loss": runner.hosts_loss,

@@ -46,6 +46,13 @@ KNOBS: Tuple[EnvKnob, ...] = (
     EnvKnob("RLT_GRAD_BUCKET_MB", True, "all-reduce bucket size"),
     EnvKnob("RLT_GRAD_BLOCK", True, "int8 quantization block length"),
     EnvKnob("RLT_GRAD_DCN_ONLY", True, "compress only across DCN"),
+    EnvKnob("RLT_GRAD_OVERLAP", True,
+            "backward-overlapped grad sync: trunk segment count G "
+            "(0/empty = step-end sync; parallel/overlap.py)"),
+    # -- MPMD transport (mpmd/transfer.py, worker-side) ------------------
+    EnvKnob("RLT_MPMD_WIRE_DTYPE", True,
+            "pipeline DCN payload codec: f32/bf16/int8 or "
+            "'act:X,grad:Y' (mpmd/transfer.py WireDtypeConfig)"),
     # -- telemetry bus (telemetry/runtime.py, worker-side) ---------------
     EnvKnob("RLT_TELEMETRY", True, "tier: off/cheap/full"),
     EnvKnob("RLT_TELEMETRY_SAMPLE", True, "step-stats sampling period"),

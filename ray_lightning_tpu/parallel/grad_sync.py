@@ -60,6 +60,7 @@ __all__ = [
     "Bucket",
     "BucketPlan",
     "build_bucket_plan",
+    "sync_leaf_buckets",
     "GradSync",
     "maybe_build_grad_sync",
 ]
@@ -254,6 +255,64 @@ def build_bucket_plan(
     )
 
 
+def sync_leaf_buckets(
+    leaves: List[Any],
+    buckets: Sequence[Bucket],
+    resid_vec,
+    axes: Tuple[str, ...],
+    n_shards: int,
+    block_size: int,
+    use_ef: bool,
+) -> Tuple[List[Any], Optional[Any]]:
+    """Per-device bucketed quantized all-reduce of flat grad leaves.
+
+    The one sync kernel both paths share: the step-end
+    :meth:`GradSync.build_synced_grad_fn` island runs it over the whole
+    grad tree after ``jax.grad`` returns; the backward-overlapped grad
+    taps (:mod:`ray_lightning_tpu.parallel.overlap`) run it per group on
+    the cotangent, mid-backward.  ``leaves`` are this bucket set's grad
+    leaves in plan order; ``resid_vec`` is the bucket set's contiguous
+    EF-residual slice (bucket offsets are local to it).  Returns the
+    synced leaves (original dtypes) and the concatenated new residual
+    (``None`` without EF).  Must run inside ``shard_map`` over ``axes``.
+    """
+    out_leaves = list(leaves)
+    resid_parts = []
+    for b in buckets:
+        parts = [
+            leaves[i].reshape(-1).astype(jnp.float32)
+            for i in b.indices
+        ]
+        flat = (
+            jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+        )
+        if b.padded > b.size:
+            flat = jnp.pad(flat, (0, b.padded - b.size))
+        if use_ef:
+            flat = flat + jax.lax.dynamic_slice(
+                resid_vec, (b.offset,), (b.padded,)
+            )
+        reduced, err = cq.int8_all_reduce(
+            flat, axes, n_shards, block_size, want_error=use_ef
+        )
+        if use_ef:
+            resid_parts.append(err)
+        pos = 0
+        for i, sz in zip(b.indices, b.sizes):
+            out_leaves[i] = (
+                jax.lax.dynamic_slice(reduced, (pos,), (sz,))
+                .reshape(leaves[i].shape)
+                .astype(leaves[i].dtype)
+            )
+            pos += sz
+    new_resid = (
+        jnp.concatenate(resid_parts)
+        if len(resid_parts) > 1
+        else (resid_parts[0] if resid_parts else None)
+    )
+    return out_leaves, new_resid
+
+
 class GradSync:
     """A resolved, active quantized-sync pipeline for one (module, mesh).
 
@@ -270,13 +329,19 @@ class GradSync:
         axes: Tuple[str, ...],
         n_shards: int,
         plan: BucketPlan,
+        overlap: Any = None,
     ):
         self.module = module
         self.mesh = mesh
         self.cfg = cfg
         self.axes = axes
         self.n_shards = n_shards
-        self.plan = plan
+        # Backward-overlapped sync (parallel/overlap.py OverlapPlan):
+        # when set, it duck-types BucketPlan's accounting/residual
+        # interface and BECOMES the active plan — stats, residual init
+        # and checkpoint reconciliation see one layout either way.
+        self.overlap = overlap
+        self.plan = overlap if overlap is not None else plan
         self.use_ef = cfg.mode == "int8_ef"
 
     # -- accounting ---------------------------------------------------------
@@ -300,6 +365,12 @@ class GradSync:
             ),
             "grad_sync_block_size": self.plan.block_size,
             "grad_sync_devices": self.n_shards,
+            # 0 = step-end sync; G >= 1 = backward-overlapped taps over
+            # G trunk segments (parallel/overlap.py).
+            "grad_sync_overlap_segments": (
+                self.overlap.trunk_segments
+                if self.overlap is not None else 0
+            ),
         }
 
     def register_telemetry(self, telemetry) -> None:
@@ -413,6 +484,8 @@ class GradSync:
         mean loss — the same quantity the implicit full-width path feeds
         the optimizer.
         """
+        if self.overlap is not None:
+            return self._build_overlapped_fn()
         module = self.module
         axes = self.axes
         n = self.n_shards
@@ -422,39 +495,9 @@ class GradSync:
 
         def _sync_buckets(grads, resid_row):
             leaves, treedef = jax.tree_util.tree_flatten(grads)
-            out_leaves = list(leaves)
-            resid_parts = []
-            for b in plan.buckets:
-                parts = [
-                    leaves[i].reshape(-1).astype(jnp.float32)
-                    for i in b.indices
-                ]
-                flat = (
-                    jnp.concatenate(parts) if len(parts) > 1 else parts[0]
-                )
-                if b.padded > b.size:
-                    flat = jnp.pad(flat, (0, b.padded - b.size))
-                if use_ef:
-                    flat = flat + jax.lax.dynamic_slice(
-                        resid_row, (b.offset,), (b.padded,)
-                    )
-                reduced, err = cq.int8_all_reduce(
-                    flat, axes, n, block, want_error=use_ef
-                )
-                if use_ef:
-                    resid_parts.append(err)
-                pos = 0
-                for i, sz in zip(b.indices, b.sizes):
-                    out_leaves[i] = (
-                        jax.lax.dynamic_slice(reduced, (pos,), (sz,))
-                        .reshape(leaves[i].shape)
-                        .astype(leaves[i].dtype)
-                    )
-                    pos += sz
-            new_resid = (
-                jnp.concatenate(resid_parts)
-                if len(resid_parts) > 1
-                else (resid_parts[0] if resid_parts else None)
+            out_leaves, new_resid = sync_leaf_buckets(
+                leaves, plan.buckets, resid_row, axes, n, block,
+                use_ef=use_ef,
             )
             return jax.tree_util.tree_unflatten(treedef, out_leaves), new_resid
 
@@ -506,6 +549,93 @@ class GradSync:
             check_vma=False,
         )
 
+    def _build_overlapped_fn(self):
+        """The backward-overlapped sync pipeline — same signature
+        contract as the step-end island, but the sync is *part of the
+        differentiation*: every param group is wrapped in a custom_vjp
+        grad tap (parallel/overlap.py) whose backward runs the group's
+        bucketed quantized all-reduce the moment its cotangent
+        completes, so XLA can overlap it with the backward compute
+        still pending for earlier-completing layers.
+
+        EF residuals ride the cotangent: the residual row is a second
+        differentiated argument — each tap consumes its group's slice
+        and returns the group's fresh residual as that slice's
+        cotangent, so ``d(loss)/d(residual_row)`` *is* the reassembled
+        next-step residual (the slices are disjoint, so the VJP's
+        scatter-add reassembles exactly).  No post-grad write-back pass,
+        and the result is bitwise the same residual layout the step-end
+        path checkpoints.
+        """
+        from ray_lightning_tpu.parallel.overlap import TapPlane
+
+        module = self.module
+        axes = self.axes
+        n = self.n_shards
+        oplan = self.overlap
+        use_ef = self.use_ef
+
+        def _pmean_logs(logs):
+            return jax.tree_util.tree_map(
+                lambda v: jax.lax.pmean(v, axes), logs
+            )
+
+        def _tapped_loss(params, resid_row, batch, rng):
+            plane = TapPlane(oplan, axes, n, use_ef, resid_row=resid_row)
+            params = plane.apply_entry_taps(params)
+            # The module's forward picks the plane up from its trainer
+            # context to tap each trunk segment at its sub-scan
+            # boundary; cleared in ``finally`` so eval/predict traces
+            # never see a stale plane.
+            trainer = getattr(module, "trainer", None)
+            if trainer is not None:
+                trainer.grad_tap_plane = plane
+            try:
+                loss, logs = module.training_step(params, batch, rng)
+            finally:
+                if trainer is not None:
+                    trainer.grad_tap_plane = None
+            plane.check_consumed()
+            logs = dict(logs)
+            logs.setdefault("loss", loss)
+            return loss / n, logs
+
+        batch_spec = P(axes)
+        if use_ef:
+            def island(params, residual, batch, rng):
+                def local_loss(p, rrow):
+                    return _tapped_loss(p, rrow, batch, rng)
+
+                (_, logs), (grads, new_resid) = jax.value_and_grad(
+                    local_loss, argnums=(0, 1), has_aux=True
+                )(params, residual[0])
+                return grads, _pmean_logs(logs), new_resid[None]
+
+            return shard_map(
+                island,
+                mesh=self.mesh,
+                in_specs=(P(), P(axes), batch_spec, P()),
+                out_specs=(P(), P(), P(axes)),
+                check_vma=False,
+            )
+
+        def island(params, batch, rng):
+            def local_loss(p):
+                return _tapped_loss(p, None, batch, rng)
+
+            (_, logs), grads = jax.value_and_grad(
+                local_loss, has_aux=True
+            )(params)
+            return grads, _pmean_logs(logs)
+
+        return shard_map(
+            island,
+            mesh=self.mesh,
+            in_specs=(P(), batch_spec, P()),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+
 
 def _batch_only_mesh(mesh) -> bool:
     """True when every mesh axis with extent > 1 is batch-parallel —
@@ -523,10 +653,17 @@ def maybe_build_grad_sync(
     mode: str = "gspmd",
     zero_stage: int = 0,
     abstract_params: Any = None,
+    overlap_segments: int = 0,
 ) -> Optional["GradSync"]:
     """Resolve a grad-comm request against the actual (mesh, strategy)
     shape.  Returns an active :class:`GradSync`, or ``None`` (full-width)
-    — every downgrade warns with the reason, never silently."""
+    — every downgrade warns with the reason, never silently.
+
+    ``overlap_segments >= 1`` additionally asks for backward-overlapped
+    sync (``grad_overlap_segments`` knob): the module must partition its
+    params via ``grad_overlap_groups`` (parallel/overlap.py) — a module
+    that can't (returns ``None`` / lacks the hook) warns and keeps the
+    step-end sync, never silently changes schedule."""
     cfg = GradCommConfig.coerce(cfg)
     if cfg.mode == "full" or mesh is None:
         return None
@@ -573,4 +710,33 @@ def maybe_build_grad_sync(
     if plan.num_buckets == 0:
         _downgrade("the module has no parameters to sync")
         return None
-    return GradSync(module, mesh, cfg, axes, n_shards, plan)
+    overlap = None
+    if overlap_segments and overlap_segments >= 1:
+        from ray_lightning_tpu.parallel import overlap as ovl
+
+        groups_fn = getattr(module, "grad_overlap_groups", None)
+        spec = (
+            groups_fn(abstract_params, overlap_segments)
+            if groups_fn is not None else None
+        )
+        if spec is None:
+            warnings.warn(
+                f"grad_overlap_segments={overlap_segments} requested but "
+                f"{type(module).__name__} does not partition its params "
+                "(grad_overlap_groups is missing or returned None); "
+                "gradients sync at step end."
+            )
+        else:
+            overlap = ovl.build_overlap_plan(
+                spec, n_shards, cfg.bucket_bytes, cfg.block_size
+            )
+            if overlap.total_elems != plan.total_elems:
+                # A partition that misses (or double-counts) params
+                # would silently skip their sync — module bug, fail
+                # loudly at build time.
+                raise ValueError(
+                    f"grad_overlap_groups covers {overlap.total_elems} "
+                    f"elements but the module has {plan.total_elems} — "
+                    "the groups must partition the whole param tree"
+                )
+    return GradSync(module, mesh, cfg, axes, n_shards, plan, overlap=overlap)

@@ -1,0 +1,370 @@
+"""Backward-overlapped gradient synchronization (the latency-hiding layer).
+
+:mod:`ray_lightning_tpu.parallel.grad_sync` cut the DCN wire *width*
+(block-scaled int8 + error feedback), but its collectives fire after
+``jax.grad`` returns — the whole wire time is exposed, serialized behind
+the backward.  This module moves the sync *into* the backward graph so
+XLA's latency-hiding scheduler can overlap each group's collective with
+the backward compute that is still pending:
+
+* the module partitions its params into **groups ordered by backward
+  completion** (``module.grad_overlap_groups``) — for a transformer LM
+  the head / final-LN grads complete *first* (loss → layer N → … →
+  layer 1 → embedding), so their bucket collectives can hide under the
+  entire trunk backward;
+* the trunk's layer scan is split into ``G`` sub-scans (knob
+  ``grad_overlap_segments`` / ``RLT_GRAD_OVERLAP``) so each segment's
+  stacked grads emerge at a segment boundary instead of all at once;
+* every group is wrapped in a ``jax.custom_vjp`` **grad tap**
+  (:class:`TapPlane`): the forward is the identity, the backward
+  receives the group's complete local cotangent — the tap replaces all
+  uses of the subtree, so by VJP accounting the accumulated cotangent
+  *is* the group's full local grad — and runs the group's bucketed
+  quantized all-reduce right there, mid-backward.
+
+Error-feedback residuals thread through the same taps: each group owns a
+contiguous slice of the per-device residual row, passed in as a tap
+operand (its VJP — a ``dynamic_slice`` — scatters the group's new
+residual back into the row cotangent, so the summed cotangent of the
+full row is the reassembled next-step residual).  The group layout is an
+:class:`OverlapPlan`, which exposes the same accounting interface as a
+step-end :class:`~ray_lightning_tpu.parallel.grad_sync.BucketPlan` —
+wire bytes are identical by construction (same codec, same alignment
+rule), so ``grad_sync_bytes`` and the EF resume path
+(``reconcile_resumed_state``) carry over unchanged.
+
+``grad_overlap_segments`` unset/""/0 resolves to the step-end path —
+the zero-risk default until a hardware window confirms the win.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "resolve_grad_overlap",
+    "normalize_grad_overlap",
+    "GroupPlan",
+    "OverlapPlan",
+    "build_overlap_plan",
+    "TapPlane",
+]
+
+
+def normalize_grad_overlap(value: Any) -> Optional[int]:
+    """Validate a ``grad_overlap_segments`` knob value and return its
+    normal form: None (defer to the env bus) or an int >= 0 (0 = off;
+    "off"/"" are accepted as 0, numeric strings become ints)."""
+    if value is None:
+        return None
+    if isinstance(value, str):
+        s = value.strip().lower()
+        if s in ("", "off", "none"):
+            return 0
+        try:
+            value = int(s)
+        except ValueError:
+            raise ValueError(
+                f"grad_overlap_segments={value!r}: expected 'off', '' or "
+                "an integer G >= 0"
+            ) from None
+    if isinstance(value, bool) or not isinstance(value, int):
+        raise TypeError(
+            f"grad_overlap_segments must be None, 'off' or an int >= 0; "
+            f"got {type(value).__name__}"
+        )
+    if value < 0:
+        raise ValueError(
+            f"grad_overlap_segments must be >= 0, got {value}"
+        )
+    return value
+
+
+def resolve_grad_overlap(value: Any) -> int:
+    """The concrete trunk-segment count G for this fit (0 = step-end).
+
+    Strongest first: an explicit ``grad_overlap_segments=`` on the
+    Trainer/strategy → the ``RLT_GRAD_OVERLAP`` env bus (forwarded to
+    workers like ``RLT_GRAD_COMM``) → off.  An empty ``RLT_GRAD_OVERLAP=``
+    means "off" (the operator cleared the knob), same as every other
+    normalization path.
+    """
+    value = normalize_grad_overlap(value)
+    if value is None:
+        value = normalize_grad_overlap(os.environ.get("RLT_GRAD_OVERLAP"))
+    return 0 if value is None else int(value)
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupPlan:
+    """One tap group: a param subtree synced at its backward boundary."""
+
+    name: str
+    #: Tapped at loss entry (a sub-dict of TOP-LEVEL param keys, applied
+    #: by dict replacement so every read — including a tied LM head —
+    #: sees the tapped value) vs inside the module's own forward.
+    entry: bool
+    keys: Tuple[str, ...]          # top-level param keys (entry groups)
+    plan: Any                      # group-local grad_sync.BucketPlan
+    resid_offset: int              # group's start in the residual row
+    leaf_sizes: Tuple[int, ...]    # tree-order element counts (validation)
+
+
+@dataclasses.dataclass(frozen=True)
+class OverlapPlan:
+    """Segment-aware bucket layout, backward-completion ordered.
+
+    Duck-types :class:`~ray_lightning_tpu.parallel.grad_sync.BucketPlan`'s
+    accounting interface (``wire_bytes_per_step`` / ``collectives_per_step``
+    / ``total_padded`` / …) so an active :class:`GradSync` can carry it as
+    its ``plan`` — stats, residual init and checkpoint reconciliation work
+    unchanged.
+    """
+
+    groups: Tuple[GroupPlan, ...]
+    trunk_segments: int            # G sub-scans the module's forward runs
+    n_shards: int
+    block_size: int
+    total_elems: int
+    total_padded: int
+    full_width_bytes: int
+
+    @property
+    def num_buckets(self) -> int:
+        return sum(g.plan.num_buckets for g in self.groups)
+
+    def wire_bytes_per_step(self, mode: str) -> int:
+        return sum(g.plan.wire_bytes_per_step(mode) for g in self.groups)
+
+    def collectives_per_step(self, mode: str) -> int:
+        return sum(g.plan.collectives_per_step(mode) for g in self.groups)
+
+    def group(self, name: str) -> GroupPlan:
+        for g in self.groups:
+            if g.name == name:
+                return g
+        raise KeyError(name)
+
+
+def _leaf_sizes(subtree: Any) -> Tuple[int, ...]:
+    sizes = []
+    for leaf in jax.tree_util.tree_leaves(subtree):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        sizes.append(int(np.prod(shape)) if shape else 1)
+    return tuple(sizes)
+
+
+def build_overlap_plan(
+    group_specs: Sequence[Tuple[str, Any, bool]],
+    n_shards: int,
+    bucket_bytes: int = 4 * 2**20,
+    block_size: int = 256,
+) -> OverlapPlan:
+    """Build per-group bucket plans from a module's
+    ``grad_overlap_groups`` spec: an ordered (backward-completion-first)
+    sequence of ``(name, abstract_subtree, entry)``.
+
+    Each group is bucketed independently with the step-end packer
+    (``grad_sync.build_bucket_plan``) — same codec, same
+    ``n_shards * block_size`` alignment — and owns a contiguous slice of
+    the per-device EF residual row at ``resid_offset``.  Group
+    granularity costs at most ``align - 1`` extra pad elements per group
+    versus one monolithic plan.
+    """
+    from ray_lightning_tpu.parallel.grad_sync import build_bucket_plan
+
+    groups: List[GroupPlan] = []
+    offset = 0
+    total_elems = 0
+    full_width_bytes = 0
+    trunk_segments = 0
+    seen: set = set()
+    for name, subtree, entry in group_specs:
+        if name in seen:
+            raise ValueError(f"duplicate grad-overlap group name {name!r}")
+        seen.add(name)
+        plan = build_bucket_plan(subtree, n_shards, bucket_bytes, block_size)
+        keys: Tuple[str, ...] = ()
+        if entry:
+            if not isinstance(subtree, dict):
+                raise ValueError(
+                    f"entry grad-overlap group {name!r} must be a dict of "
+                    "top-level param keys (applied by dict replacement); "
+                    f"got {type(subtree).__name__}"
+                )
+            keys = tuple(subtree.keys())
+        else:
+            trunk_segments += 1
+        groups.append(
+            GroupPlan(
+                name=name,
+                entry=entry,
+                keys=keys,
+                plan=plan,
+                resid_offset=offset,
+                leaf_sizes=_leaf_sizes(subtree),
+            )
+        )
+        offset += plan.total_padded
+        total_elems += plan.total_elems
+        full_width_bytes += plan.full_width_bytes
+    if not groups:
+        raise ValueError("grad_overlap_groups produced no groups")
+    return OverlapPlan(
+        groups=tuple(groups),
+        trunk_segments=trunk_segments,
+        n_shards=n_shards,
+        block_size=block_size,
+        total_elems=total_elems,
+        total_padded=offset,
+        full_width_bytes=full_width_bytes,
+    )
+
+
+def _make_group_tap(grp: GroupPlan, axes, n_shards: int, block_size: int,
+                    use_ef: bool):
+    """The ``custom_vjp`` identity whose backward syncs the group.
+
+    Primal: ``tap(leaves[, resid_slice]) -> leaves`` (tuple in, tuple
+    out).  Backward: the incoming cotangent tuple is the group's
+    complete per-device local grad (the tap replaces every use of the
+    subtree), so the group's bucketed quantized all-reduce runs right
+    here — mid-backward, with later-completing groups' compute still
+    pending for XLA to overlap against.  The EF variant returns the
+    group's fresh residual as the ``resid_slice`` cotangent; the
+    enclosing ``dynamic_slice`` VJP scatters it back into the row.
+    """
+    from ray_lightning_tpu.parallel import grad_sync as gsync
+
+    buckets = grp.plan.buckets
+
+    if use_ef:
+        @jax.custom_vjp
+        def tap(leaves, resid_slice):
+            del resid_slice
+            return leaves
+
+        def fwd(leaves, resid_slice):
+            return leaves, resid_slice
+
+        def bwd(resid_slice, ct):
+            out, new_resid = gsync.sync_leaf_buckets(
+                list(ct), buckets, resid_slice, axes, n_shards,
+                block_size, use_ef=True,
+            )
+            if new_resid is None:  # bucketless group (all-empty leaves)
+                new_resid = jnp.zeros_like(resid_slice)
+            return tuple(out), new_resid
+
+        tap.defvjp(fwd, bwd)
+        return tap
+
+    @jax.custom_vjp
+    def tap(leaves):
+        return leaves
+
+    def fwd(leaves):
+        return leaves, None
+
+    def bwd(_, ct):
+        out, _resid = gsync.sync_leaf_buckets(
+            list(ct), buckets, None, axes, n_shards, block_size,
+            use_ef=False,
+        )
+        return (tuple(out),)
+
+    tap.defvjp(fwd, bwd)
+    return tap
+
+
+class TapPlane:
+    """Trace-scoped tap registry for one differentiation of the loss.
+
+    Built inside the grad-sync island's local loss and installed on the
+    module's trainer context as ``grad_tap_plane`` for the duration of
+    the traced ``training_step``, so module forwards can route param
+    subtrees through :meth:`tap`.  Entry groups (top-level param keys —
+    the LM head / embeddings) are applied here by dict replacement
+    (:meth:`apply_entry_taps`) so *every* read of those params — the
+    tied-softmax head included — sees the tapped value; trunk segment
+    groups are tapped by the module at each sub-scan boundary.
+
+    One plane serves exactly one trace: :meth:`check_consumed` raises if
+    the forward skipped (or double-tapped) a group — a silent miss would
+    quietly drop that group's gradient sync.
+    """
+
+    def __init__(self, oplan: OverlapPlan, axes, n_shards: int,
+                 use_ef: bool, resid_row=None):
+        self._oplan = oplan
+        self._groups = {g.name: g for g in oplan.groups}
+        self._axes = axes
+        self._n = n_shards
+        self._use_ef = use_ef
+        self._resid_row = resid_row
+        self.consumed: set = set()
+
+    @property
+    def trunk_segments(self) -> int:
+        return self._oplan.trunk_segments
+
+    def apply_entry_taps(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        out = dict(params)
+        for grp in self._oplan.groups:
+            if not grp.entry:
+                continue
+            sub = {k: out[k] for k in grp.keys}
+            out.update(self.tap(grp.name, sub))
+        return out
+
+    def tap(self, name: str, subtree: Any) -> Any:
+        grp = self._groups.get(name)
+        if grp is None:
+            raise ValueError(
+                f"grad tap {name!r} is not in the overlap plan "
+                f"(groups: {sorted(self._groups)})"
+            )
+        if name in self.consumed:
+            raise ValueError(
+                f"grad tap {name!r} consumed twice in one trace — each "
+                "group must be tapped exactly once per differentiation"
+            )
+        leaves, treedef = jax.tree_util.tree_flatten(subtree)
+        sizes = _leaf_sizes(subtree)
+        if sizes != grp.leaf_sizes:
+            raise ValueError(
+                f"grad tap {name!r}: subtree leaf layout {sizes} does "
+                f"not match the plan's {grp.leaf_sizes} — the forward "
+                "must tap the same subtree grad_overlap_groups declared"
+            )
+        self.consumed.add(name)
+        fn = _make_group_tap(
+            grp, self._axes, self._n, self._oplan.block_size, self._use_ef
+        )
+        if self._use_ef:
+            resid_slice = jax.lax.dynamic_slice(
+                self._resid_row, (grp.resid_offset,),
+                (grp.plan.total_padded,),
+            )
+            out_leaves = fn(tuple(leaves), resid_slice)
+        else:
+            out_leaves = fn(tuple(leaves))
+        return jax.tree_util.tree_unflatten(treedef, list(out_leaves))
+
+    def check_consumed(self) -> None:
+        missing = [
+            g.name for g in self._oplan.groups
+            if g.name not in self.consumed
+        ]
+        if missing:
+            raise ValueError(
+                f"grad overlap groups never tapped this trace: {missing} "
+                "— the module's forward must route every declared "
+                "subtree through trainer.grad_tap_plane.tap()"
+            )

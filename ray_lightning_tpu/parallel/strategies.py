@@ -53,6 +53,7 @@ from ray_lightning_tpu.core.loop import (
 )
 from ray_lightning_tpu.fault import drain as drain_mod
 from ray_lightning_tpu.parallel import env_bus
+from ray_lightning_tpu.parallel.overlap import normalize_grad_overlap
 from ray_lightning_tpu.fault.drain import PreemptedError
 from ray_lightning_tpu.util import process_results
 
@@ -273,6 +274,7 @@ class TpuStrategy:
         monitor=None,
         megastep=None,
         update_sharding=None,
+        grad_overlap_segments=None,
         elastic_min_workers: Optional[int] = None,
         elastic_grow_after_s: Optional[float] = None,
         elastic_capacity_fn: Optional[Callable[[], int]] = None,
@@ -334,6 +336,12 @@ class TpuStrategy:
         # like every other strategy knob.
         _normalize_update_sharding(update_sharding)
         self.update_sharding = update_sharding
+        # Backward-overlapped grad sync (core/loop.py + parallel/
+        # overlap.py: G trunk segments, custom_vjp grad taps).  None
+        # defers to the Trainer's knob / the RLT_GRAD_OVERLAP env bus /
+        # off; validated eagerly like every other strategy knob.
+        normalize_grad_overlap(grad_overlap_segments)
+        self.grad_overlap_segments = grad_overlap_segments
         self.env_per_worker = dict(env_per_worker or {})
         # Persistent XLA compilation cache (RLT_COMPILE_CACHE=dir): the
         # first GPT-2-scale compile costs 20-40s on this platform; a
@@ -620,6 +628,11 @@ class TpuStrategy:
                 and self.update_sharding is not None):
             config = dataclasses.replace(
                 config, update_sharding=self.update_sharding
+            )
+        if (config.grad_overlap_segments is None
+                and self.grad_overlap_segments is not None):
+            config = dataclasses.replace(
+                config, grad_overlap_segments=self.grad_overlap_segments
             )
         elastic = self.max_restarts > 0 and kind == "fit"
         if elastic and config.restart_every_n_epochs is None:
@@ -1336,11 +1349,13 @@ class LocalStrategy(TpuStrategy):
     def __init__(self, mesh_axes: Optional[Dict[str, int]] = None,
                  mode: str = "gspmd", zero_stage: int = 0,
                  grad_comm=None, telemetry=None, monitor=None,
-                 megastep=None, update_sharding=None):
+                 megastep=None, update_sharding=None,
+                 grad_overlap_segments=None):
         super().__init__(
             num_workers=1, mesh_axes=mesh_axes, grad_comm=grad_comm,
             telemetry=telemetry, monitor=monitor, megastep=megastep,
             update_sharding=update_sharding,
+            grad_overlap_segments=grad_overlap_segments,
         )
         if monitor is not None:
             warnings.warn(
@@ -1380,6 +1395,11 @@ class LocalStrategy(TpuStrategy):
                 and self.update_sharding is not None):
             config = dataclasses.replace(
                 config, update_sharding=self.update_sharding
+            )
+        if (config.grad_overlap_segments is None
+                and self.grad_overlap_segments is not None):
+            config = dataclasses.replace(
+                config, grad_overlap_segments=self.grad_overlap_segments
             )
         # Gang-packing: inside a tune_run trial holding a sub-mesh
         # allocation (tuning/pack.py), build the mesh over exactly the
@@ -1557,9 +1577,18 @@ class MpmdStrategy(TpuStrategy):
         ckpt_every_n_steps: int = 1,
         tx_factory: Optional[Callable[[], Any]] = None,
         trace_dir: Optional[str] = None,
+        wire_dtype: Any = None,
         **kwargs: Any,
     ):
         from ray_lightning_tpu.mpmd.schedule import SCHEDULES
+        from ray_lightning_tpu.mpmd.transfer import WireDtypeConfig
+
+        if wire_dtype is not None:
+            # Eager validation (a bad codec string must fail at
+            # construction, not inside a stage actor); the validated
+            # value still ships as the raw knob so workers re-coerce —
+            # None defers to the bridged RLT_MPMD_WIRE_DTYPE env knob.
+            WireDtypeConfig.coerce(wire_dtype)
 
         if num_stages < 1:
             raise ValueError("num_stages must be >= 1")
@@ -1606,6 +1635,7 @@ class MpmdStrategy(TpuStrategy):
         self.recv_timeout_s = recv_timeout_s
         self.ckpt_every_n_steps = ckpt_every_n_steps
         self.tx_factory = tx_factory
+        self.wire_dtype = wire_dtype
         # Distributed step tracing (docs/OBSERVABILITY.md): a SHARED
         # path (same-host fleets or a shared mount) each stage actor
         # exports trace-mpmd-stage<k>.jsonl into at fit end; None =
@@ -1757,6 +1787,7 @@ class MpmdStrategy(TpuStrategy):
             ),
             "tx_factory": self.tx_factory,
             "trace_dir": self.trace_dir,
+            "wire_dtype": self.wire_dtype,
         }
         task_ref = self._backend.put(task)
         queue = self._backend.create_queue()
@@ -1845,6 +1876,20 @@ class MpmdStrategy(TpuStrategy):
                 self.schedule, self.num_workers, self.num_microbatches,
                 self.interleave, costs,
             )
+        xfers = [r["xfer"] for r in results if r.get("xfer")]
+        if xfers:
+            sent = sum(int(x.get("bytes_sent", 0)) for x in xfers)
+            full = sum(int(x.get("bytes_full_width", 0)) for x in xfers)
+            wire: Dict[str, Any] = {
+                "bytes_sent": sent,
+                "bytes_full_width": full,
+                "wire_ratio": (full / sent) if sent else 1.0,
+                "per_stage": xfers,
+            }
+            enc = next((x["enc"] for x in xfers if x.get("enc")), None)
+            if enc is not None:
+                wire["enc"] = enc
+            report["xfer"] = wire
         self.mpmd_report = report
         self._write_live_snapshot()
 

@@ -67,6 +67,7 @@ __all__ = [
     "Signature",
     "diff_signatures",
     "hbm_report",
+    "hlo_text",
     "ledger",
     "ledgered_jit",
     "recompile_records",
@@ -79,6 +80,15 @@ _LOG = logging.getLogger("ray_lightning_tpu.program_ledger")
 #: Ring caps: an observatory must never become the leak it watches.
 _MAX_RECORDS = 512
 _MAX_RECOMPILES = 128
+
+#: site -> the live LedgeredFunction most recently built for it (latest
+#: wins; weak values so the registry never pins a retraced function — or
+#: its compiled executables — alive).  Feeds :func:`hlo_text`.
+import weakref  # noqa: E402 - grouped with its sole consumer
+
+_SITE_FUNCTIONS: "weakref.WeakValueDictionary[str, Any]" = (
+    weakref.WeakValueDictionary()
+)
 
 
 # ---------------------------------------------------------------------------
@@ -454,6 +464,7 @@ class LedgeredFunction:
         self._variants: List[_Variant] = []   # guarded by self._lock
         self._mru: Optional[_Variant] = None
         self._lock = threading.Lock()
+        _SITE_FUNCTIONS[site] = self
 
     # -- introspection (tests, tooling) --------------------------------------
     @property
@@ -623,6 +634,26 @@ def ledgered_jit(fn: Callable, *, site: str,
 
         return jax.jit(fn, **jit_kwargs)
     return LedgeredFunction(fn, site, arg_names=arg_names, **jit_kwargs)
+
+
+def hlo_text(site: str) -> Optional[str]:
+    """Optimized HLO of the named site's most-recently-used compiled
+    variant, or ``None`` when unavailable (ledger disabled, site never
+    dispatched, backend without ``as_text``).  Best-effort by design —
+    callers gate structural assertions (the comm/compute-overlap bench
+    proof) on a non-``None`` return, they do not branch behavior."""
+    fn = _SITE_FUNCTIONS.get(site)
+    if fn is None:
+        return None
+    with fn._lock:
+        variant = fn._mru or (fn._variants[-1] if fn._variants else None)
+    if variant is None:
+        return None
+    try:
+        text = variant.compiled.as_text()
+    except Exception:  # noqa: BLE001 - backend-dependent surface
+        return None
+    return text if isinstance(text, str) else None
 
 
 # ---------------------------------------------------------------------------

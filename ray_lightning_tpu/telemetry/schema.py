@@ -53,6 +53,7 @@ __all__ = [
     "validate_mpmd_xfer",
     "validate_mpmd_snapshot",
     "validate_bench_mpmd",
+    "validate_bench_comm_overlap",
     "validate_program_row",
     "validate_recompile_record",
     "validate_program_snapshot",
@@ -1716,6 +1717,7 @@ _MPMD_XFER_OPTIONAL = {
     "data": bytes,
     "shm": str,
     "trace": dict,        # sender's trace envelope (cross-stage stitch)
+    "enc": str,           # wire codec ("act:bf16,grad:int8"); absent=f32
 }
 
 # mpmd-live.json (MpmdStrategy's live export, the rlt_top mpmd pane).
@@ -1816,6 +1818,83 @@ def validate_bench_mpmd(block: Any, where: str = "mpmd") -> List[str]:
         value = block.get(key)
         if isinstance(value, (int, float)) and not 0 <= value <= 1:
             problems.append(f"{where}: {key} {value} outside [0, 1]")
+    return problems
+
+
+# The bench comm_overlap block: the backward-overlapped grad-sync A/B
+# (round 25).  Both arms run the SAME int8_ef grad-comm config on the
+# same mesh; only `segments` differs (0 = step-end sync, G >= 1 =
+# tapped backward).  ``loss_rel_diff`` is the A/B fit parity at the EF
+# tolerance; ``bytes_ratio`` = overlap grad_sync_bytes / step-end
+# (bucket re-planning pads per group, so ~1.0 within 10%);
+# ``collectives_before_last_dot_*`` is the HLO-structural proof that
+# the overlapped arm's bucket collectives are data-dependence-ordered
+# INTO the backward rather than appended after it (step-end arm: 0).
+# ``mpmd_*`` keys record the quantized-DCN-wire probe.  Probe keys are
+# nullable — each arm is best-effort.
+_BENCH_COMM_OVERLAP_REQUIRED = {
+    "segments": int,
+    "mode": str,
+    "loss_rel_diff": (int, float),
+}
+_BENCH_COMM_OVERLAP_OPTIONAL = {
+    "devices": (int, type(None)),
+    "loss_step_end": (int, float, type(None)),
+    "loss_overlap": (int, float, type(None)),
+    "grad_sync_bytes_step_end": (int, float, type(None)),
+    "grad_sync_bytes_overlap": (int, float, type(None)),
+    "bytes_ratio": (int, float, type(None)),
+    "dispatches_per_opt_step_step_end": (int, float, type(None)),
+    "dispatches_per_opt_step_overlap": (int, float, type(None)),
+    "recompiles_step_end": (int, type(None)),
+    "recompiles_overlap": (int, type(None)),
+    "collectives_before_last_dot_step_end": (int, type(None)),
+    "collectives_before_last_dot_overlap": (int, type(None)),
+    "hlo_gate": (bool, type(None)),
+    "mpmd_wire_enc": (str, type(None)),
+    "mpmd_wire_ratio": (int, float, type(None)),
+    "mpmd_loss_rel_diff": (int, float, type(None)),
+}
+
+
+def validate_bench_comm_overlap(
+    block: Any, where: str = "comm_overlap"
+) -> List[str]:
+    """Validate the ``comm_overlap`` block of a ``BENCH_*.json``
+    artifact (absent on pre-overlap rounds)."""
+    problems = _check_fields(
+        block, _BENCH_COMM_OVERLAP_REQUIRED,
+        _BENCH_COMM_OVERLAP_OPTIONAL, where,
+    )
+    if problems:
+        return problems
+    if block["segments"] < 1:
+        problems.append(
+            f"{where}: segments must be >= 1 (the overlapped arm), got "
+            f"{block['segments']}"
+        )
+    if block["loss_rel_diff"] < 0:
+        problems.append(f"{where}: negative loss_rel_diff")
+    ratio = block.get("bytes_ratio")
+    if isinstance(ratio, (int, float)) and not 0.9 <= ratio <= 1.1:
+        problems.append(
+            f"{where}: bytes_ratio {ratio} outside [0.9, 1.1] — "
+            "overlap bucketing must not change the wire volume"
+        )
+    if block.get("hlo_gate") is True:
+        before = block.get("collectives_before_last_dot_overlap")
+        if not isinstance(before, int) or before < 1:
+            problems.append(
+                f"{where}: hlo_gate claims interleaving but "
+                "collectives_before_last_dot_overlap is not a positive "
+                "count"
+            )
+    wire = block.get("mpmd_wire_ratio")
+    if isinstance(wire, (int, float)) and wire < 1.0:
+        problems.append(
+            f"{where}: mpmd_wire_ratio {wire} < 1 (codec inflated the "
+            "payload)"
+        )
     return problems
 
 

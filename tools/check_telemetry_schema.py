@@ -32,6 +32,7 @@ from ray_lightning_tpu.telemetry.schema import (  # noqa: E402
     validate_bench_fault,
     validate_bench_host_overhead,
     validate_bench_chunked_prefill,
+    validate_bench_comm_overlap,
     validate_bench_mpmd,
     validate_bench_multi_lora,
     validate_bench_opt_state,
@@ -399,6 +400,7 @@ def _self_test_trace() -> list:
     chan._handle = _StubHandle()
     chan._store = None
     chan._shm_threshold = 1 << 30
+    chan._codec = None
     chan.bytes_sent = 0
     chan.shm_sends = 0
     chan.send("act", 0, 1, {"x": [1.0]}, chunk=0, trace=root)
@@ -538,10 +540,25 @@ def _self_test_mpmd() -> list:
     chan._handle = _StubHandle()
     chan._store = None
     chan._shm_threshold = 1 << 30
+    chan._codec = None
     chan.bytes_sent = 0
     chan.shm_sends = 0
     chan.send("act", 3, 1, {"x": [1.0, 2.0]}, chunk=1)
     problems = validate_mpmd_xfer(sent[0], "self-test mpmd xfer")
+
+    # A codec-bearing frame through the REAL encoder: the "enc" stamp
+    # must validate (round 25's quantized-wire accounting).
+    import numpy as _np
+
+    from ray_lightning_tpu.mpmd.transfer import WireCodec, WireDtypeConfig
+
+    chan._codec = WireCodec(WireDtypeConfig.coerce("act:bf16,grad:int8"))
+    chan.send("grad", 3, 1, {"g": _np.ones(8, _np.float32)}, chunk=1)
+    problems += validate_mpmd_xfer(sent[1], "self-test mpmd xfer enc")
+    if sent[1].get("enc") != "act:bf16,grad:int8":
+        problems.append(
+            "self-test mpmd xfer enc: codec frame missing its enc stamp"
+        )
 
     beat = {
         "type": "mpmd_stage", "stage": 1, "step": 4,
@@ -581,6 +598,56 @@ def _self_test_mpmd() -> list:
             {**beat, "bubble_fraction": 1.5}, "neg"):
         problems.append(
             "self-test mpmd beat: validator accepted bubble > 1"
+        )
+    problems += _self_test_comm_overlap()
+    return problems
+
+
+def _self_test_comm_overlap() -> list:
+    """The bench comm_overlap block (round 25) — a representative
+    passing block, then negatives (wire volume drifting under overlap,
+    an hlo_gate claim without interleaved collectives, a block missing
+    its A/B identification)."""
+    good = {
+        "segments": 2, "mode": "int8_ef", "devices": 8,
+        "loss_rel_diff": 0.002, "loss_step_end": 6.27,
+        "loss_overlap": 6.28,
+        "grad_sync_bytes_step_end": 60160.0,
+        "grad_sync_bytes_overlap": 60416.0,
+        "bytes_ratio": 1.0043,
+        "dispatches_per_opt_step_step_end": 1.0,
+        "dispatches_per_opt_step_overlap": 1.0,
+        "recompiles_step_end": 0, "recompiles_overlap": 0,
+        "collectives_before_last_dot_step_end": 0,
+        "collectives_before_last_dot_overlap": 54,
+        "hlo_gate": True,
+        "mpmd_wire_enc": "act:bf16,grad:int8",
+        "mpmd_wire_ratio": 1.99,
+        "mpmd_loss_rel_diff": 0.0001,
+    }
+    problems = validate_bench_comm_overlap(
+        good, "self-test bench comm_overlap"
+    )
+    if not validate_bench_comm_overlap({**good, "bytes_ratio": 1.5}):
+        problems.append(
+            "self-test bench comm_overlap: validator accepted a 1.5x "
+            "wire-volume drift"
+        )
+    if not validate_bench_comm_overlap(
+            {**good, "collectives_before_last_dot_overlap": 0}):
+        problems.append(
+            "self-test bench comm_overlap: validator accepted hlo_gate "
+            "without interleaved collectives"
+        )
+    if not validate_bench_comm_overlap({"segments": 2}):
+        problems.append(
+            "self-test bench comm_overlap: validator accepted a block "
+            "missing its A/B identification"
+        )
+    if not validate_bench_comm_overlap({**good, "mpmd_wire_ratio": 0.5}):
+        problems.append(
+            "self-test bench comm_overlap: validator accepted a codec "
+            "that inflated the wire"
         )
     return problems
 
@@ -1487,6 +1554,11 @@ def scan_bench_files() -> list:
         mpmd = doc.get("mpmd")
         if mpmd is not None:  # pre-MPMD rounds lack it
             problems += validate_bench_mpmd(mpmd, f"{name}:mpmd")
+        overlap = doc.get("comm_overlap")
+        if overlap is not None:  # pre-overlap rounds lack it
+            problems += validate_bench_comm_overlap(
+                overlap, f"{name}:comm_overlap"
+            )
         opt_state = doc.get("opt_state")
         if opt_state is not None:  # pre-HBM-diet rounds lack it
             problems += validate_bench_opt_state(

@@ -157,4 +157,32 @@ RLT_SLO=1 RLT_CAPACITY=1 RLT_TS_INTERVAL_S=0.5 RLT_DISAGG_REPLICAS=0 \
   timeout 1800 python bench_serve.py \
   2>&1 | tee "tools/hw_logs/${stamp}_bench_serve_slo_halfbin.log"
 
+log "comm/compute overlap A/B: backward-overlapped grad sync (comm_overlap block)"
+# Trunk-segment sweep x wire-width A/B on real DCN: G=0 is the step-end
+# baseline, G in {1,2,4} moves each segment's bucket all-reduce into the
+# backward where XLA's latency-hiding scheduler can bury it.  The int8_ef
+# arms compound the width cut with the schedule change (the headline
+# claim); the full-width arms isolate pure overlap (segmentation must be
+# bitwise-neutral there, so any tokens/s delta is schedule, not numerics).
+for g in 1 2 4; do
+  RLT_GRAD_OVERLAP=$g timeout 1800 python bench.py \
+    2>&1 | tee "tools/hw_logs/${stamp}_bench_overlap_g${g}_full.log"
+  RLT_GRAD_OVERLAP=$g RLT_GRAD_COMM=int8_ef timeout 1800 python bench.py \
+    2>&1 | tee "tools/hw_logs/${stamp}_bench_overlap_g${g}_int8ef.log"
+done
+RLT_GRAD_COMM=int8_ef timeout 1800 python bench.py \
+  2>&1 | tee "tools/hw_logs/${stamp}_bench_overlap_g0_int8ef.log"
+
+log "MPMD wire A/B: quantized DCN activation transfers (mpmd xfer stats)"
+# Pipeline-stage payload width against the f32 wire: bf16 halves the
+# activation bytes with rounding only; int8 is the block-scaled codec
+# (~3.9x) with sender-side EF on the grad direction.  On real DCN the
+# xfer wire_ratio comes with measured step time, so these logs price
+# the bandwidth cut against the host-side codec cost.
+for wd in bf16 int8 "act:bf16,grad:int8"; do
+  tag=$(echo "$wd" | tr ':,' '__')
+  RLT_MPMD_WIRE_DTYPE=$wd timeout 1800 python bench.py \
+    2>&1 | tee "tools/hw_logs/${stamp}_bench_mpmd_wire_${tag}.log"
+done
+
 log "done — logs in tools/hw_logs/${stamp}_*.log"

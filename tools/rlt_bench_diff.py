@@ -62,6 +62,11 @@ GATED_KEYS: Tuple[Tuple[str, str], ...] = (
     ("slo.prediction_error_pct", "lower"),
     ("slo.alerts_cold", "zero"),
     ("slo.recompiles_steady_state", "zero"),
+    ("comm_overlap.loss_rel_diff", "lower"),
+    ("comm_overlap.recompiles_step_end", "zero"),
+    ("comm_overlap.recompiles_overlap", "zero"),
+    ("comm_overlap.collectives_before_last_dot_overlap", "higher"),
+    ("comm_overlap.mpmd_wire_ratio", "higher"),
 )
 
 # Relative change below which a higher/lower key is noise, not signal.
@@ -209,6 +214,11 @@ def self_test() -> int:
     new["slo"] = {"prediction_error_pct": 5.0,
                   "alerts_cold": 0,
                   "recompiles_steady_state": 0}     # added block
+    new["comm_overlap"] = {"loss_rel_diff": 0.002,
+                           "recompiles_step_end": 0,
+                           "recompiles_overlap": 1,  # pin broken
+                           "collectives_before_last_dot_overlap": 54,
+                           "mpmd_wire_ratio": 3.9}   # added block
     rows = {r["key"]: r for r in diff_docs(old, new)}
     problems = []
 
@@ -225,14 +235,17 @@ def self_test() -> int:
     expect("value", "ok")
     expect("slo.prediction_error_pct", "added")
     expect("slo.alerts_cold", "added")
+    expect("comm_overlap.loss_rel_diff", "added")
+    expect("comm_overlap.recompiles_overlap", "added")
     if "spec_decode.vs_baseline" in rows:
         problems.append("absent-in-both block produced a row")
     # Direction sanity: a zero pin that HOLDS must not flag, and a
     # near-zero overhead baseline must use the absolute-move rule.
     ok_rows = {r["key"]: r for r in diff_docs(new, new)}
+    broken_pins = {"serve.recompiles_steady_state",
+                   "comm_overlap.recompiles_overlap"}
     for key, row in ok_rows.items():
-        if row["status"] == "regression" and key != \
-                "serve.recompiles_steady_state":
+        if row["status"] == "regression" and key not in broken_pins:
             problems.append(f"self-diff regressed {key}")
     shrunk = json.loads(json.dumps(new))
     shrunk["trace"]["overhead_pct"] = 0.0

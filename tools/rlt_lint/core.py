@@ -898,6 +898,19 @@ _HOT_JIT = {
     f"{_PKG}/mpmd/stage.py": frozenset({
         "StageRunner._run_opt_step",
     }),
+    f"{_PKG}/mpmd/transfer.py": frozenset({
+        # The quantized-wire codec runs per micro-batch SEND on every
+        # pipeline step: host-side numpy by design (np.asarray is its
+        # job), but a jit constructed here would recompile per frame.
+        "WireCodec.encode_payload", "LocalChannel.send",
+        "QueueChannel.send", "StageInbox._file",
+    }),
+    f"{_PKG}/parallel/overlap.py": frozenset({
+        # Grad taps are built per TRACE (amortized by the ledger's jit
+        # cache), never per step — a jax.jit inside the tap machinery
+        # would defeat exactly the overlap the taps exist to create.
+        "TapPlane.tap", "TapPlane.apply_entry_taps",
+    }),
     f"{_PKG}/core/loop.py": frozenset({
         "_AsyncLogFetch.schedule", "_RunningMeanLogs.update",
         "_RunningMeanLogs.update_stride", "_place_batch",
